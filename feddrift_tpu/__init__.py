@@ -1,0 +1,19 @@
+"""feddrift-tpu: a TPU-native federated-learning-under-concept-drift framework.
+
+A from-scratch JAX/XLA re-design with the capabilities of microsoft/FedDrift
+(AISTATS'23, "Federated Learning under Distributed Concept Drift"). Instead of
+one MPI process per client exchanging pickled state dicts (reference:
+fedml_api/distributed/fedavg_ens/FedAvgEnsAPI.py:86-92), clients and the model
+ensemble are array axes of a single sharded XLA program:
+
+- the model pool is a pytree stacked on a leading ``[M]`` axis,
+- clients are a ``[C]`` axis sharded over the TPU mesh,
+- per-(model, client) local SGD runs under ``vmap``/``shard_map``,
+- FedAvg aggregation is a masked weighted mean lowered to XLA collectives,
+- drift-clustering decisions (FedDrift hierarchical merge, drift detection,
+  IFCA/CFL/AUE/KUE/DriftSurf/Ada state machines) run on host between steps.
+"""
+
+__version__ = "0.1.0"
+
+from feddrift_tpu.config import ExperimentConfig  # noqa: F401

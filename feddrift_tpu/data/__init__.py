@@ -1,0 +1,3 @@
+from feddrift_tpu.data.drift_dataset import DriftDataset  # noqa: F401
+from feddrift_tpu.data.changepoints import load_change_points, generate_random_change_points  # noqa: F401
+from feddrift_tpu.data.registry import make_dataset  # noqa: F401

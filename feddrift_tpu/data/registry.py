@@ -1,0 +1,76 @@
+"""Dataset registry: dataset <-> drift-algorithm composition is orthogonal.
+
+The reference hardwires its drift pipeline to five datasets via a closed
+switch (fedml_experiments/distributed/fedavg_cont_ens/main_fedavg.py:145-179);
+FederatedEMNIST / fed_shakespeare only exist in the non-drift pipeline
+(BASELINE.md). Here any registered dataset composes with any drift algorithm.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import numpy as np
+
+from feddrift_tpu.config import ExperimentConfig
+from feddrift_tpu.data import changepoints as cp
+from feddrift_tpu.data.drift_dataset import DriftDataset
+from feddrift_tpu.data.prototype import generate_prototype_drift
+from feddrift_tpu.data.synthetic import generate_synthetic
+from feddrift_tpu.data.text import generate_text_drift
+
+_REGISTRY: dict[str, Callable[..., DriftDataset]] = {}
+
+
+def register_dataset(*names: str):
+    """Register a builder ``(cfg, change_points) -> DriftDataset`` under names."""
+    def deco(fn: Callable[[ExperimentConfig, np.ndarray], DriftDataset]):
+        for n in names:
+            _REGISTRY[n] = fn
+        return fn
+    return deco
+
+
+def available_datasets() -> list[str]:
+    return sorted(_REGISTRY)
+
+
+def _resolve_change_points(cfg: ExperimentConfig) -> np.ndarray:
+    if cfg.change_points == "rand":
+        return cp.generate_random_change_points(
+            cfg.train_iterations, cfg.client_num_in_total, cfg.drift_together,
+            cfg.time_stretch, seed=cfg.seed)
+    return cp.load_change_points(cfg.change_points)
+
+
+for _name in ("sea", "sine", "circle"):
+    @register_dataset(_name)
+    def _mk(cfg: ExperimentConfig, change_points: np.ndarray, *, _n=_name) -> DriftDataset:
+        return generate_synthetic(
+            _n, change_points, cfg.train_iterations, cfg.client_num_in_total,
+            cfg.sample_num, cfg.noise_prob, cfg.time_stretch, cfg.seed)
+
+for _name in ("MNIST", "femnist", "cifar10"):
+    @register_dataset(_name)
+    def _mk_img(cfg: ExperimentConfig, change_points: np.ndarray, *, _n=_name) -> DriftDataset:
+        return generate_prototype_drift(
+            _n, change_points, cfg.train_iterations, cfg.client_num_in_total,
+            cfg.sample_num, cfg.noise_prob, cfg.time_stretch, cfg.seed, cfg.data_dir)
+
+
+@register_dataset("shakespeare", "fed_shakespeare")
+def _mk_text(cfg: ExperimentConfig, change_points: np.ndarray) -> DriftDataset:
+    return generate_text_drift(
+        change_points, cfg.train_iterations, cfg.client_num_in_total,
+        cfg.sample_num, cfg.noise_prob, cfg.time_stretch, cfg.seed)
+
+
+def make_dataset(cfg: ExperimentConfig) -> DriftDataset:
+    if cfg.dataset not in _REGISTRY:
+        raise KeyError(f"unknown dataset {cfg.dataset!r}; available: {available_datasets()}")
+    change_points = _resolve_change_points(cfg)
+    if change_points.shape[1] < cfg.client_num_in_total:
+        raise ValueError(
+            f"change-point matrix has {change_points.shape[1]} clients < "
+            f"client_num_in_total={cfg.client_num_in_total}")
+    return _REGISTRY[cfg.dataset](cfg, change_points)

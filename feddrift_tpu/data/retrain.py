@@ -1,0 +1,72 @@
+"""Retrain-window specifications as dense time-weight tensors.
+
+The reference expresses "which past time steps feed a model's training" as a
+string spec parsed into concatenated pandas frames
+(fedml_api/data_preprocessing/common/retrain.py:7-85):
+
+    all | win-N | weight-linear | weight-exp | sel-i,j,... |
+    clientsel-<json per-client lists> | poisson
+
+Here the same spec becomes a ``[C, T_total]`` float weight matrix over time
+steps (duplication-based recency weighting maps to multiplicative weights, and
+``poisson`` maps to per-sample Poisson(1) counts used by KUE's bootstrap,
+retrain.py:65-74). A weight of w on step t means samples of that step are
+drawn with relative probability w during local SGD — exactly equivalent to the
+reference's duplicated-rows sampling because every step holds the same number
+of samples.
+
+Test data is always the *next* step (temporal holdout, retrain.py:78-83);
+that is handled by ``DriftDataset.test_slice``.
+"""
+
+from __future__ import annotations
+
+import json
+
+import numpy as np
+
+
+def time_weights(retrain_method: str, num_clients: int, current_iteration: int,
+                 total_steps: int) -> np.ndarray:
+    """Dense ``[C, total_steps]`` weights; zero for steps > current_iteration."""
+    t = current_iteration
+    w = np.zeros((num_clients, total_steps), dtype=np.float32)
+    if retrain_method == "all":
+        w[:, : t + 1] = 1.0
+    elif retrain_method.startswith("win-"):
+        win = int(retrain_method.removeprefix("win-"))
+        w[:, max(0, t - win + 1) : t + 1] = 1.0
+    elif retrain_method.startswith("weight-"):
+        kind = retrain_method.removeprefix("weight-")
+        for it in range(t + 1):
+            w[:, it] = (it + 1) if kind == "linear" else float(2**it)
+    elif retrain_method.startswith("sel-"):
+        spec = retrain_method.removeprefix("sel-")
+        if spec:
+            for it in spec.split(","):
+                w[:, int(it)] = 1.0
+    elif retrain_method.startswith("clientsel-"):
+        per_client = json.loads(retrain_method.removeprefix("clientsel-"))
+        for c in range(num_clients):
+            for it in per_client[c]:
+                w[c, int(it)] = 1.0
+    elif retrain_method.startswith("poisson"):
+        # Step-level weight is win-1; per-sample Poisson counts are produced
+        # separately by ``poisson_sample_counts``.
+        w[:, t] = 1.0
+    else:
+        raise NameError(retrain_method)
+    return w
+
+
+def poisson_sample_counts(num_clients: int, sample_num: int,
+                          rng: np.random.Generator) -> np.ndarray:
+    """Per-sample Poisson(1) bootstrap counts ``[C, N]`` (KUE; retrain.py:65-74).
+
+    Clients whose counts sum to zero fall back to uniform weights, matching the
+    reference's "if sum(weights) != 0" guard.
+    """
+    counts = rng.poisson(1.0, size=(num_clients, sample_num)).astype(np.float32)
+    empty = counts.sum(axis=1) == 0
+    counts[empty] = 1.0
+    return counts

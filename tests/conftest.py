@@ -1,0 +1,23 @@
+"""Test harness: force an 8-device virtual CPU platform.
+
+Mirrors how the reference smoke-tests its MPI pipeline on one box with
+``--ci 1`` (FedAvgEnsAggregatorSoftCluster.py:259-264): the pjit/collective
+paths run against XLA's host-platform device simulation so multi-chip sharding
+is exercised without TPU hardware.
+
+Note: this environment pre-imports jax via sitecustomize (axon TPU tunnel),
+so the platform must be overridden through jax.config, not env vars — and
+XLA_FLAGS must be appended before the first backend initialisation.
+"""
+
+import os
+
+_flag = "--xla_force_host_platform_device_count=8"
+if _flag not in os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") + " " + _flag).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+assert len(jax.devices()) >= 8, jax.devices()

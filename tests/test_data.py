@@ -1,0 +1,165 @@
+"""Unit tests for change points, synthetic generators, and retrain specs."""
+
+import numpy as np
+import pytest
+
+from feddrift_tpu.config import ExperimentConfig
+from feddrift_tpu.data import changepoints as cp
+from feddrift_tpu.data.registry import make_dataset, available_datasets
+from feddrift_tpu.data.retrain import time_weights, poisson_sample_counts
+from feddrift_tpu.data.synthetic import generate_synthetic, SEA_THRESHOLDS
+from feddrift_tpu.data.prototype import apply_label_swap
+
+
+class TestChangePoints:
+    def test_presets_load(self):
+        for name in ("A", "B", "C", "D", "E", "F", "W", "X", "Y", "Z", "R0", "R9"):
+            m = cp.load_change_points(name)
+            assert m.shape == (11, 10)
+            assert m.dtype == np.int32
+
+    def test_preset_a_is_binary_staggered(self):
+        a = cp.load_change_points("A")
+        assert set(np.unique(a)) <= {0, 1}
+        # drifts are staggered: not all clients change at the same step
+        change_steps = [np.nonzero(np.diff(a[:, c]))[0] for c in range(10)]
+        assert len({tuple(s) for s in change_steps}) > 1
+
+    def test_preset_d_has_four_concepts(self):
+        d = cp.load_change_points("D")
+        assert set(np.unique(d)) == {0, 1, 2, 3}
+
+    def test_random_generation(self):
+        m = cp.generate_random_change_points(10, 7, drift_together=0, seed=3)
+        assert m.shape == (11, 7)
+        assert (np.diff(m, axis=0) >= 0).all()
+        assert (m[0] == 0).all() and (m[-1] == 1).all()
+        m2 = cp.generate_random_change_points(10, 7, drift_together=1, seed=3)
+        # all clients share one change point
+        assert len({tuple(col) for col in m2.T}) == 1
+
+    def test_time_stretch_indexing(self):
+        a = cp.load_change_points("A")
+        mat = cp.concept_matrix(a, num_steps=20, num_clients=10, time_stretch=2)
+        assert mat.shape == (20, 10)
+        assert (mat[0] == a[0]).all() and (mat[19] == a[9]).all()
+
+
+class TestSynthetic:
+    def test_sea_shapes_and_labels(self):
+        cps = cp.load_change_points("A")
+        ds = generate_synthetic("sea", cps, 10, 10, 200, seed=0)
+        assert ds.x.shape == (10, 11, 200, 3)
+        assert ds.y.shape == (10, 11, 200)
+        assert ds.num_classes == 2
+        assert ds.num_steps == 10 and ds.samples_per_step == 200
+
+    def test_sea_boundary_statistics(self):
+        # label mean approx P(f2+f3 > theta) with 10% flip noise
+        cps = np.zeros((2, 4), dtype=np.int32)
+        ds = generate_synthetic("sea", cps, 1, 4, 5000, seed=1)
+        theta = SEA_THRESHOLDS[0]
+        p_clean = 1 - theta**2 / 200.0
+        expect = p_clean * 0.9 + (1 - p_clean) * 0.1
+        assert abs(ds.y.mean() - expect) < 0.02
+
+    def test_drift_changes_distribution(self):
+        cps = cp.load_change_points("A")
+        ds = generate_synthetic("sine", cps, 10, 10, 500, seed=0)
+        # client 1 drifts at t=1 in preset A: label rule flips
+        below = ds.x[1, :, :, 1] <= np.sin(ds.x[1, :, :, 0])
+        acc_c0 = (ds.y[1, 0] == below[0]).mean()   # concept 0 at t=0
+        acc_c1 = (ds.y[1, 2] == below[2]).mean()   # concept 1 at t=2 (preset A)
+        assert acc_c0 > 0.95 and acc_c1 < 0.05
+
+    def test_noise_prob_flips(self):
+        cps = np.zeros((2, 2), dtype=np.int32)
+        clean = generate_synthetic("circle", cps, 1, 2, 2000, seed=5)
+        noisy = generate_synthetic("circle", cps, 1, 2, 2000, noise_prob=0.3, seed=5)
+        frac_diff = (clean.y != noisy.y).mean()
+        assert 0.25 < frac_diff < 0.35
+
+    def test_determinism(self):
+        cps = cp.load_change_points("B")
+        a = generate_synthetic("sea", cps, 3, 5, 50, seed=9)
+        b = generate_synthetic("sea", cps, 3, 5, 50, seed=9)
+        assert (a.x == b.x).all() and (a.y == b.y).all()
+
+
+class TestLabelSwap:
+    def test_swaps(self):
+        y = np.arange(10)
+        assert (apply_label_swap(y, 0, 10) == y).all()
+        s1 = apply_label_swap(y, 1, 10)
+        assert s1[1] == 2 and s1[2] == 1 and s1[3] == 3
+        s3 = apply_label_swap(y, 3, 10)
+        assert s3[5] == 6 and s3[6] == 5
+
+
+class TestRegistry:
+    def test_available(self):
+        names = available_datasets()
+        for n in ("sea", "sine", "circle", "MNIST", "cifar10", "femnist", "shakespeare"):
+            assert n in names
+
+    def test_make_sea(self):
+        cfg = ExperimentConfig(dataset="sea", train_iterations=3, sample_num=40,
+                               client_num_in_total=10, client_num_per_round=10)
+        ds = make_dataset(cfg)
+        assert ds.x.shape == (10, 4, 40, 3)
+
+    def test_make_mnist_synthetic(self):
+        cfg = ExperimentConfig(dataset="MNIST", train_iterations=2, sample_num=30,
+                               change_points="D")
+        ds = make_dataset(cfg)
+        assert ds.x.shape == (10, 3, 30, 784)
+        assert ds.num_classes == 10
+
+    def test_make_text(self):
+        cfg = ExperimentConfig(dataset="shakespeare", train_iterations=2, sample_num=16)
+        ds = make_dataset(cfg)
+        assert ds.x.shape == (10, 3, 16, 80)
+        assert ds.is_sequence and ds.num_classes == 90
+
+    def test_rand_changepoints(self):
+        cfg = ExperimentConfig(dataset="sea", change_points="rand",
+                               train_iterations=6, sample_num=20)
+        ds = make_dataset(cfg)
+        assert ds.concepts.shape == (7, 10)
+
+
+class TestRetrain:
+    def test_all(self):
+        w = time_weights("all", 3, 2, 6)
+        assert (w[:, :3] == 1).all() and (w[:, 3:] == 0).all()
+
+    def test_win(self):
+        w = time_weights("win-2", 2, 4, 6)
+        assert (w[:, 3:5] == 1).all()
+        assert w.sum() == 4
+        w0 = time_weights("win-3", 2, 0, 6)
+        assert w0.sum() == 2  # clipped at 0
+
+    def test_weight_exp_linear(self):
+        w = time_weights("weight-exp", 1, 3, 5)
+        assert list(w[0, :4]) == [1, 2, 4, 8]
+        w = time_weights("weight-linear", 1, 3, 5)
+        assert list(w[0, :4]) == [1, 2, 3, 4]
+
+    def test_sel_and_clientsel(self):
+        w = time_weights("sel-0,2", 2, 3, 5)
+        assert (w[:, [0, 2]] == 1).all() and w.sum() == 4
+        w = time_weights("clientsel-[[0],[1,2]]", 2, 2, 5)
+        assert w[0, 0] == 1 and w[1, 1] == 1 and w[1, 2] == 1 and w.sum() == 3
+
+    def test_poisson(self):
+        w = time_weights("poisson", 2, 3, 5)
+        assert (w[:, 3] == 1).all() and w.sum() == 2
+        counts = poisson_sample_counts(4, 100, np.random.default_rng(0))
+        assert counts.shape == (4, 100)
+        assert (counts.sum(axis=1) > 0).all()
+        assert abs(counts.mean() - 1.0) < 0.15
+
+    def test_unknown_raises(self):
+        with pytest.raises(NameError):
+            time_weights("bogus", 1, 0, 2)
