@@ -1,0 +1,4 @@
+from feddrift_tpu.algorithms.base import DriftAlgorithm, make_algorithm, available_algorithms  # noqa: F401
+
+# Import algorithm modules for registration side effects.
+import feddrift_tpu.algorithms.singlemodel  # noqa: F401,E402

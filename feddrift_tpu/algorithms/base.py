@@ -1,0 +1,117 @@
+"""Drift-adaptation algorithm interface.
+
+An algorithm owns the host-side state machine (the reference's pickled
+SoftClusterState / DriftSurfState / AdaState / KueState / MultiModelAccState,
+FedAvgEnsDataLoader.py) and steers the device program through four hooks:
+
+- ``begin_iteration(t)``: start-of-time-step clustering / drift detection
+  (reference: aggregator ctor ``init_sc_state`` and the *_data_loader
+  functions, SURVEY.md §3.3-3.4). May mutate the model pool.
+- ``round_inputs(t, r)``: the [M, C, T1] time-weight tensor plus per-sample
+  weights / feature masks / LR scale consumed by ``TrainStep.train_round``.
+- ``after_round(...)``: post-aggregation work — CFL split checks
+  (AggregatorSoftCluster.py:140-146), IFCA hard-r re-clustering (:187-191),
+  Ada per-round LR statistics. Returns the params the pool should adopt.
+- ``end_iteration(t)``: state persistence / weight updates done near run end
+  (e.g. AUE ensemble-weight update, sc_state pickling).
+
+Evaluation routing mirrors ``test_on_all_clients``
+(AggregatorSoftCluster.py:210-285): either a per-client model index or an
+ensemble vote spec.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Callable, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+_REGISTRY: dict[str, Callable[..., "DriftAlgorithm"]] = {}
+
+
+def register_algorithm(*names: str):
+    def deco(cls):
+        for n in names:
+            _REGISTRY[n] = cls
+        return cls
+    return deco
+
+
+def available_algorithms() -> list[str]:
+    return sorted(_REGISTRY)
+
+
+def make_algorithm(cfg, ds, pool, step) -> "DriftAlgorithm":
+    name = cfg.concept_drift_algo
+    if name not in _REGISTRY:
+        raise KeyError(f"unknown concept_drift_algo {name!r}; "
+                       f"available: {available_algorithms()}")
+    return _REGISTRY[name](cfg, ds, pool, step)
+
+
+@dataclass
+class EnsembleSpec:
+    """Ensemble-vote evaluation (AUE hard vote / KUE soft vote)."""
+    mode: str                      # 'hard' | 'soft'
+    weights: np.ndarray            # [M] or [M, C]
+    model_mask: Optional[np.ndarray] = None   # [M] 1=include
+
+
+class DriftAlgorithm:
+    name = "base"
+
+    def __init__(self, cfg, ds, pool, step) -> None:
+        self.cfg = cfg
+        self.ds = ds
+        self.pool = pool
+        self.step = step
+        self.M = pool.num_models
+        self.C = cfg.client_num_in_total
+        self.T1 = ds.num_steps + 1
+        self.N = ds.samples_per_step
+        # default device-side constants
+        self._ones_sample_w = jnp.ones((self.M, self.C, self.N), jnp.float32)
+        self._ones_feat_mask = jnp.ones((self.M, *ds.feature_shape), jnp.float32) \
+            if not ds.is_sequence else jnp.ones((self.M, 1), jnp.float32)
+
+    # -- hooks ----------------------------------------------------------
+    def begin_iteration(self, t: int) -> None:
+        raise NotImplementedError
+
+    def round_inputs(self, t: int, r: int):
+        """-> (time_w [M,C,T1] jnp, sample_w [M,C,N], feat_mask, lr_scale)."""
+        raise NotImplementedError
+
+    def after_round(self, t: int, r: int, prev_params, agg_params,
+                    client_params, n) -> Any:
+        """Return the params the pool adopts for the next round."""
+        return agg_params
+
+    def end_iteration(self, t: int) -> None:
+        pass
+
+    # -- evaluation routing --------------------------------------------
+    def test_model_idx(self, t: int) -> np.ndarray:
+        """[C] model index per client for train/test eval."""
+        return np.zeros((self.C,), dtype=np.int64)
+
+    def ensemble_spec(self, t: int) -> Optional[EnsembleSpec]:
+        return None
+
+    # -- checkpointing --------------------------------------------------
+    def state_dict(self) -> dict:
+        return {}
+
+    def load_state_dict(self, d: dict) -> None:
+        pass
+
+    # -- helpers --------------------------------------------------------
+    def feature_mask_for(self, mask_flat: np.ndarray) -> jnp.ndarray:
+        """Reshape [M, F_flat] masks to the dataset's feature shape (KUE
+        reshapes masks to the sample shape, FedAvgEnsTrainerKue.py:68-71)."""
+        if self.ds.is_sequence:
+            return jnp.ones((self.M, 1), jnp.float32)
+        return jnp.asarray(mask_flat, jnp.float32).reshape(
+            (self.M, *self.ds.feature_shape))

@@ -1,0 +1,56 @@
+"""Single-model continual baselines: oblivious windows and recency weighting.
+
+Covers the reference's ``fedavg_cont_one`` pipeline (win-N / all / weight-*
+via --retrain_data, fedml_experiments/distributed/fedavg_cont_one/) and the
+``exp`` / ``lin`` recency-weighted trainers of the ensemble pipeline
+(FedAvgEnsTrainerExp.py:66 weight 2^t, FedAvgEnsTrainerLin.py:66 weight t+1,
+with the Vanilla single-model aggregator FedAvgEnsAggregatorVanilla.py:14).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from feddrift_tpu.algorithms.base import DriftAlgorithm, register_algorithm
+from feddrift_tpu.data.retrain import time_weights
+
+
+@register_algorithm("win-1", "all", "oblivious", "window")
+class WindowBaseline(DriftAlgorithm):
+    """One model trained on a retrain-window of past steps. The window spec
+    comes from cfg.retrain_data ('win-N', 'all', 'weight-exp', ...) as in the
+    cont_one shell arg 19 (run_fedavg_distributed_pytorch.sh:21)."""
+
+    name = "window"
+
+    def __init__(self, cfg, ds, pool, step) -> None:
+        super().__init__(cfg, ds, pool, step)
+        spec = cfg.retrain_data
+        if cfg.concept_drift_algo in ("win-1", "all"):
+            spec = cfg.concept_drift_algo
+        self.spec = spec
+        self._tw = None
+
+    def begin_iteration(self, t: int) -> None:
+        w = time_weights(self.spec, self.C, t, self.T1)      # [C, T1]
+        self._tw = jnp.asarray(w[None], jnp.float32)          # [1, C, T1]
+
+    def round_inputs(self, t: int, r: int):
+        return self._tw, self._ones_sample_w, self._ones_feat_mask, jnp.float32(1.0)
+
+
+@register_algorithm("exp", "lin")
+class RecencyWeighted(DriftAlgorithm):
+    """Exponential / linear recency sampling over all past steps
+    (FedAvgEnsTrainer{Exp,Lin}.py:66)."""
+
+    name = "recency"
+
+    def begin_iteration(self, t: int) -> None:
+        kind = "weight-exp" if self.cfg.concept_drift_algo == "exp" else "weight-linear"
+        w = time_weights(kind, self.C, t, self.T1)
+        self._tw = jnp.asarray(w[None], jnp.float32)
+
+    def round_inputs(self, t: int, r: int):
+        return self._tw, self._ones_sample_w, self._ones_feat_mask, jnp.float32(1.0)

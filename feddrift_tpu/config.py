@@ -89,7 +89,8 @@ class ExperimentConfig:
             return self.ensemble_window
         if self.concept_drift_algo == "driftsurf":
             return 2  # pred + (stab|reac), DriftSurfState at FedAvgEnsDataLoader.py:151
-        if self.concept_drift_algo in ("ada", "win-1", "all", "exp", "lin", "oblivious"):
+        if self.concept_drift_algo in ("ada", "win-1", "all", "exp", "lin",
+                                       "oblivious", "window"):
             return 1
         return self.concept_num
 

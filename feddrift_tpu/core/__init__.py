@@ -1,0 +1,2 @@
+from feddrift_tpu.core.pool import ModelPool  # noqa: F401
+from feddrift_tpu.core.step import TrainStep  # noqa: F401

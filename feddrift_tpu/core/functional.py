@@ -1,0 +1,37 @@
+"""Numerical primitives: loss, accuracy, confusion matrices, pytree helpers."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def cross_entropy(logits: jnp.ndarray, labels: jnp.ndarray) -> jnp.ndarray:
+    """Mean softmax cross-entropy (the reference's nn.CrossEntropyLoss)."""
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    return -jnp.take_along_axis(logp, labels[..., None], axis=-1).mean()
+
+
+def confusion_matrix(logits: jnp.ndarray, labels: jnp.ndarray,
+                     num_classes: int) -> jnp.ndarray:
+    """[K, K] counts with rows = true label, cols = prediction (KUE kappa,
+    reference FedAvgEnsAggregatorKue.py:289-299)."""
+    preds = logits.argmax(axis=-1)
+    flat = labels * num_classes + preds
+    return jnp.bincount(flat, length=num_classes * num_classes).reshape(
+        (num_classes, num_classes)).astype(jnp.float32)
+
+
+def cohens_kappa(conf: jnp.ndarray) -> jnp.ndarray:
+    """Cohen's kappa from a summed confusion matrix
+    (FedAvgEnsAggregatorKue.py:64-70)."""
+    n = conf.sum()
+    diag = jnp.trace(conf)
+    marg = (conf.sum(axis=1) * conf.sum(axis=0)).sum()
+    return (n * diag - marg) / (n * n - marg)
+
+
+def tree_select(cond_scalar, a, b):
+    """Select an entire pytree by a traced scalar boolean."""
+    return jax.tree_util.tree_map(
+        lambda x, y: jnp.where(cond_scalar, x, y), a, b)

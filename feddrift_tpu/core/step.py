@@ -1,0 +1,255 @@
+"""The federated round as a single XLA program.
+
+Reference hot path (SURVEY.md §3.2): server broadcasts M state_dicts to C
+client processes over MPI; each client runs, per model, ``epochs`` SGD steps
+on randomly sampled batches (FedAvgEnsTrainer.py:50-85, weighted time-step
+sampling in FedAvgEnsTrainerSoftCluster.py:72-125); the server then does a
+per-model sample-weighted parameter average skipping unused models
+(FedAvgEnsAggregatorSoftCluster.py:149-185).
+
+Here the whole round is ONE jitted function:
+
+    params      [M, ...]        model pool (replicated over the mesh)
+    opt_state   [M, C, ...]     per-(model, client) optimizer state; persists
+                                across rounds within a time step, reset at
+                                step boundaries — exactly the lifetime of the
+                                reference's per-process optimizers
+    x, y        [C, T1, N, ...] the full drift dataset (sharded over clients)
+    time_w      [M, C, T1]      per-(model, client) time-step sampling weights
+                                (the sc_weights tensor, FedAvgEnsDataLoader.py:589)
+    sample_w    [M, C, N]       per-sample weights (KUE Poisson bootstrap;
+                                ones otherwise)
+    feat_mask   [M, F]          multiplicative feature masks (KUE; ones otherwise)
+    lr_scale    []              dynamic LR multiplier (Adaptive-FedAvg)
+
+Local SGD vmaps over (M, C); aggregation is a weighted mean over the client
+axis, which GSPMD lowers to an all-reduce over ICI when C is sharded. Unused
+(model, client) pairs (zero total weight) still execute — static shapes — but
+their updates are masked out, mirroring the reference's skip logic
+(FedAvgEnsTrainerSoftCluster.py:67-79, AggregatorSoftCluster.py:151-169).
+
+Batch sampling semantics match the reference: data is pre-shuffled once per
+time step (host side), a step picks time step t ~ Categorical(time_w) and a
+contiguous batch within it (FedAvgEnsTrainerSoftCluster.py:91-113: concatenated
+per-step batch lists, uniform batch choice). With per-sample weights the batch
+is instead drawn by weighted categorical sampling with replacement (the
+Poisson bootstrap resample, retrain.py:65-74).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from feddrift_tpu.core.functional import confusion_matrix, cross_entropy, tree_select
+
+
+def make_optimizer(name: str, lr: float, wd: float) -> optax.GradientTransformation:
+    """Client optimizer. Reference: SGD(lr) or Adam(lr, wd, amsgrad=True)
+    (FedAvgEnsTrainer.py:28-33)."""
+    if name == "sgd":
+        return optax.sgd(lr)
+    return optax.chain(optax.add_decayed_weights(wd), optax.amsgrad(lr))
+
+
+# eq=False keeps the dataclass hashable (identity hash) so jit can treat
+# `self` as a static argument.
+@dataclass(eq=False)
+class TrainStep:
+    """Compiled train/eval programs for one (module, dataset geometry)."""
+
+    apply_fn: Callable          # (params, x) -> logits
+    optimizer: optax.GradientTransformation
+    batch_size: int
+    num_steps: int              # local SGD steps per round (reference `epochs`)
+    num_classes: int
+
+    # ------------------------------------------------------------------
+    def init_opt_states(self, params, num_models: int, num_clients: int):
+        """[M, C, ...] optimizer states, fresh at each time-step boundary."""
+        def init_one(p):
+            return self.optimizer.init(p)
+        per_model = jax.vmap(init_one)(params)          # [M, ...]
+        return jax.tree_util.tree_map(
+            lambda s: jnp.broadcast_to(
+                s[:, None], (s.shape[0], num_clients, *s.shape[1:])).copy(),
+            per_model)
+
+    # ------------------------------------------------------------------
+    def _local_sgd(self, params, opt_state, key, x_ct, y_ct, w_t, s_n,
+                   fmask, lr_scale):
+        """Train ONE (model, client) pair for num_steps batches.
+
+        x_ct: [T1, N, ...]; w_t: [T1]; s_n: [N]; fmask: [F...]-broadcastable.
+        """
+        T1, N = x_ct.shape[0], x_ct.shape[1]
+        B = min(self.batch_size, N)
+        nb = N // B                                     # batches per time step
+        total_w = w_t.sum()
+        active = total_w > 0
+
+        # Per-sample categorical logits over the flattened [T1*N] axis:
+        # p[t, n] ∝ w_t[t] * s_n[n]. Uniform fallback keeps logits finite
+        # for inactive pairs (their result is masked out below).
+        probs = jnp.where(active, 1.0, 0.0) * (w_t[:, None] * s_n[None, :])
+        probs = jnp.where(probs.sum() > 0, probs, jnp.ones_like(probs))
+        logits_flat = jnp.log(probs.reshape(-1) + 1e-30)
+        # Time-step-level logits for contiguous-batch mode.
+        wt_safe = jnp.where(total_w > 0, w_t, jnp.ones_like(w_t))
+        logits_t = jnp.log(wt_safe + 1e-30)
+
+        weighted_sampling = (s_n != 1.0).any()
+
+        x_flat = x_ct.reshape((T1 * N,) + x_ct.shape[2:])
+        y_flat = y_ct.reshape((T1 * N,))
+
+        def loss_fn(p, xb, yb):
+            return cross_entropy(self.apply_fn(p, xb * fmask
+                                               if xb.dtype != jnp.int32 else xb), yb)
+
+        def step(carry, k):
+            p, o = carry
+            k1, k2 = jax.random.split(k)
+            # contiguous batch: t ~ Cat(w), slot ~ U[0, nb)
+            t_idx = jax.random.categorical(k1, logits_t)
+            slot = jax.random.randint(k2, (), 0, nb)
+            base = t_idx * N + slot * B
+            idx_contig = base + jnp.arange(B)
+            # weighted per-sample batch (with replacement)
+            idx_weighted = jax.random.categorical(k1, logits_flat, shape=(B,))
+            idx = jnp.where(weighted_sampling, idx_weighted, idx_contig)
+            xb, yb = x_flat[idx], y_flat[idx]
+            loss, grads = jax.value_and_grad(loss_fn)(p, xb, yb)
+            updates, o = self.optimizer.update(grads, o, p)
+            updates = jax.tree_util.tree_map(lambda u: u * lr_scale, updates)
+            p = optax.apply_updates(p, updates)
+            return (p, o), loss
+
+        keys = jax.random.split(key, self.num_steps)
+        (p_new, o_new), losses = jax.lax.scan(step, (params, opt_state), keys)
+
+        p_out = tree_select(active, p_new, params)
+        o_out = tree_select(active, o_new, opt_state)
+        # Weighted sample count reported to the aggregator
+        # (FedAvgEnsTrainerSoftCluster.py:72-74: sum_t w[t] * data volume).
+        n = jnp.where(active, total_w * N, 0.0)
+        return p_out, o_out, n, losses.mean()
+
+    # ------------------------------------------------------------------
+    @partial(jax.jit, static_argnums=0)
+    def train_round(self, params, opt_states, key, x, y, time_w, sample_w,
+                    feat_mask, lr_scale):
+        """One communication round. Returns (new_params [M, ...],
+        new_opt_states, client_params [M, C, ...], n [M, C], mean_loss [M, C]).
+        """
+        M = time_w.shape[0]
+        C = x.shape[0]
+        keys = jax.random.split(key, M * C).reshape(M, C, 2)
+
+        # vmap over clients (inner), then models (outer).
+        def per_model(p_m, o_m, k_m, w_m, s_m, f_m):
+            return jax.vmap(
+                lambda o, k, xc, yc, w, s: self._local_sgd(
+                    p_m, o, k, xc, yc, w, s, f_m, lr_scale)
+            )(o_m, k_m, x, y, w_m, s_m)
+
+        client_params, new_opt, n, losses = jax.vmap(per_model)(
+            params, opt_states, keys, time_w, sample_w, feat_mask)
+
+        # Masked weighted FedAvg over the client axis
+        # (AggregatorSoftCluster.py:149-185). With a sharded client axis the
+        # sums become ICI all-reduces.
+        denom = n.sum(axis=1)                              # [M]
+        w_norm = n / jnp.maximum(denom[:, None], 1e-12)    # [M, C]
+        def avg(leaf_mc, leaf_m):
+            wb = w_norm.reshape(w_norm.shape + (1,) * (leaf_mc.ndim - 2))
+            agg = (leaf_mc * wb).sum(axis=1)
+            keep = (denom > 0).reshape((-1,) + (1,) * (leaf_m.ndim - 1))
+            return jnp.where(keep, agg, leaf_m)
+        new_params = jax.tree_util.tree_map(avg, client_params, params)
+        return new_params, new_opt, client_params, n, losses
+
+    # ------------------------------------------------------------------
+    @partial(jax.jit, static_argnums=0)
+    def acc_matrix(self, params, x, y, feat_mask):
+        """Batched [M, C] eval of every model on every client's data.
+
+        Replaces the reference's hottest loop — M x C sequential full-dataset
+        inferences with CPU<->GPU shuttling (train_acc_matrix,
+        FedAvgEnsDataLoader.py:1074-1085) — with one [M, C, N] forward.
+        x: [C, N, ...]; returns (correct [M, C], loss_sum [M, C], total [C]).
+        """
+        def one(p_m, f_m):
+            def per_client(xc, yc):
+                xin = xc * f_m if xc.dtype != jnp.int32 else xc
+                logits = self.apply_fn(p_m, xin)
+                logp = jax.nn.log_softmax(logits)
+                nll = -jnp.take_along_axis(logp, yc[:, None], axis=-1).sum()
+                return (logits.argmax(-1) == yc).sum(), nll
+            return jax.vmap(per_client)(x, y)
+        correct, loss_sum = jax.vmap(one)(params, feat_mask)
+        total = jnp.full((x.shape[0],), x.shape[1], dtype=jnp.int32)
+        return correct, loss_sum, total
+
+    # ------------------------------------------------------------------
+    @partial(jax.jit, static_argnums=(0, 5))
+    def ensemble_eval(self, params, x, y, ens_weights, mode: str = "hard",
+                      model_mask=None, feat_mask=None):
+        """Weighted-vote ensemble accuracy per client.
+
+        mode='hard': AUE — each model casts its weight on its argmax class
+        (FedAvgEnsAggregatorAue.py:256-283).
+        mode='soft': KUE — kappa-weighted softmax sum over models with
+        kappa > 0, worst model excluded (FedAvgEnsAggregatorKue.py:234-262).
+        x: [C, N, ...]; ens_weights: [M] or [M, C] (AUE-PC per-client weights,
+        FedAvgEnsAggregatorAuePc.py:260). Returns (correct [C], total [C]).
+        """
+        M = jax.tree_util.tree_leaves(params)[0].shape[0]
+        if model_mask is None:
+            model_mask = jnp.ones((M,), dtype=jnp.float32)
+        if ens_weights.ndim == 1:
+            ens_weights = jnp.broadcast_to(ens_weights[:, None],
+                                           (M, x.shape[0]))
+
+        def one_model(p_m, f_m):
+            def per_client(xc):
+                xin = xc * f_m if xc.dtype != jnp.int32 else xc
+                return self.apply_fn(p_m, xin)          # [N, K]
+            return jax.vmap(per_client)(x)              # [C, N, K]
+        if feat_mask is None:
+            feat_mask = jnp.ones((M,) + (1,) * (x.ndim - 2), dtype=x.dtype) \
+                if x.dtype != jnp.int32 else jnp.ones((M, 1), dtype=jnp.float32)
+        logits = jax.vmap(one_model)(params, feat_mask)  # [M, C, N, K]
+
+        w = ens_weights * model_mask[:, None]            # [M, C]
+        if mode == "hard":
+            votes = jax.nn.one_hot(logits.argmax(-1), logits.shape[-1])
+        else:
+            votes = jax.nn.softmax(logits, axis=-1)
+            w = jnp.maximum(w, 0.0) * (ens_weights > 0)  # kappa>0 gate
+        combined = (votes * w[:, :, None, None]).sum(axis=0)   # [C, N, K]
+        correct = (combined.argmax(-1) == y).sum(axis=1)
+        # Ensemble NLL from the normalised vote distribution, so Test/Loss
+        # stays a real series for AUE/KUE runs.
+        probs = combined / jnp.maximum(combined.sum(-1, keepdims=True), 1e-12)
+        nll = -jnp.log(jnp.take_along_axis(probs, y[..., None], -1)[..., 0] + 1e-12)
+        loss_sum = nll.sum(axis=1)
+        total = jnp.full((x.shape[0],), x.shape[1], dtype=jnp.int32)
+        return correct, total, loss_sum
+
+    # ------------------------------------------------------------------
+    @partial(jax.jit, static_argnums=0)
+    def confusion_matrices(self, params, x, y, feat_mask):
+        """Per-(model, client) confusion matrices [M, C, K, K] (KUE kappa)."""
+        K = self.num_classes
+        def one(p_m, f_m):
+            def per_client(xc, yc):
+                xin = xc * f_m if xc.dtype != jnp.int32 else xc
+                return confusion_matrix(self.apply_fn(p_m, xin), yc, K)
+            return jax.vmap(per_client)(x, y)
+        return jax.vmap(one)(params, feat_mask)

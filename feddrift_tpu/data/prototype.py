@@ -119,11 +119,12 @@ def generate_prototype_drift(
             concept = int(concepts[t, c])
             if real is not None:
                 rx, ry = real
-                if used + sample_num >= len(rx):  # repeat when exhausted (:181)
+                if used + sample_num > len(rx):  # wrap when exhausted (:181)
                     used = 0
-                xs = rx[used:used + sample_num].reshape(sample_num, *feature_shape)
-                ys = ry[used:used + sample_num].copy()
-                used += sample_num
+                take = np.arange(used, used + sample_num) % len(rx)
+                xs = rx[take].reshape(sample_num, *feature_shape)
+                ys = ry[take].copy()
+                used = (used + sample_num) % len(rx)
             else:
                 xs, ys = sampler.sample(rng, sample_num)
             ys = apply_label_swap(ys, concept, num_classes)

@@ -1,0 +1,93 @@
+"""Model zoo registry.
+
+Mirrors the reference's ``create_model`` dispatch
+(fedml_experiments/distributed/fedavg_cont_ens/main_fedavg.py:207-224) but as
+flax modules returning logits. Every model is a pure function of
+``(params, x)`` so the pool can be stacked on a leading ``[M]`` axis and
+trained under ``vmap``.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import flax.linen as nn
+
+from feddrift_tpu.data.drift_dataset import DriftDataset
+from feddrift_tpu.models.mlp import LogisticRegression, FeedForwardNN
+from feddrift_tpu.models.cnn import CNNFedAvg, CNNDropout
+from feddrift_tpu.models.resnet import ResNetCifar, ResNet18
+from feddrift_tpu.models.rnn import CharLSTM, WordLSTM
+
+_BUILDERS: dict[str, Callable[..., nn.Module]] = {}
+
+
+def register_model(*names: str):
+    def deco(fn):
+        for n in names:
+            _BUILDERS[n] = fn
+        return fn
+    return deco
+
+
+def available_models() -> list[str]:
+    return sorted(_BUILDERS)
+
+
+@register_model("lr")
+def _lr(ds: DriftDataset, cfg) -> nn.Module:
+    return LogisticRegression(num_classes=ds.num_classes)
+
+
+@register_model("fnn")
+def _fnn(ds: DriftDataset, cfg) -> nn.Module:
+    # Reference: FeedForwardNN(input_dim, output_dim, hidden) with hidden from
+    # main_fedavg model wiring; hidden_dim configurable here.
+    return FeedForwardNN(num_classes=ds.num_classes,
+                        hidden_dim=getattr(cfg, "fnn_hidden_dim", 10))
+
+
+@register_model("cnn")
+def _cnn(ds: DriftDataset, cfg) -> nn.Module:
+    return CNNFedAvg(num_classes=ds.num_classes)
+
+
+@register_model("cnn_dropout")
+def _cnnd(ds: DriftDataset, cfg) -> nn.Module:
+    return CNNDropout(num_classes=ds.num_classes)
+
+
+@register_model("resnet", "resnet20")
+def _resnet20(ds: DriftDataset, cfg) -> nn.Module:
+    return ResNetCifar(num_classes=ds.num_classes, depth=20)
+
+
+@register_model("resnet56")
+def _resnet56(ds: DriftDataset, cfg) -> nn.Module:
+    return ResNetCifar(num_classes=ds.num_classes, depth=56)
+
+
+@register_model("resnet56_gn")
+def _resnet56gn(ds: DriftDataset, cfg) -> nn.Module:
+    return ResNetCifar(num_classes=ds.num_classes, depth=56, norm="group")
+
+
+@register_model("resnet18")
+def _resnet18(ds: DriftDataset, cfg) -> nn.Module:
+    return ResNet18(num_classes=ds.num_classes)
+
+
+@register_model("rnn")
+def _rnn(ds: DriftDataset, cfg) -> nn.Module:
+    return CharLSTM(vocab_size=ds.num_classes)
+
+
+@register_model("rnn_stackoverflow")
+def _rnn_so(ds: DriftDataset, cfg) -> nn.Module:
+    return WordLSTM(vocab_size=ds.num_classes)
+
+
+def create_model(name: str, ds: DriftDataset, cfg=None) -> nn.Module:
+    if name not in _BUILDERS:
+        raise KeyError(f"unknown model {name!r}; available: {available_models()}")
+    return _BUILDERS[name](ds, cfg)

@@ -1,0 +1,58 @@
+"""FedAvg CNNs (reference: fedml_api/model/cv/cnn.py:5-120).
+
+TPU-first notes: NHWC layout (XLA's native conv layout on TPU), logits
+output. The reference applies ``Softmax`` inside ``forward`` and then
+``CrossEntropyLoss`` on top (cnn.py:66-68) — a double normalisation that
+flattens gradients; we return logits instead, which trains the same task with
+better conditioning (documented deviation).
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+def _to_nhwc(x, side: int = 28, channels: int = 1):
+    if x.ndim == 2:  # flat [B, side*side*channels]
+        x = x.reshape((x.shape[0], side, side, channels))
+    elif x.ndim == 3:  # [B, H, W]
+        x = x[..., None]
+    return x
+
+
+class CNNFedAvg(nn.Module):
+    """conv5x5(32) -> pool -> conv5x5(64) -> pool -> fc512 -> fc K
+    (cnn.py:50-69); 1,663,370 params for 10 classes, matching the paper."""
+
+    num_classes: int = 10
+
+    @nn.compact
+    def __call__(self, x):
+        x = _to_nhwc(x)
+        x = nn.Conv(32, (5, 5), padding="SAME")(x)
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = nn.Conv(64, (5, 5), padding="SAME")(x)
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = x.reshape((x.shape[0], -1))
+        x = nn.relu(nn.Dense(512)(x))
+        return nn.Dense(self.num_classes)(x)
+
+
+class CNNDropout(nn.Module):
+    """The dropout variant (cnn.py:71-120): conv3x3(32) -> conv3x3(64) ->
+    pool -> dropout .25 -> fc128 -> dropout .5 -> fc K."""
+
+    num_classes: int = 62
+
+    @nn.compact
+    def __call__(self, x, deterministic: bool = True):
+        x = _to_nhwc(x)
+        x = nn.relu(nn.Conv(32, (3, 3), padding="VALID")(x))
+        x = nn.relu(nn.Conv(64, (3, 3), padding="VALID")(x))
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = nn.Dropout(0.25, deterministic=deterministic)(x)
+        x = x.reshape((x.shape[0], -1))
+        x = nn.relu(nn.Dense(128)(x))
+        x = nn.Dropout(0.5, deterministic=deterministic)(x)
+        return nn.Dense(self.num_classes)(x)

@@ -1,0 +1,33 @@
+"""Linear / MLP models (reference: fedml_api/model/linear/lr.py,
+fedml_api/model/fnn/fnn.py)."""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class LogisticRegression(nn.Module):
+    """Sigmoid-squashed linear head, as the reference (lr.py:10-11) —
+    note the reference feeds sigmoid outputs into CrossEntropyLoss; we keep
+    that behavior for parity."""
+
+    num_classes: int
+
+    @nn.compact
+    def __call__(self, x):
+        x = x.reshape((x.shape[0], -1))
+        return nn.sigmoid(nn.Dense(self.num_classes)(x))
+
+
+class FeedForwardNN(nn.Module):
+    """fc1 -> relu -> fc2 (fnn.py:5-15); the SEA/SINE/CIRCLE/MNIST workhorse."""
+
+    num_classes: int
+    hidden_dim: int = 10
+
+    @nn.compact
+    def __call__(self, x):
+        x = x.reshape((x.shape[0], -1))
+        x = nn.relu(nn.Dense(self.hidden_dim)(x))
+        return nn.Dense(self.num_classes)(x)

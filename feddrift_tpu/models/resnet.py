@@ -1,0 +1,96 @@
+"""CIFAR ResNets (reference: fedml_api/model/cv/resnet.py:113-232 resnet56/110,
+cv/resnet_gn.py:108 GroupNorm variant, torchvision resnet18 at
+main_fedavg.py:219-222).
+
+TPU-first: NHWC, 3x3 convs sized to keep the MXU busy, GroupNorm option for
+federated settings where BatchNorm's running stats are problematic (the usual
+reason the reference ships resnet_gn). BatchNorm here is implemented *without*
+cross-round running statistics — per-batch normalisation — which sidesteps
+mutable batch-stats collections in the vmapped multi-model pool while staying
+faithful to federated practice.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class _Norm(nn.Module):
+    kind: str = "batch"
+
+    @nn.compact
+    def __call__(self, x):
+        if self.kind == "group":
+            return nn.GroupNorm(num_groups=min(32, x.shape[-1]))(x)
+        # Stateless per-batch normalisation over (N, H, W).
+        mean = x.mean(axis=(0, 1, 2), keepdims=True)
+        var = x.var(axis=(0, 1, 2), keepdims=True)
+        scale = self.param("scale", nn.initializers.ones, (x.shape[-1],))
+        bias = self.param("bias", nn.initializers.zeros, (x.shape[-1],))
+        return (x - mean) / jnp.sqrt(var + 1e-5) * scale + bias
+
+
+class BasicBlock(nn.Module):
+    filters: int
+    strides: int = 1
+    norm: str = "batch"
+
+    @nn.compact
+    def __call__(self, x):
+        residual = x
+        y = nn.Conv(self.filters, (3, 3), strides=(self.strides, self.strides),
+                    padding="SAME", use_bias=False)(x)
+        y = nn.relu(_Norm(self.norm)(y))
+        y = nn.Conv(self.filters, (3, 3), padding="SAME", use_bias=False)(y)
+        y = _Norm(self.norm)(y)
+        if residual.shape != y.shape:
+            residual = nn.Conv(self.filters, (1, 1),
+                               strides=(self.strides, self.strides),
+                               use_bias=False)(x)
+            residual = _Norm(self.norm)(residual)
+        return nn.relu(y + residual)
+
+
+class ResNetCifar(nn.Module):
+    """6n+2 CIFAR ResNet (resnet.py:113: depth in {20, 56, 110})."""
+
+    num_classes: int = 10
+    depth: int = 20
+    norm: str = "batch"
+
+    @nn.compact
+    def __call__(self, x):
+        if x.ndim == 2:
+            x = x.reshape((x.shape[0], 32, 32, 3))
+        n = (self.depth - 2) // 6
+        x = nn.Conv(16, (3, 3), padding="SAME", use_bias=False)(x)
+        x = nn.relu(_Norm(self.norm)(x))
+        for stage, filters in enumerate((16, 32, 64)):
+            for block in range(n):
+                strides = 2 if stage > 0 and block == 0 else 1
+                x = BasicBlock(filters, strides, self.norm)(x)
+        x = x.mean(axis=(1, 2))
+        return nn.Dense(self.num_classes)(x)
+
+
+class ResNet18(nn.Module):
+    """Compact ImageNet-style ResNet-18 (torchvision flavor, 2-2-2-2 blocks)."""
+
+    num_classes: int = 10
+    norm: str = "batch"
+
+    @nn.compact
+    def __call__(self, x):
+        if x.ndim == 2:
+            x = x.reshape((x.shape[0], 32, 32, 3))
+        x = nn.Conv(64, (3, 3), padding="SAME", use_bias=False)(x)  # CIFAR stem
+        x = nn.relu(_Norm(self.norm)(x))
+        for stage, filters in enumerate((64, 128, 256, 512)):
+            for block in range(2):
+                strides = 2 if stage > 0 and block == 0 else 1
+                x = BasicBlock(filters, strides, self.norm)(x)
+        x = x.mean(axis=(1, 2))
+        return nn.Dense(self.num_classes)(x)

@@ -1,0 +1,42 @@
+"""LSTM sequence models (reference: fedml_api/model/nlp/rnn.py:4-67).
+
+CharLSTM replicates RNN_OriginalFedAvg (embed 8 -> 2-layer LSTM 256 -> fc to
+vocab, last-position prediction); WordLSTM replicates RNN_StackOverFlow
+(embed 96 -> LSTM 670 -> fc 96 -> fc vocab+4).
+
+TPU-first: the sequence is unrolled with ``nn.RNN`` (lax.scan under the
+hood) so the whole model stays a single XLA program; batch-first layout.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class CharLSTM(nn.Module):
+    vocab_size: int = 90
+    embedding_dim: int = 8
+    hidden_size: int = 256
+
+    @nn.compact
+    def __call__(self, tokens):
+        x = nn.Embed(self.vocab_size, self.embedding_dim)(tokens.astype(jnp.int32))
+        x = nn.RNN(nn.OptimizedLSTMCell(self.hidden_size))(x)
+        x = nn.RNN(nn.OptimizedLSTMCell(self.hidden_size))(x)
+        return nn.Dense(self.vocab_size)(x[:, -1])
+
+
+class WordLSTM(nn.Module):
+    vocab_size: int = 10000
+    num_oov_buckets: int = 1
+    embedding_size: int = 96
+    latent_size: int = 670
+
+    @nn.compact
+    def __call__(self, tokens):
+        extended = self.vocab_size + 3 + self.num_oov_buckets
+        x = nn.Embed(extended, self.embedding_size)(tokens.astype(jnp.int32))
+        x = nn.RNN(nn.OptimizedLSTMCell(self.latent_size))(x)
+        x = nn.Dense(self.embedding_size)(x[:, -1])
+        return nn.Dense(extended)(x)

@@ -1,0 +1,3 @@
+from feddrift_tpu.parallel.mesh import (  # noqa: F401
+    make_mesh, shard_client_arrays, replicate, client_sharding,
+)

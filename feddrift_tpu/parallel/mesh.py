@@ -1,0 +1,53 @@
+"""Device mesh and sharding layout.
+
+The reference's distribution unit is one MPI process per client with pickled
+point-to-point messages (fedml_core/distributed/communication/mpi/,
+SURVEY.md §2c). The TPU-native equivalent: a ``jax.sharding.Mesh`` whose
+``clients`` axis shards every client-indexed array; aggregation reductions
+lower to XLA all-reduces over ICI (intra-pod) / DCN (multi-host under
+``jax.distributed.initialize``). The model pool and its [M] axis stay
+replicated — M is small (<= concept_num) and every device needs every model.
+
+Sharding layout:
+
+    x, y          [C, T1, N, ...]  -> P('clients', ...)
+    time_w        [M, C, T1]       -> P(None, 'clients')
+    sample_w      [M, C, N]        -> P(None, 'clients')
+    opt_states    [M, C, ...]      -> P(None, 'clients')
+    params        [M, ...]         -> replicated
+
+C need not divide the device count; GSPMD pads internally.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def make_mesh(num_devices: int | None = None, axis_name: str = "clients") -> Mesh:
+    devices = jax.devices()
+    if num_devices is not None:
+        devices = devices[:num_devices]
+    return Mesh(np.asarray(devices), (axis_name,))
+
+
+def client_sharding(mesh: Mesh, rank: int, client_axis: int = 0) -> NamedSharding:
+    """NamedSharding placing ``client_axis`` of a rank-``rank`` array on the
+    clients mesh axis."""
+    spec = [None] * rank
+    spec[client_axis] = "clients"
+    return NamedSharding(mesh, P(*spec))
+
+
+def replicate(mesh: Mesh, tree):
+    sharding = NamedSharding(mesh, P())
+    return jax.device_put(tree, sharding)
+
+
+def shard_client_arrays(mesh: Mesh, tree, client_axis: int = 0):
+    """Shard every leaf of ``tree`` along ``client_axis`` over the mesh."""
+    def put(leaf):
+        return jax.device_put(leaf, client_sharding(mesh, np.ndim(leaf), client_axis))
+    return jax.tree_util.tree_map(put, tree)

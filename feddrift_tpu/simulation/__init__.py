@@ -1,0 +1,1 @@
+from feddrift_tpu.simulation.runner import run_experiment  # noqa: F401

@@ -1,0 +1,194 @@
+"""The experiment driver: the whole time x round loop in one process.
+
+Replaces the reference's shell loop that re-executes an MPI job per time step
+(run_fedavg_distributed_pytorch.sh:49-84, forced by MPI_Abort termination)
+and its server/client manager message loop (SURVEY.md §3.1-3.2). State that
+the reference persists in CWD files between processes (model_params.pt,
+sc_state.pkl, ...) simply lives in memory here; checkpoints are optional
+rather than load-bearing.
+
+Round structure parity:
+  for t in time steps:                  # one reference mpirun
+      algo.begin_iteration(t)           # clustering / drift detection
+      reset per-(m, c) optimizer states # fresh client processes
+      for r in rounds:                  # comm_round
+          train_round (vmap M x C local SGD -> masked weighted FedAvg)
+          algo.after_round              # CFL split / hard-r / Ada LR
+          eval every frequency_of_the_test rounds + last round
+      algo.end_iteration(t)
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from feddrift_tpu.algorithms import make_algorithm
+from feddrift_tpu.config import ExperimentConfig
+from feddrift_tpu.core.pool import ModelPool
+from feddrift_tpu.core.step import TrainStep, make_optimizer
+from feddrift_tpu.data.registry import make_dataset
+from feddrift_tpu.models import create_model
+from feddrift_tpu.parallel.mesh import make_mesh, shard_client_arrays, replicate
+from feddrift_tpu.utils.metrics import MetricsLogger
+from feddrift_tpu.utils.prng import experiment_key, round_key
+
+log = logging.getLogger("feddrift_tpu")
+
+
+def _sample_input(ds) -> jnp.ndarray:
+    x0 = ds.x[0, 0, :2]
+    return jnp.asarray(x0)
+
+
+class Experiment:
+    """Holds the compiled programs + state for one configured run."""
+
+    def __init__(self, cfg: ExperimentConfig, mesh=None,
+                 use_wandb: bool = False, out_dir: Optional[str] = None) -> None:
+        self.cfg = cfg
+        self.ds = make_dataset(cfg)
+        self.module = create_model(cfg.model, self.ds, cfg)
+        self.mesh = mesh if mesh is not None else make_mesh()
+        self.pool = ModelPool.create(self.module, _sample_input(self.ds),
+                                     cfg.num_models, seed=cfg.seed + 42)
+        self.step = TrainStep(
+            apply_fn=lambda p, x: self.module.apply({"params": p}, x),
+            optimizer=make_optimizer(cfg.client_optimizer, cfg.lr, cfg.wd),
+            batch_size=cfg.batch_size,
+            num_steps=cfg.epochs,
+            num_classes=self.ds.num_classes,
+        )
+        # Device-resident dataset, client axis sharded over the mesh. The
+        # client axis is padded to a multiple of the mesh size with phantom
+        # clients whose time weights stay zero — they train masked and
+        # contribute n=0 to aggregation, so results are identical.
+        n_dev = self.mesh.devices.size
+        C = cfg.client_num_in_total
+        self.C_pad = ((C + n_dev - 1) // n_dev) * n_dev
+        pad = self.C_pad - C
+        x_np, y_np = self.ds.x, self.ds.y
+        if pad:
+            x_np = np.concatenate([x_np, np.repeat(x_np[:1], pad, 0)], axis=0)
+            y_np = np.concatenate([y_np, np.repeat(y_np[:1], pad, 0)], axis=0)
+        self.x = shard_client_arrays(self.mesh, jnp.asarray(x_np))
+        self.y = shard_client_arrays(self.mesh, jnp.asarray(y_np))
+        self.algo = make_algorithm(cfg, self.ds, self.pool, self.step)
+        self.logger = MetricsLogger(out_dir, use_wandb)
+        self.key = experiment_key(cfg.seed)
+        self.global_round = 0
+
+    # ------------------------------------------------------------------
+    def evaluate(self, t: int, round_idx: int) -> dict:
+        """Reference ``test_on_all_clients`` (AggregatorSoftCluster.py:210-285):
+        per-client train acc on step t with that client's plurality model, and
+        test acc on step t+1 data (temporal holdout); AUE/KUE use ensemble
+        votes instead (FedAvgEnsAggregatorAue.py:256-283, Kue:234-262)."""
+        cfg = self.cfg
+        C = self.C_
+        xt, yt = self.x[:, t], self.y[:, t]
+        xtest, ytest = self.x[:, t + 1], self.y[:, t + 1]
+        fm = self.algo.round_inputs(t, round_idx)[2]
+
+        correct, loss_sum, total = self.step.acc_matrix(self.pool.params, xt, yt, fm)
+        correct = np.asarray(correct)[:, :C]
+        loss_sum = np.asarray(loss_sum)[:, :C]
+        total = np.asarray(total)[:C]
+
+        idx = self.algo.test_model_idx(t)                      # [C]
+        cr = np.arange(self.C_)
+        train_correct = correct[idx, cr]
+        train_loss = loss_sum[idx, cr]
+
+        spec = self.algo.ensemble_spec(t)
+        if spec is None:
+            tcorrect, tloss_sum, ttotal = self.step.acc_matrix(
+                self.pool.params, xtest, ytest, fm)
+            tcorrect = np.asarray(tcorrect)[:, :C][idx, cr]
+            tloss = np.asarray(tloss_sum)[:, :C][idx, cr]
+            ttotal = np.asarray(ttotal)[:C]
+        else:
+            ew = jnp.asarray(spec.weights, jnp.float32)
+            if ew.ndim == 2:  # per-client weights (AUE-PC): pad phantom clients
+                ew = self._pad_clients(ew)
+            ec, et, el = self.step.ensemble_eval(
+                self.pool.params, xtest, ytest, ew, spec.mode,
+                None if spec.model_mask is None
+                else jnp.asarray(spec.model_mask, jnp.float32),
+                fm)
+            tcorrect = np.asarray(ec)[:C]
+            ttotal = np.asarray(et)[:C]
+            tloss = np.asarray(el)[:C]
+
+        metrics = {
+            "round": self.global_round,
+            "iteration": t,
+            "Train/Acc": float(train_correct.sum() / total.sum()),
+            "Train/Loss": float(train_loss.sum() / total.sum()),
+            "Test/Acc": float(tcorrect.sum() / ttotal.sum()),
+            "Test/Loss": float(tloss.sum() / ttotal.sum()),
+        }
+        if cfg.report_client:
+            for c in range(self.C_):
+                metrics[f"Train/Acc-CL-{c}"] = float(train_correct[c] / total[c])
+                metrics[f"Test/Acc-CL-{c}"] = float(tcorrect[c] / ttotal[c])
+                metrics[f"Plurality/CL-{c}"] = int(idx[c])
+        self.logger.log(metrics)
+        return metrics
+
+    @property
+    def C_(self) -> int:
+        return self.cfg.client_num_in_total
+
+    def _pad_clients(self, arr: jnp.ndarray, axis: int = 1,
+                     value: float = 0.0) -> jnp.ndarray:
+        """Pad a client-indexed array up to C_pad along ``axis``."""
+        pad = self.C_pad - arr.shape[axis]
+        if pad == 0:
+            return arr
+        widths = [(0, 0)] * arr.ndim
+        widths[axis] = (0, pad)
+        return jnp.pad(arr, widths, constant_values=value)
+
+    # ------------------------------------------------------------------
+    def run_iteration(self, t: int) -> None:
+        cfg = self.cfg
+        t0 = time.time()
+        self.algo.begin_iteration(t)
+        opt_states = self.step.init_opt_states(
+            self.pool.params, self.pool.num_models, self.C_pad)
+
+        for r in range(cfg.comm_round):
+            tw, sw, fm, lr_scale = self.algo.round_inputs(t, r)
+            tw = self._pad_clients(tw)                  # phantom clients: w=0
+            sw = self._pad_clients(sw, value=1.0)
+            prev_params = self.pool.params
+            new_params, opt_states, client_params, n, losses = self.step.train_round(
+                prev_params, opt_states, round_key(self.key, t, r),
+                self.x, self.y, tw, sw, fm, lr_scale)
+            self.pool.params = self.algo.after_round(
+                t, r, prev_params, new_params, client_params, n)
+            if r % cfg.frequency_of_the_test == 0 or r == cfg.comm_round - 1:
+                self.evaluate(t, r)
+            self.global_round += 1
+
+        self.algo.end_iteration(t)
+        log.info("iteration %d done in %.1fs (Test/Acc=%.4f)", t,
+                 time.time() - t0, self.logger.last("Test/Acc", -1))
+
+    def run(self) -> MetricsLogger:
+        for t in range(self.cfg.train_iterations):
+            self.run_iteration(t)
+        return self.logger
+
+
+def run_experiment(cfg: ExperimentConfig, mesh=None, use_wandb: bool = False,
+                   out_dir: Optional[str] = None) -> Experiment:
+    exp = Experiment(cfg, mesh=mesh, use_wandb=use_wandb, out_dir=out_dir)
+    exp.run()
+    return exp

@@ -1,0 +1,62 @@
+"""Metrics logging with reference-compatible series names.
+
+The reference's system of record is wandb (Train/Acc, Test/Acc, Train/Loss,
+Test/Loss, per-client *-CL-{c}, Plurality/CL-{c}, summary num_models /
+local_models / Contribute/CL-{c} / Merge — see SURVEY.md §5). Here the same
+names flow to an in-memory history plus an optional JSONL file, so runs are
+diffable against reference wandb exports. wandb itself is attached if
+importable and enabled (zero-egress environments simply skip it).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Any
+
+
+class MetricsLogger:
+    def __init__(self, out_dir: str | None = None, use_wandb: bool = False) -> None:
+        self.history: list[dict[str, Any]] = []
+        self.summary: dict[str, Any] = {}
+        self._fh = None
+        if out_dir:
+            os.makedirs(out_dir, exist_ok=True)
+            self._fh = open(os.path.join(out_dir, "metrics.jsonl"), "a")
+        self._wandb = None
+        if use_wandb:
+            try:
+                import wandb  # type: ignore
+                if wandb.run is not None:
+                    self._wandb = wandb
+            except ImportError:
+                pass
+
+    def log(self, metrics: dict[str, Any]) -> None:
+        rec = {"_ts": time.time(), **metrics}
+        self.history.append(rec)
+        if self._fh:
+            self._fh.write(json.dumps(rec) + "\n")
+            self._fh.flush()
+        if self._wandb:
+            self._wandb.log(metrics)
+
+    def set_summary(self, key: str, value: Any) -> None:
+        self.summary[key] = value
+        if self._wandb:
+            self._wandb.run.summary[key] = value
+
+    def series(self, name: str) -> list[tuple[int, Any]]:
+        """(round, value) pairs for one metric name."""
+        return [(r.get("round", i), r[name])
+                for i, r in enumerate(self.history) if name in r]
+
+    def last(self, name: str, default=None):
+        s = self.series(name)
+        return s[-1][1] if s else default
+
+    def close(self) -> None:
+        if self._fh:
+            self._fh.close()
+            self._fh = None

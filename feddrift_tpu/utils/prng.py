@@ -1,0 +1,25 @@
+"""Deterministic PRNG stream derivation.
+
+The reference's reproducibility rests on seeding np/torch/random per run
+(main_fedavg.py:292-298) plus round-seeded client sampling
+(AggregatorSoftCluster.py:197-205). Bitwise parity with torch RNG is
+impossible; instead every consumer gets a key derived by folding structured
+coordinates into the experiment seed, so runs are bitwise-reproducible within
+this framework and independent across (time step, round, purpose).
+"""
+
+from __future__ import annotations
+
+import jax
+
+PURPOSES = {"train": 0, "sample": 1, "init": 2, "algo": 3}
+
+
+def experiment_key(seed: int) -> jax.Array:
+    return jax.random.PRNGKey(seed)
+
+
+def round_key(seed_key: jax.Array, t: int, r: int, purpose: str = "train") -> jax.Array:
+    k = jax.random.fold_in(seed_key, PURPOSES[purpose])
+    k = jax.random.fold_in(k, t)
+    return jax.random.fold_in(k, r)

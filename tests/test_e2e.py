@@ -1,0 +1,56 @@
+"""End-to-end simulation tests on the 8-device CPU mesh.
+
+Mirrors the reference's reproducibility-as-testing stance (SURVEY.md §4):
+fixed seeds, assert accuracy trajectories.
+"""
+
+import numpy as np
+import pytest
+
+from feddrift_tpu.config import ExperimentConfig
+from feddrift_tpu.simulation.runner import run_experiment
+
+
+def _cfg(**kw):
+    base = dict(dataset="sine", model="fnn", concept_drift_algo="win-1",
+                train_iterations=2, comm_round=16, epochs=5, sample_num=100,
+                batch_size=50, frequency_of_the_test=5, lr=0.05,
+                client_num_in_total=10, client_num_per_round=10, seed=0)
+    base.update(kw)
+    return ExperimentConfig(**base)
+
+
+class TestEndToEnd:
+    def test_win1_learns_sine(self):
+        exp = run_experiment(_cfg())
+        accs = dict(exp.logger.series("Test/Acc"))
+        # end of iteration 0 (round 15): model must beat chance solidly
+        assert accs[15] > 0.8, accs
+
+    def test_drift_hurts_oblivious_baseline(self):
+        exp = run_experiment(_cfg(train_iterations=3, comm_round=12))
+        accs = exp.logger.series("Test/Acc")
+        by_round = dict(accs)
+        # test at iteration 2 covers step-3 data where half the clients have
+        # flipped concepts (preset A) -> win-1 single model falls toward 0.5
+        assert by_round[35] < 0.75, by_round
+
+    def test_determinism(self):
+        a = run_experiment(_cfg()).logger.series("Test/Acc")
+        b = run_experiment(_cfg()).logger.series("Test/Acc")
+        assert a == b
+
+    def test_all_retrain_all_data(self):
+        exp = run_experiment(_cfg(concept_drift_algo="all", comm_round=10))
+        assert exp.logger.last("Test/Acc") > 0.7
+
+    def test_recency_exp(self):
+        exp = run_experiment(_cfg(concept_drift_algo="exp", comm_round=10))
+        assert exp.logger.last("Test/Acc") > 0.6
+
+    def test_metrics_names_reference_compatible(self):
+        exp = run_experiment(_cfg(comm_round=6))
+        rec = exp.logger.history[-1]
+        for key in ("Train/Acc", "Train/Loss", "Test/Acc", "Test/Loss",
+                    "Train/Acc-CL-0", "Test/Acc-CL-9", "Plurality/CL-0"):
+            assert key in rec, key

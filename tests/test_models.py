@@ -1,0 +1,98 @@
+"""Model zoo and pool tests."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from feddrift_tpu.core.pool import ModelPool
+from feddrift_tpu.models import create_model, available_models
+from feddrift_tpu.config import ExperimentConfig
+from feddrift_tpu.data.registry import make_dataset
+
+
+def _ds(name="sea", **kw):
+    cfg = ExperimentConfig(dataset=name, train_iterations=2, sample_num=16, **kw)
+    return make_dataset(cfg), cfg
+
+
+class TestModels:
+    @pytest.mark.parametrize("name,dataset,xshape", [
+        ("lr", "sea", (4, 3)),
+        ("fnn", "sea", (4, 3)),
+        ("cnn", "MNIST", (4, 784)),
+        ("resnet20", "cifar10", (4, 32, 32, 3)),
+    ])
+    def test_forward_shapes(self, name, dataset, xshape):
+        ds, cfg = _ds(dataset)
+        mod = create_model(name, ds, cfg)
+        x = jnp.zeros(xshape, jnp.float32)
+        params = mod.init(jax.random.PRNGKey(0), x)["params"]
+        out = mod.apply({"params": params}, x)
+        assert out.shape == (4, ds.num_classes)
+
+    def test_rnn_forward(self):
+        ds, cfg = _ds("shakespeare")
+        mod = create_model("rnn", ds, cfg)
+        x = jnp.zeros((2, 80), jnp.int32)
+        params = mod.init(jax.random.PRNGKey(0), x)["params"]
+        out = mod.apply({"params": params}, x)
+        assert out.shape == (2, 90)
+
+    def test_unknown_model(self):
+        ds, cfg = _ds()
+        with pytest.raises(KeyError):
+            create_model("transformer9000", ds, cfg)
+
+    def test_registry_nonempty(self):
+        assert {"lr", "fnn", "cnn", "resnet", "rnn"} <= set(available_models())
+
+
+class TestModelPool:
+    def _pool(self, M=3):
+        ds, cfg = _ds()
+        mod = create_model("fnn", ds, cfg)
+        return ModelPool.create(mod, jnp.zeros((2, 3)), M, seed=7)
+
+    def test_identical_init(self):
+        pool = self._pool()
+        # reference parity: all models reinitialized with the same fixed seed
+        for leaf in jax.tree_util.tree_leaves(pool.params):
+            assert np.allclose(leaf[0], leaf[1]) and np.allclose(leaf[1], leaf[2])
+
+    def test_reinit_restores(self):
+        pool = self._pool()
+        perturbed = jax.tree_util.tree_map(lambda p: p + 1.0, pool.slot(1))
+        pool.set_slot(1, perturbed)
+        assert not np.allclose(
+            jax.tree_util.tree_leaves(pool.slot(1))[0],
+            jax.tree_util.tree_leaves(pool.slot(0))[0])
+        pool.reinit_slot(1)
+        for a, b in zip(jax.tree_util.tree_leaves(pool.slot(1)),
+                        jax.tree_util.tree_leaves(pool.init_params)):
+            assert np.allclose(a, b)
+
+    def test_merge(self):
+        pool = self._pool()
+        pool.set_slot(0, jax.tree_util.tree_map(lambda p: p * 0 + 1.0, pool.slot(0)))
+        pool.set_slot(1, jax.tree_util.tree_map(lambda p: p * 0 + 3.0, pool.slot(1)))
+        pool.merge_slots(0, 1, w1=0.25, w2=0.75)
+        merged = jax.tree_util.tree_leaves(pool.slot(0))[0]
+        assert np.allclose(merged, 2.5)
+        # second model reset to init
+        for a, b in zip(jax.tree_util.tree_leaves(pool.slot(1)),
+                        jax.tree_util.tree_leaves(pool.init_params)):
+            assert np.allclose(a, b)
+
+    def test_distinct_reinit(self):
+        pool = self._pool()
+        pool.distinct_reinit_slot(2, seed=123)
+        a = jax.tree_util.tree_leaves(pool.slot(0))[-1]
+        b = jax.tree_util.tree_leaves(pool.slot(2))[-1]
+        assert not np.allclose(a, b)
+
+    def test_copy_slot(self):
+        pool = self._pool()
+        pool.set_slot(0, jax.tree_util.tree_map(lambda p: p * 0 + 5.0, pool.slot(0)))
+        pool.copy_slot(2, 0)
+        assert np.allclose(jax.tree_util.tree_leaves(pool.slot(2))[0], 5.0)

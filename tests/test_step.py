@@ -1,0 +1,130 @@
+"""TrainStep unit tests: masking, aggregation math, batched eval."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from feddrift_tpu.config import ExperimentConfig
+from feddrift_tpu.core.pool import ModelPool
+from feddrift_tpu.core.step import TrainStep, make_optimizer
+from feddrift_tpu.data.registry import make_dataset
+from feddrift_tpu.models import create_model
+
+
+def _setup(M=3, C=4, T=3, N=40, B=20):
+    cfg = ExperimentConfig(dataset="sine", train_iterations=T, sample_num=N,
+                           batch_size=B, epochs=4, client_num_in_total=C,
+                           client_num_per_round=C, lr=0.05)
+    ds = make_dataset(cfg)
+    mod = create_model("fnn", ds, cfg)
+    pool = ModelPool.create(mod, jnp.zeros((2, 2)), M, seed=1)
+    step = TrainStep(pool.apply, make_optimizer("adam", cfg.lr, cfg.wd),
+                     B, cfg.epochs, ds.num_classes)
+    x, y = jnp.asarray(ds.x), jnp.asarray(ds.y)
+    opt = step.init_opt_states(pool.params, M, C)
+    sw = jnp.ones((M, C, N), jnp.float32)
+    fm = jnp.ones((M, 2), jnp.float32)
+    return cfg, ds, pool, step, x, y, opt, sw, fm
+
+
+def _leafdiff(a, b):
+    return sum(float(jnp.abs(la - lb).sum())
+               for la, lb in zip(jax.tree_util.tree_leaves(a),
+                                 jax.tree_util.tree_leaves(b)))
+
+
+class TestTrainRound:
+    def test_unused_models_untouched(self):
+        cfg, ds, pool, step, x, y, opt, sw, fm = _setup()
+        tw = np.zeros((3, 4, 4), np.float32)
+        tw[0, :, 0] = 1.0          # only model 0 trains
+        newp, _, _, n, _ = step.train_round(
+            pool.params, opt, jax.random.PRNGKey(0), x, y,
+            jnp.asarray(tw), sw, fm, jnp.float32(1.0))
+        n = np.asarray(n)
+        assert (n[0] == 40).all() and (n[1:] == 0).all()
+        assert _leafdiff(jax.tree_util.tree_map(lambda p: p[0], newp),
+                         jax.tree_util.tree_map(lambda p: p[0], pool.params)) > 0
+        for m in (1, 2):
+            assert _leafdiff(jax.tree_util.tree_map(lambda p: p[m], newp),
+                             jax.tree_util.tree_map(lambda p: p[m], pool.params)) == 0
+
+    def test_per_client_zero_weight_masked(self):
+        cfg, ds, pool, step, x, y, opt, sw, fm = _setup()
+        tw = np.zeros((3, 4, 4), np.float32)
+        tw[0, :2, 0] = 1.0         # model 0: only clients 0, 1 participate
+        newp, _, client_params, n, _ = step.train_round(
+            pool.params, opt, jax.random.PRNGKey(0), x, y,
+            jnp.asarray(tw), sw, fm, jnp.float32(1.0))
+        n = np.asarray(n)
+        assert (n[0, :2] == 40).all() and (n[0, 2:] == 0).all()
+        # non-participating clients' local params remain the broadcast globals
+        cp0 = jax.tree_util.tree_leaves(client_params)[0]
+        p0 = jax.tree_util.tree_leaves(pool.params)[0]
+        assert np.allclose(cp0[0, 2], p0[0]) and np.allclose(cp0[0, 3], p0[0])
+
+    def test_aggregation_is_weighted_mean(self):
+        cfg, ds, pool, step, x, y, opt, sw, fm = _setup()
+        tw = np.zeros((3, 4, 4), np.float32)
+        tw[0, 0, :2] = 1.0         # client 0 trains on steps 0+1 (n=80)
+        tw[0, 1, 0] = 1.0          # client 1 trains on step 0    (n=40)
+        newp, _, client_params, n, _ = step.train_round(
+            pool.params, opt, jax.random.PRNGKey(1), x, y,
+            jnp.asarray(tw), sw, fm, jnp.float32(1.0))
+        n = np.asarray(n)
+        assert n[0, 0] == 80 and n[0, 1] == 40
+        for la, lc in zip(jax.tree_util.tree_leaves(newp),
+                          jax.tree_util.tree_leaves(client_params)):
+            manual = (lc[0, 0] * 80 + lc[0, 1] * 40) / 120
+            assert np.allclose(la[0], manual, atol=1e-5)
+
+    def test_determinism(self):
+        cfg, ds, pool, step, x, y, opt, sw, fm = _setup()
+        tw = jnp.ones((3, 4, 4), jnp.float32)
+        a = step.train_round(pool.params, opt, jax.random.PRNGKey(3), x, y,
+                             tw, sw, fm, jnp.float32(1.0))[0]
+        b = step.train_round(pool.params, opt, jax.random.PRNGKey(3), x, y,
+                             tw, sw, fm, jnp.float32(1.0))[0]
+        assert _leafdiff(a, b) == 0
+
+    def test_lr_scale_zero_freezes(self):
+        cfg, ds, pool, step, x, y, opt, sw, fm = _setup()
+        tw = jnp.ones((3, 4, 4), jnp.float32)
+        newp, _, _, _, _ = step.train_round(
+            pool.params, opt, jax.random.PRNGKey(0), x, y, tw, sw, fm,
+            jnp.float32(0.0))
+        assert _leafdiff(newp, pool.params) == 0
+
+    def test_feature_mask_blocks_features(self):
+        cfg, ds, pool, step, x, y, opt, sw, fm = _setup()
+        # masking all features: inputs become 0; training still runs
+        fm0 = jnp.zeros((3, 2), jnp.float32)
+        tw = jnp.ones((3, 4, 4), jnp.float32)
+        newp, *_ = step.train_round(pool.params, opt, jax.random.PRNGKey(0),
+                                    x, y, tw, sw, fm0, jnp.float32(1.0))
+        assert np.isfinite(jax.tree_util.tree_leaves(newp)[0]).all()
+
+
+class TestEval:
+    def test_acc_matrix_matches_manual(self):
+        cfg, ds, pool, step, x, y, opt, sw, fm = _setup()
+        correct, loss_sum, total = step.acc_matrix(pool.params, x[:, 0], y[:, 0], fm)
+        m0 = pool.slot(0)
+        logits = pool.apply(m0, x[0, 0])
+        manual = int((jnp.argmax(logits, -1) == y[0, 0]).sum())
+        assert int(correct[0, 0]) == manual
+        assert int(total[0]) == 40
+
+    def test_ensemble_hard_single_model_equals_plain(self):
+        cfg, ds, pool, step, x, y, opt, sw, fm = _setup()
+        w = jnp.asarray([1.0, 0.0, 0.0])
+        ec, et, el = step.ensemble_eval(pool.params, x[:, 0], y[:, 0], w, "hard")
+        correct, _, _ = step.acc_matrix(pool.params, x[:, 0], y[:, 0], fm)
+        assert np.array_equal(np.asarray(ec), np.asarray(correct[0]))
+        assert np.isfinite(np.asarray(el)).all()
+
+    def test_confusion_matrix_sums(self):
+        cfg, ds, pool, step, x, y, opt, sw, fm = _setup()
+        cm = step.confusion_matrices(pool.params, x[:, 0], y[:, 0], fm)
+        assert cm.shape == (3, 4, 2, 2)
+        assert np.allclose(np.asarray(cm).sum(axis=(-1, -2)), 40)
