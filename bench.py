@@ -1,0 +1,81 @@
+"""Benchmark: FedDrift canonical config throughput on real hardware.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Config: the reference's canonical run (README.md:46-50): SEA-4, 10 clients,
+fnn, 200 rounds x 5 local steps per time step, batch 500, lr 0.01, 500
+samples/client/step. We measure steady-state communication-round throughput
+(train_round + the periodic eval), which is the quantity the reference logs
+per round ("aggregate time cost", FedAvgEnsAggregatorSoftCluster.py:193-194).
+
+Baseline: the reference publishes no numbers (BASELINE.md). Its round time is
+bounded below by its 0.3 s communication polling alone
+(mpi_send_thread.py:29, com_manager.py:78) plus pickling M state_dicts per
+client and serial M x C evaluation; we take 1.0 rounds/s as a *generous*
+reference estimate on its 4-GPU setup, and report vs_baseline against it.
+Run with --smoke for a fast CI-sized check.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+
+REFERENCE_ROUNDS_PER_SEC = 1.0  # generous estimate; see module docstring
+
+
+def main() -> None:
+    smoke = "--smoke" in sys.argv
+
+    from feddrift_tpu.config import ExperimentConfig
+    from feddrift_tpu.simulation.runner import Experiment
+
+    algo = "softcluster"
+    from feddrift_tpu.algorithms import available_algorithms
+    if "softcluster" not in available_algorithms():
+        algo = "win-1"   # pre-softcluster fallback
+
+    cfg = ExperimentConfig(
+        dataset="sea", model="fnn", concept_drift_algo=algo,
+        concept_drift_algo_arg="H_A_C_1_10_0", concept_num=4,
+        change_points="A",
+        client_num_in_total=10, client_num_per_round=10,
+        train_iterations=3 if smoke else 10,
+        comm_round=20 if smoke else 200,
+        epochs=5, batch_size=500, sample_num=100 if smoke else 500,
+        lr=0.01, frequency_of_the_test=10,
+        report_client=0,
+    )
+    exp = Experiment(cfg)
+
+    # Warm-up: run time step 0 fully (compiles every program variant).
+    exp.run_iteration(0)
+
+    # Timed steady state: the remaining time steps.
+    t0 = time.time()
+    for t in range(1, cfg.train_iterations):
+        exp.run_iteration(t)
+    jax.block_until_ready(exp.pool.params)
+    elapsed = time.time() - t0
+    rounds = cfg.comm_round * (cfg.train_iterations - 1)
+    rps = rounds / elapsed
+
+    final_acc = exp.logger.last("Test/Acc")
+    print(json.dumps({
+        "metric": f"FedDrift SEA-4 round throughput ({algo}, 10 clients, "
+                  f"M=4, fnn, batch 500)",
+        "value": round(rps, 3),
+        "unit": "rounds/s",
+        "vs_baseline": round(rps / REFERENCE_ROUNDS_PER_SEC, 3),
+        "final_test_acc": round(float(final_acc), 4),
+        "wall_s": round(elapsed, 2),
+        "rounds": rounds,
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -76,6 +76,34 @@ class DriftAlgorithm:
         self._ones_feat_mask = jnp.ones((self.M, *ds.feature_shape), jnp.float32) \
             if not ds.is_sequence else jnp.ones((self.M, 1), jnp.float32)
 
+    # -- runtime binding ------------------------------------------------
+    def bind(self, x, y, logger, c_pad: int) -> None:
+        """Called by the runner after construction: device-resident dataset
+        (client axis padded to c_pad), and the metrics logger. Algorithms
+        slice device results back to [:C] before host-side decisions."""
+        self.x = x
+        self.y = y
+        self.logger = logger
+        self.C_pad = c_pad
+
+    def acc_matrix_at(self, t: int, feat_mask=None) -> np.ndarray:
+        """[M, C] accuracy of every model on every client's step-t data
+        (reference train_acc_matrix, FedAvgEnsDataLoader.py:1074-1085)."""
+        fm = feat_mask if feat_mask is not None else self._ones_feat_mask
+        correct, _, total = self.step.acc_matrix(
+            self.pool.params, self.x[:, t], self.y[:, t], fm)
+        return np.asarray(correct)[:, :self.C] / np.asarray(total)[None, :self.C]
+
+    def acc_cells_upto(self, t: int, feat_mask=None) -> np.ndarray:
+        """[M, C, t+1] correct counts per (model, client, step<=t).
+
+        Evaluates the full [T1] axis (static shape -> one compile) and slices
+        on host; the extra cells are cheap relative to a recompilation per t.
+        """
+        fm = feat_mask if feat_mask is not None else self._ones_feat_mask
+        correct = self.step.acc_cells(self.pool.params, self.x, self.y, fm)
+        return np.asarray(correct)[:, :self.C, : t + 1]
+
     # -- hooks ----------------------------------------------------------
     def begin_iteration(self, t: int) -> None:
         raise NotImplementedError

@@ -1,0 +1,487 @@
+"""The SoftCluster family: FedDrift, FedDrift-Eager, IFCA, CFL, GMM, softmax,
+oracle — the reference's multi-model clustering heart.
+
+Re-design of ``SoftClusterState`` + ``FedAvgEnsAggregatorSoftCluster``
+(fedml_api/distributed/fedavg_ens/FedAvgEnsDataLoader.py:581-1341,
+FedAvgEnsAggregatorSoftCluster.py). The time-indexed weight dict
+``{t -> M x C}`` becomes a dense ``[T1, M, C]`` float tensor; device work
+(accuracy matrices/cells) is batched XLA; the clustering decisions
+(drift detection, LRU model pool, hierarchical merge, CFL bipartition) stay
+host-side numpy/scipy on O(M^2) matrices — exactly the split SURVEY.md §7
+prescribes.
+
+Variant dispatch mirrors the reference (AggregatorSoftCluster.init_sc_state
+:46-118 + SoftClusterState.cluster :640-658):
+
+  cluster_alg 'H_*'     -> FedDrift hierarchical (cluster_hierarchical :840-978)
+  'mmacc*'              -> FedDrift-Eager (cluster_mmacc2 :796-837)
+  'hard' / 'hard-r'     -> IFCA; '-r' re-clusters every round (:187-191)
+  'softmax_{alpha}'     -> softmax weights over accuracies (:680-682)
+  'gmm'                 -> 2-component GaussianMixture (:782-794)
+  'geni'                -> change-point oracle (:1141-1146)
+  'cfl_{gamma}_{rt}'    -> clustered-FL gradient bipartition (:1159-1249)
+
+concept_drift_algo variants: 'softclusterwin-1' zeroes weights of past steps
+(:102-104, :1263-1265); 'softclusterreset' deletes non-competitive models
+(:85-97).
+"""
+
+from __future__ import annotations
+
+import logging
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import scipy.cluster.hierarchy as sch
+from scipy.spatial.distance import squareform
+from scipy.special import softmax as sp_softmax
+
+from feddrift_tpu.algorithms.base import DriftAlgorithm, register_algorithm
+
+log = logging.getLogger("feddrift_tpu.softcluster")
+
+
+@register_algorithm("softcluster", "softclusterwin-1", "softclusterreset")
+class SoftCluster(DriftAlgorithm):
+    name = "softcluster"
+
+    def __init__(self, cfg, ds, pool, step) -> None:
+        super().__init__(cfg, ds, pool, step)
+        p = cfg.algo_params()
+        self.kind = p["kind"]
+        self.p = p
+        # dense [T1, M, C] replaces the reference's {t -> M x C} dict (:589)
+        self.weights = np.zeros((self.T1, self.M, self.C), dtype=np.float32)
+        self.mmacc_acc = np.zeros(self.C)           # per-client last best acc
+        self.mmacc_delta = p.get("mmacc_delta", p.get("h_delta", 0.1))
+        # FedDrift hierarchical state (:598-606)
+        self.h_delta = p.get("h_delta", 0.1)
+        self.h_deltap = p.get("h_deltap", 0.1)
+        self.h_w = p.get("h_w", 1)
+        self.h_distance = p.get("h_distance", "A")
+        self.h_cluster = p.get("h_cluster", "C")
+        self.h_marked: dict[int, tuple[int, int]] = {}   # client -> (model, unmark t)
+        self.h_next_free = 1
+        # CFL state (:608-612)
+        self.cfl_gamma = p.get("cfl_gamma", 0.1)
+        self.cfl_retrain = p.get("cfl_retrain", "win-1")
+        self.cfl_norm = 0.0
+        self.cfl_eps1 = 0.0
+        self.cfl_eps2 = 1e4
+        # geni oracle: the dataset's own ground-truth concept matrix (already
+        # time-stretch dilated), so the oracle can never diverge from the
+        # generated drift — incl. change_points='rand'
+        if self.kind == "geni":
+            self.geni_concepts = ds.concepts[:, : self.C]
+        self.rng = np.random.default_rng(cfg.seed + 1009)
+        self._tw = None
+
+    # ------------------------------------------------------------------
+    # plumbing
+    def _models_in_use_before(self, t: int, exclude_marked: bool = False) -> list[int]:
+        """Models with any weight before step t (reference :686-690, :855-859)."""
+        marked = {m for (m, _) in self.h_marked.values()} if exclude_marked else set()
+        used = []
+        for m in range(self.M):
+            if (self.weights[:t, m, :] > 0).any() and m not in marked:
+                used.append(m)
+        return used
+
+    def _sync_device_weights(self) -> None:
+        # [T1, M, C] -> [M, C, T1] for the train step
+        self._tw = jnp.asarray(np.transpose(self.weights, (1, 2, 0)))
+
+    def round_inputs(self, t: int, r: int):
+        return self._tw, self._ones_sample_w, self._ones_feat_mask, jnp.float32(1.0)
+
+    def test_model_idx(self, t: int) -> np.ndarray:
+        return np.argmax(self.weights[t], axis=0)        # (:1257-1258)
+
+    # ------------------------------------------------------------------
+    # life cycle
+    def begin_iteration(self, t: int) -> None:
+        acc_t = None   # cache: the [M, C] acc matrix at step t, if computed
+        if t == 0:
+            self._cluster_init()
+            if self.kind in ("hard", "hard-r"):
+                # IFCA symmetry breaking: distinct random models at t=0
+                # (AggregatorSoftCluster.py:64-71)
+                for m in range(self.M):
+                    self.pool.distinct_reinit_slot(m, seed=self.cfg.seed + 7700 + m)
+                acc_t = self.acc_matrix_at(0)
+                self._cluster(acc_t, 0, round_idx=0)
+        else:
+            if self.kind == "hierarchical":
+                self._cluster_hierarchical(t)
+            elif self.kind == "mmacc":
+                self._cluster_mmacc2(t)
+            elif self.kind == "cfl":
+                self._cluster_cfl_init(t)
+            elif self.kind in ("hard", "hard-r"):
+                # reference 'hard' branch: cluster only, never combined with
+                # the reset variant (AggregatorSoftCluster.py:64-71)
+                self._cluster(self.acc_matrix_at(t), t, round_idx=0)
+            else:
+                # the reference's final else branch (:78-100): reset variant
+                # applies only here
+                if self.cfg.concept_drift_algo == "softclusterreset":
+                    self._reset_noncompetitive(t)
+                self._cluster(self.acc_matrix_at(t), t, round_idx=0)
+
+        if self.cfg.concept_drift_algo == "softclusterwin-1":
+            self.weights[:t] = 0.0                       # (:1263-1265)
+
+        if t == 0:
+            # arm the drift detector with initial accuracies (:106-116)
+            acc = acc_t if acc_t is not None else self.acc_matrix_at(0)
+            idx = self.test_model_idx(0)
+            for c in range(self.C):
+                self.mmacc_acc[c] = acc[idx[c], c]
+        self._log_models(t)
+        self._sync_device_weights()
+
+    def after_round(self, t: int, r: int, prev_params, agg_params,
+                    client_params, n):
+        if self.kind == "cfl":
+            did_split = self._cluster_cfl_round(t, r + 1, prev_params,
+                                                client_params, n)
+            if did_split:
+                # skip this round's aggregation: local updates correspond to
+                # an outdated model assignment (AggregatorSoftCluster.py:140-146)
+                self._sync_device_weights()
+                return self.pool.params
+        self.pool.params = agg_params
+        if self.kind == "hard-r":
+            # re-cluster every round (:187-191)
+            self._cluster(self.acc_matrix_at(t), t, round_idx=r + 1)
+            self._sync_device_weights()
+        return self.pool.params
+
+    # ------------------------------------------------------------------
+    # clustering variants
+    def _cluster_init(self) -> None:
+        """Everyone on model 0 — or one model per client for FedDrift-F
+        (cluster_init, :616-638)."""
+        self.weights[0] = 0.0
+        if self.h_cluster == "F" and self.kind == "hierarchical":
+            if self.M < self.C:
+                raise ValueError(
+                    f"h_cluster='F' needs concept_num >= clients ({self.M} < {self.C})")
+            for c in range(self.C):
+                self.weights[0, c, c] = 1.0
+            self.h_next_free = self.C
+        else:
+            self.weights[0, 0, :] = 1.0
+
+    def _cluster(self, acc: np.ndarray, t: int, round_idx: int) -> None:
+        """Per-round-capable variants (SoftClusterState.cluster, :640-658)."""
+        if self.kind in ("hard", "hard-r"):
+            self.weights[t] = 0.0
+            best = np.argmax(acc, axis=0)
+            self.weights[t, best, np.arange(self.C)] = 1.0
+        elif self.kind == "softmax":
+            alpha = self.p.get("softmax_alpha", 0)
+            self.weights[t] = sp_softmax(acc * (2**alpha), axis=0)
+        elif self.kind == "gmm":
+            self._cluster_gmm(acc, t)
+        elif self.kind == "geni":
+            if round_idx == 0:
+                self.weights[t] = 0.0
+                best = self.geni_concepts[t] % self.M
+                self.weights[t, best, np.arange(self.C)] = 1.0
+        else:
+            raise NameError(self.kind)
+
+    def _cluster_gmm(self, acc: np.ndarray, t: int) -> None:
+        from sklearn.mixture import GaussianMixture       # (:782-794)
+        self.weights[t] = 0.0
+        gm = GaussianMixture(n_components=2, random_state=0).fit(acc.T)
+        probs = gm.predict_proba(acc.T).T
+        if gm.means_[0][0] > gm.means_[0][1]:
+            self.weights[t, 0], self.weights[t, 1] = probs[0], probs[1]
+        else:
+            self.weights[t, 0], self.weights[t, 1] = probs[1], probs[0]
+
+    # -- FedDrift-Eager -------------------------------------------------
+    def _cluster_mmacc2(self, t: int) -> None:
+        """Drift detect + at most one new model per step, no merge
+        (cluster_mmacc2, :796-837)."""
+        acc = self.acc_matrix_at(t)
+        in_use = self._models_in_use_before(t)
+        self.weights[t] = 0.0
+        best_rows = np.argmax(acc[in_use], axis=0)
+        best = np.asarray(in_use)[best_rows]
+        self.weights[t, best, np.arange(self.C)] = 1.0
+
+        next_free = -42
+        for c in range(self.C):
+            newest_acc = acc[best[c], c]
+            if self.mmacc_acc[c] - newest_acc > self.mmacc_delta:
+                if next_free == -42:
+                    next_free = self._find_unused_model_lru(t, original_model=best[c])
+                if next_free != -1:
+                    self.weights[t, :, c] = 0.0
+                    self.weights[t, next_free, c] = 1.0
+            self.mmacc_acc[c] = newest_acc
+
+    # -- FedDrift (hierarchical) ---------------------------------------
+    def _cluster_hierarchical(self, t: int) -> None:
+        """The FedDrift algorithm (cluster_hierarchical, :840-978)."""
+        # FedDrift-C: keep only one of the models created last step (:842-849)
+        if self.h_cluster == "E":
+            marked_models = [m for (m, _) in self.h_marked.values()]
+            if marked_models:
+                keep = self.rng.choice(marked_models)
+                for mm in marked_models:
+                    if mm != keep:
+                        self.pool.reinit_slot(mm)
+                        self.weights[:, mm, :] = 0.0
+
+        # clients leave isolation (:852, :1038-1046)
+        self.h_marked = {c: (m, tt) for c, (m, tt) in self.h_marked.items()
+                         if tt != t}
+
+        in_use = self._models_in_use_before(t, exclude_marked=True)
+        acc = self.acc_matrix_at(t)                       # device: [M, C]
+
+        self.weights[t] = 0.0
+        for c, (m, _) in self.h_marked.items():           # marked stay local (:868)
+            self.weights[t, m, c] = 1.0
+
+        # everyone else on their best in-use model (:872-876)
+        for c in range(self.C):
+            if c not in self.h_marked:
+                best = in_use[int(np.argmax(acc[in_use, c]))]
+                self.weights[t, best, c] = 1.0
+
+        # drift detection -> isolate on a fresh model (:879-897)
+        for c in range(self.C):
+            if c in self.h_marked:
+                continue
+            best = in_use[int(np.argmax(acc[in_use, c]))]
+            newest_acc = acc[best, c]
+            if self.mmacc_acc[c] - newest_acc > self.h_delta:
+                next_free = self._find_unused_model_lru(t, original_model=best)
+                if next_free != -1:
+                    self.h_marked[c] = (next_free, t + self.h_w)
+                    self.weights[t, :, c] = 0.0
+                    self.weights[t, next_free, c] = 1.0
+            self.mmacc_acc[c] = newest_acc
+
+        if len(in_use) > 1:
+            self._hierarchical_merge(t, in_use)
+
+    def _hierarchical_merge(self, t: int, in_use: list[int]) -> None:
+        """Cluster-accuracy matrix -> distance -> linkage -> merge
+        (:899-972). The M x M accuracies come from full per-cell correct
+        counts (one XLA call) instead of the reference's 20-batch subsample."""
+        cells = self.acc_cells_upto(t)                    # [M, C, t+1] correct
+        w = np.transpose(self.weights[: t + 1], (1, 2, 0))  # [M, C, t+1]
+        assigned = (w == 1.0).astype(np.float64)
+        k = len(in_use)
+        cluster_acc = np.zeros((k, k))
+        for j_pos, j in enumerate(in_use):
+            vol = assigned[j].sum() * self.N
+            if vol == 0:
+                continue
+            for i_pos, i in enumerate(in_use):
+                cluster_acc[i_pos, j_pos] = (cells[i] * assigned[j]).sum() / vol
+
+        dist = np.zeros((k, k))
+        for i in range(k):
+            for j in range(k):
+                if self.h_distance == "A":                # (:937-940)
+                    dist[i, j] = max(cluster_acc[i, i] - cluster_acc[i, j],
+                                     cluster_acc[j, j] - cluster_acc[j, i], 0.0)
+                elif self.h_distance == "B":              # (:941-944)
+                    dist[i, j] = max(cluster_acc[i, i] - cluster_acc[j, i],
+                                     cluster_acc[j, j] - cluster_acc[i, j], 0.0)
+        np.fill_diagonal(dist, 0.0)
+
+        method = "average" if self.h_cluster == "D" else "complete"  # (:947-950)
+        Z = sch.linkage(squareform(dist, checks=False), method=method)
+        T = sch.fcluster(Z, t=self.h_deltap, criterion="distance")
+
+        clusters: dict[int, list[int]] = {}
+        for pos, cid in enumerate(T):
+            clusters.setdefault(cid, []).append(in_use[pos])
+
+        merged_log = []
+        for group in clusters.values():
+            if len(group) > 1:
+                merged_log.append("(" + ", ".join(str(m) for m in group) + ")")
+            base = group[0]
+            for second in group[1:]:
+                self._merge(t, base, second)
+        if merged_log and self.logger:
+            self.logger.set_summary("Merge", ", ".join(merged_log))
+
+    def _merge(self, t: int, base: int, second: int) -> None:
+        """Weighted param average + weight union (merge, :1048-1072)."""
+        w1 = float(self.weights[: t + 1, base, :].sum())
+        w2 = float(self.weights[: t + 1, second, :].sum())
+        s = w1 + w2
+        self.pool.merge_slots(base, second, w1 / s, w2 / s)
+        self.weights[: t + 1, base, :] += self.weights[: t + 1, second, :]
+        self.weights[:, second, :] = 0.0
+
+    def _find_unused_model_lru(self, t: int, original_model: int) -> int:
+        """LRU slot allocation (find_unused_model_lru, :1011-1036)."""
+        if self.h_next_free < self.M:
+            nxt = self.h_next_free
+            self.h_next_free += 1
+        else:
+            last_used = -1 * np.ones(self.M)
+            for tt in range(t + 1):
+                for m in range(self.M):
+                    if (self.weights[tt, m] > 0).any():
+                        last_used[m] = tt
+            lru = np.where(last_used == last_used.min())[0]
+            nxt = int(self.rng.choice(lru))
+            if last_used[nxt] == t:
+                return -1
+            self.weights[:, nxt, :] = 0.0
+        # initialise from the drifted client's previous model (:1031-1033)
+        self.pool.copy_slot(nxt, original_model)
+        return nxt
+
+    # -- softclusterreset ----------------------------------------------
+    def _reset_noncompetitive(self, t: int) -> None:
+        """Delete models not epsilon-better than the rest
+        (AggregatorSoftCluster.py:85-97)."""
+        acc = self.acc_matrix_at(t)
+        deleted: list[int] = []
+        for m in reversed(range(self.M)):
+            rest = np.delete(acc, deleted + [m], axis=0)
+            if rest.shape[0] > 0 and (acc[m] < np.max(rest, axis=0) + 0.01).all():
+                deleted.append(m)
+                if self.logger:
+                    self.logger.set_summary(f"Reset-{m}", 1)
+                self.weights[:, m, :] = 0.0
+                self.pool.reinit_slot(m)
+
+    # -- CFL ------------------------------------------------------------
+    def _cluster_cfl_init(self, t: int) -> None:
+        """Copy assignment forward at step start (cluster_cfl_init, :1150-1157)."""
+        self.weights[t] = self.weights[t - 1].copy()
+        if self.cfl_retrain == "win-1":
+            self.weights[:t] = 0.0
+
+    def _cluster_cfl_round(self, t: int, round_idx: int, prev_params,
+                           client_params, n) -> bool:
+        """Gradient-norm gated bipartition (cluster_cfl, :1159-1223)."""
+        did_split = False
+        n_np = np.asarray(n)[:, :self.C]
+        in_use = [m for m in range(self.M) if (self.weights[t, m] > 0).any()]
+
+        # flatten per-client updates: [C_pad, P] per model
+        def flat_updates(m):
+            rows = []
+            for cp_leaf, pv_leaf in zip(jax.tree_util.tree_leaves(client_params),
+                                        jax.tree_util.tree_leaves(prev_params)):
+                delta = cp_leaf[m] - pv_leaf[m][None]      # [C_pad, ...]
+                rows.append(delta.reshape(delta.shape[0], -1))
+            return jnp.concatenate(rows, axis=1)
+
+        for m in in_use:
+            clients = np.nonzero(self.weights[t, m])[0]
+            participating = [c for c in clients if n_np[m, c] > 0]
+            if not participating:
+                continue
+            dW = np.asarray(flat_updates(m))[participating]   # [k, P]
+            norms = np.linalg.norm(dW, axis=1)
+            max_norm = float(norms.max())
+            mean_norm = float(np.linalg.norm(dW.mean(axis=0)))
+
+            if mean_norm > self.cfl_norm:                     # (:1191-1194)
+                self.cfl_norm = mean_norm
+                self.cfl_eps1 = self.cfl_norm / 10.0
+                self.cfl_eps2 = 6 * self.cfl_eps1
+            elif mean_norm < self.cfl_eps1 and max_norm > self.cfl_eps2:
+                S = (dW @ dW.T) / (np.outer(norms, norms) + 1e-12)
+                cl1, cl2 = self._bipartition(S)
+                alpha_cross = max(S[i, j] for i in cl1 for j in cl2)
+                if ((1 - alpha_cross) / 2.0) ** 0.5 > self.cfl_gamma:
+                    nxt = self._find_unused_model_capped()
+                    if nxt != -1:
+                        did_split = True
+                        self.pool.reinit_slot(m)              # (:1205)
+                        self.weights[t, m, :] = 0.0
+                        for i in cl1:
+                            self.weights[t, m, participating[i]] = 1.0
+                        for i in cl2:
+                            self.weights[t, nxt, participating[i]] = 1.0
+
+        if did_split and self.cfl_retrain == "all":           # (:1219-1221)
+            for tt in range(t):
+                self.weights[tt] = self.weights[t].copy()
+        return did_split
+
+    def _find_unused_model_capped(self) -> int:
+        """Give up when the pool cap is reached (:982-987)."""
+        if self.h_next_free < self.M:
+            nxt = self.h_next_free
+            self.h_next_free += 1
+            return nxt
+        return -1
+
+    @staticmethod
+    def _bipartition(S: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+        """Complete-linkage bipartition on similarity (cfl_util_bipartition,
+        :1245-1249). d = 1 - S is a strictly monotone transform of the
+        reference's -S, and complete linkage is invariant under monotone
+        distance transforms, so the 2-way cut is identical."""
+        d = 1.0 - S
+        np.fill_diagonal(d, 0.0)
+        d = (d + d.T) / 2.0     # numerical symmetry for squareform
+        Z = sch.linkage(squareform(d, checks=False), method="complete")
+        labels = sch.fcluster(Z, t=2, criterion="maxclust")
+        cl1 = np.where(labels == labels[0])[0]
+        cl2 = np.where(labels != labels[0])[0]
+        return cl1, cl2
+
+    # ------------------------------------------------------------------
+    # logging (log_models, :723-764)
+    def _log_models(self, t: int) -> None:
+        if not getattr(self, "logger", None):
+            return
+        if self.h_cluster == "E":
+            num_models = len(self._models_in_use_before(t))
+            if self.h_marked:
+                num_models += 1
+        else:
+            num_models = sum(1 for m in range(self.M)
+                             if (self.weights[: t + 1, m, :] > 0).any())
+        self.logger.set_summary("num_models", num_models)
+
+        trained_by = {m: set(np.nonzero(self.weights[: t + 1, m, :].sum(0))[0])
+                      for m in range(self.M)}
+        local_models = sum(1 for m, cs in trained_by.items() if len(cs) == 1)
+        self.logger.set_summary("local_models", local_models)
+        shared = {m: cs for m, cs in trained_by.items() if len(cs) > 1}
+        for c in range(self.C):
+            self.logger.set_summary(
+                f"Contribute/CL-{c}",
+                sum(1 for cs in shared.values() if c in cs))
+
+    # ------------------------------------------------------------------
+    def state_dict(self) -> dict:
+        return {
+            "weights": self.weights,
+            "mmacc_acc": self.mmacc_acc,
+            "h_marked": dict(self.h_marked),
+            "h_next_free": self.h_next_free,
+            "cfl_norm": self.cfl_norm,
+            "cfl_eps1": self.cfl_eps1,
+            "cfl_eps2": self.cfl_eps2,
+        }
+
+    def load_state_dict(self, d: dict) -> None:
+        self.weights = np.asarray(d["weights"], dtype=np.float32)
+        self.mmacc_acc = np.asarray(d["mmacc_acc"])
+        self.h_marked = {int(k): tuple(v) for k, v in d["h_marked"].items()}
+        self.h_next_free = int(d["h_next_free"])
+        self.cfl_norm = float(d["cfl_norm"])
+        self.cfl_eps1 = float(d["cfl_eps1"])
+        self.cfl_eps2 = float(d["cfl_eps2"])

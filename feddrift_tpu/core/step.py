@@ -244,6 +244,33 @@ class TrainStep:
 
     # ------------------------------------------------------------------
     @partial(jax.jit, static_argnums=0)
+    def acc_cells(self, params, x, y, feat_mask):
+        """Correct-prediction counts per (model, client, time step).
+
+        x: [C, T1, N, ...] -> correct [M, C, T1]. Powers FedDrift's
+        cluster-accuracy matrix (reference _infer_subset over concatenated
+        per-cluster datasets, FedAvgEnsDataLoader.py:899-931) exactly:
+        cluster_acc[i][j] = sum over cells assigned to cluster j of
+        correct[i, c, t] / volume — full data, not the reference's 20-batch
+        subsample. lax.map over the time axis bounds activation memory for
+        large models.
+        """
+        def at_time(xt_yt):
+            xt, yt = xt_yt                               # [C, N, ...], [C, N]
+            def one(p_m, f_m):
+                def per_client(xc, yc):
+                    xin = xc * f_m if xc.dtype != jnp.int32 else xc
+                    logits = self.apply_fn(p_m, xin)
+                    return (logits.argmax(-1) == yc).sum()
+                return jax.vmap(per_client)(xt, yt)
+            return jax.vmap(one)(params, feat_mask)      # [M, C]
+        x_t = jnp.moveaxis(x, 1, 0)                      # [T1, C, N, ...]
+        y_t = jnp.moveaxis(y, 1, 0)
+        correct = jax.lax.map(at_time, (x_t, y_t))       # [T1, M, C]
+        return jnp.moveaxis(correct, 0, 2)               # [M, C, T1]
+
+    # ------------------------------------------------------------------
+    @partial(jax.jit, static_argnums=0)
     def confusion_matrices(self, params, x, y, feat_mask):
         """Per-(model, client) confusion matrices [M, C, K, K] (KUE kappa)."""
         K = self.num_classes

@@ -80,6 +80,7 @@ class Experiment:
         self.y = shard_client_arrays(self.mesh, jnp.asarray(y_np))
         self.algo = make_algorithm(cfg, self.ds, self.pool, self.step)
         self.logger = MetricsLogger(out_dir, use_wandb)
+        self.algo.bind(self.x, self.y, self.logger, self.C_pad)
         self.key = experiment_key(cfg.seed)
         self.global_round = 0
 
