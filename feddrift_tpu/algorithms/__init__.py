@@ -3,3 +3,5 @@ from feddrift_tpu.algorithms.base import DriftAlgorithm, make_algorithm, availab
 # Import algorithm modules for registration side effects.
 import feddrift_tpu.algorithms.singlemodel  # noqa: F401,E402
 import feddrift_tpu.algorithms.softcluster  # noqa: F401,E402
+import feddrift_tpu.algorithms.ensembles   # noqa: F401,E402
+import feddrift_tpu.algorithms.statebased  # noqa: F401,E402

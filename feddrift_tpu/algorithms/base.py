@@ -122,8 +122,14 @@ class DriftAlgorithm:
 
     # -- evaluation routing --------------------------------------------
     def test_model_idx(self, t: int) -> np.ndarray:
-        """[C] model index per client for train/test eval."""
+        """[C] model index per client for test-data eval."""
         return np.zeros((self.C,), dtype=np.int64)
+
+    def train_model_idx(self, t: int) -> np.ndarray:
+        """[C] model index per client for train-data eval. Defaults to the
+        test index (SoftCluster/DriftSurf); AUE/KUE pin it to model 0 and
+        mmgeniex trains/tests different models."""
+        return self.test_model_idx(t)
 
     def ensemble_spec(self, t: int) -> Optional[EnsembleSpec]:
         return None

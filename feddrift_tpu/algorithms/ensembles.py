@@ -1,0 +1,250 @@
+"""Streaming-ensemble baselines: AUE, AUE-PC, KUE.
+
+Accuracy-Updated Ensemble (AUE/AUE-PC) keeps a sliding window of models, the
+m-th trained on the last m+1 time steps, with MSE-derived voting weights
+(reference AUE_data_loader, FedAvgEnsDataLoader.py:20-29;
+FedAvgEnsAggregatorAue.py; per-client weights FedAvgEnsAggregatorAuePc.py).
+Kappa-Updated Ensemble (KUE) keeps ``concept_num`` models with random feature
+masks, Poisson(1) bootstrap resampling and Cohen's-kappa voting
+(KueState, FedAvgEnsDataLoader.py:32-72; FedAvgEnsAggregatorKue.py;
+FedAvgEnsTrainerKue.py).
+
+All device work — the [M, C] MSE/Brier matrix, the [M, C, K, K] confusion
+matrices, the masked forward passes — is batched XLA over the stacked model
+pool instead of the reference's per-model CPU<->GPU loop.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from feddrift_tpu.algorithms.base import DriftAlgorithm, EnsembleSpec, register_algorithm
+from feddrift_tpu.data.retrain import poisson_sample_counts, time_weights
+
+import jax.numpy as jnp
+
+EPS = 1e-20
+
+
+class _AueBase(DriftAlgorithm):
+    """Shared AUE machinery; subclasses choose global vs per-client weights."""
+
+    per_client_weights = False
+
+    def __init__(self, cfg, ds, pool, step) -> None:
+        super().__init__(cfg, ds, pool, step)
+        self.W = cfg.ensemble_window
+        assert self.M == self.W
+        py = 1.0 / ds.num_classes
+        self.mser = (1.0 - py) ** 2
+        shape = (self.C, self.M) if self.per_client_weights else (self.M,)
+        self.ens_weights = np.full(shape, 1.0 / (self.mser + EPS))
+        self._normalize()
+        self.model_num = 1
+        self._tw = None
+
+    def _normalize(self) -> None:
+        if self.per_client_weights:
+            self.ens_weights /= self.ens_weights.sum(axis=1, keepdims=True)
+        else:
+            self.ens_weights /= self.ens_weights.sum()
+
+    # ------------------------------------------------------------------
+    def begin_iteration(self, t: int) -> None:
+        # Window size grows until it hits W (AUE_data_loader:22).
+        self.model_num = min(t + 1, self.W)
+        if t > 0:
+            # Circular reload: model m inherits last iteration's model m-1;
+            # model 0 restarts from the deterministic init
+            # (main_fedavg.py:342-345).
+            for m in reversed(range(1, self.model_num)):
+                self.pool.copy_slot(m, m - 1)
+            self.pool.reinit_slot(0)
+            # Weights shift with the models; fresh model starts "perfect".
+            if self.per_client_weights:
+                self.ens_weights[:, 1:] = self.ens_weights[:, :-1]
+                self.ens_weights[:, 0] = 1.0 / (self.mser + EPS)
+            else:
+                self.ens_weights[1:] = self.ens_weights[:-1]
+                self.ens_weights[0] = 1.0 / (self.mser + EPS)
+            self._normalize()
+        # Model m trains on window win-(m+1) (AUE_data_loader:26).
+        w = np.zeros((self.M, self.C, self.T1), dtype=np.float32)
+        for m in range(self.model_num):
+            w[m] = time_weights(f"win-{m + 1}", self.C, t, self.T1)
+        self._tw = jnp.asarray(w)
+
+    def round_inputs(self, t: int, r: int):
+        return self._tw, self._ones_sample_w, self._ones_feat_mask, jnp.float32(1.0)
+
+    # ------------------------------------------------------------------
+    def _update_ens_weights(self, t: int) -> None:
+        """1/(MSEr + MSEi + eps) from the newest data batch
+        (update_ens_weights, FedAvgEnsAggregatorAue.py:55-87).
+
+        Note: the reference writes model (m+1)'s MSE score into weight slot m
+        (``for m_idx, model in enumerate(self.models[1:]): ens_weights[m_idx]
+        = ...``, :64-78) — an off-by-one that leaves the last slot stale; we
+        implement the AUE-paper formula (weight m from model m's MSE).
+        """
+        mse_sum, total = self.step.mse_matrix(
+            self.pool.params, self.x[:, t], self.y[:, t], self._ones_feat_mask)
+        mse_sum = np.asarray(mse_sum)[:, : self.C]
+        total = np.asarray(total)[: self.C]
+        if self.per_client_weights:
+            msei = mse_sum.T / np.maximum(total[:, None], 1)    # [C, M]
+            self.ens_weights = 1.0 / (self.mser + msei + EPS)
+            self.ens_weights[:, 0] = 1.0 / (self.mser + EPS)
+        else:
+            msei = mse_sum.sum(axis=1) / max(total.sum(), 1)    # [M]
+            self.ens_weights = 1.0 / (self.mser + msei + EPS)
+            self.ens_weights[0] = 1.0 / (self.mser + EPS)
+        self._normalize()
+
+    def after_round(self, t: int, r: int, prev_params, agg_params,
+                    client_params, n):
+        self.pool.params = agg_params
+        # Same cadence as the reference (AggregatorAue.py:142-144).
+        if r % 10 == 0 or r > self.cfg.comm_round - 10:
+            self._update_ens_weights(t)
+        return self.pool.params
+
+    # ------------------------------------------------------------------
+    def train_model_idx(self, t: int) -> np.ndarray:
+        # Train metrics come from the newest model (AggregatorAue._infer:236).
+        return np.zeros((self.C,), dtype=np.int64)
+
+    test_model_idx = train_model_idx
+
+    def ensemble_spec(self, t: int):
+        mask = np.zeros((self.M,), dtype=np.float32)
+        mask[: self.model_num] = 1.0
+        w = self.ens_weights.T if self.per_client_weights else self.ens_weights
+        return EnsembleSpec(mode="hard", weights=np.asarray(w, np.float32),
+                            model_mask=mask)
+
+    # ------------------------------------------------------------------
+    def state_dict(self) -> dict:
+        return {"ens_weights": self.ens_weights, "model_num": self.model_num}
+
+    def load_state_dict(self, d: dict) -> None:
+        self.ens_weights = np.asarray(d["ens_weights"])
+        self.model_num = int(d["model_num"])
+
+
+@register_algorithm("aue")
+class Aue(_AueBase):
+    name = "aue"
+    per_client_weights = False
+
+
+@register_algorithm("auepc")
+class AuePc(_AueBase):
+    """Per-client ensemble weights (FedAvgEnsAggregatorAuePc.py:45-90, 260)."""
+    name = "auepc"
+    per_client_weights = True
+
+
+@register_algorithm("kue")
+class Kue(DriftAlgorithm):
+    """Kappa-Updated Ensemble.
+
+    concept_num models; model m sees inputs elementwise-multiplied by a random
+    feature mask (KueState.initialize_mask, FedAvgEnsDataLoader.py:50-55;
+    FedAvgEnsTrainerKue.py:65-92) and trains on its own Poisson(1) bootstrap
+    of the newest batch (Kue_data_loader:58-62, retrain.py:65-74). Each
+    iteration the lowest-kappa model is re-masked and re-initialised
+    (FedAvgEnsAggregatorKue.py:47-57); test-time prediction is a
+    kappa-weighted soft vote over models with kappa > 0, excluding the worst
+    (:234-262).
+    """
+
+    name = "kue"
+
+    def __init__(self, cfg, ds, pool, step) -> None:
+        super().__init__(cfg, ds, pool, step)
+        self.F = int(np.prod(ds.feature_shape)) if not ds.is_sequence else 1
+        self.rng = np.random.default_rng(cfg.seed + 31337)
+        self.masks = np.zeros((self.M, self.F), dtype=np.float32)
+        for m in range(self.M):
+            self._init_mask(m)
+        self.worst_idx = 0
+        self.ens_weights = np.zeros((self.M,), dtype=np.float64)
+        self._tw = None
+        self._sw = None
+        self._fm = None
+
+    def _init_mask(self, m: int) -> None:
+        """r ~ U{1..F} features on (initialize_mask, :50-55)."""
+        r = int(self.rng.integers(1, self.F + 1))
+        used = self.rng.choice(self.F, size=r, replace=False)
+        self.masks[m] = 0.0
+        self.masks[m][used] = 1.0
+
+    # ------------------------------------------------------------------
+    def begin_iteration(self, t: int) -> None:
+        if t > 0:
+            # Replace the worst model: new mask + deterministic reinit
+            # (init_kue_state, AggregatorKue.py:47-57).
+            self._init_mask(self.worst_idx)
+            self.pool.reinit_slot(self.worst_idx)
+        # win-1 time window; per-model Poisson bootstrap sample weights.
+        w = time_weights("win-1", self.C, t, self.T1)
+        self._tw = jnp.asarray(np.broadcast_to(w[None], (self.M, self.C, self.T1)).copy())
+        counts = np.stack([poisson_sample_counts(self.C, self.N, self.rng)
+                           for _ in range(self.M)])
+        self._sw = jnp.asarray(counts)
+        self._fm = self.feature_mask_for(self.masks)
+
+    def round_inputs(self, t: int, r: int):
+        return self._tw, self._sw, self._fm, jnp.float32(1.0)
+
+    # ------------------------------------------------------------------
+    def _update_ens_weights(self, t: int) -> None:
+        """Cohen's kappa from confusion matrices summed over clients
+        (update_ens_weights, AggregatorKue.py:59-77)."""
+        cms = self.step.confusion_matrices(
+            self.pool.params, self.x[:, t], self.y[:, t], self._fm)
+        cms = np.asarray(cms, dtype=np.float64)[:, : self.C].sum(axis=1)  # [M, K, K]
+        for m in range(self.M):
+            A = cms[m]
+            n = A.sum()
+            left = np.trace(A)
+            right = (A.sum(axis=1) * A.sum(axis=0)).sum()
+            denom = n * n - right
+            self.ens_weights[m] = (n * left - right) / denom if denom != 0 else 0.0
+
+    def after_round(self, t: int, r: int, prev_params, agg_params,
+                    client_params, n):
+        self.pool.params = agg_params
+        if r % 10 == 0 or r > self.cfg.comm_round - 10:
+            self._update_ens_weights(t)
+            if t != 0:
+                self.worst_idx = int(np.argmin(self.ens_weights))
+        return self.pool.params
+
+    # ------------------------------------------------------------------
+    def train_model_idx(self, t: int) -> np.ndarray:
+        return np.zeros((self.C,), dtype=np.int64)   # (AggregatorKue._infer:216)
+
+    test_model_idx = train_model_idx
+
+    def ensemble_spec(self, t: int):
+        mask = np.ones((self.M,), dtype=np.float32)
+        mask[self.worst_idx] = 0.0                   # worst excluded (:249)
+        return EnsembleSpec(mode="soft",
+                            weights=np.asarray(self.ens_weights, np.float32),
+                            model_mask=mask)
+
+    # ------------------------------------------------------------------
+    def state_dict(self) -> dict:
+        return {"masks": self.masks, "worst_idx": self.worst_idx,
+                "ens_weights": self.ens_weights,
+                "rng_state": self.rng.bit_generator.state}
+
+    def load_state_dict(self, d: dict) -> None:
+        self.masks = np.asarray(d["masks"], np.float32)
+        self.worst_idx = int(d["worst_idx"])
+        self.ens_weights = np.asarray(d["ens_weights"], np.float64)
+        if "rng_state" in d:
+            self.rng.bit_generator.state = d["rng_state"]

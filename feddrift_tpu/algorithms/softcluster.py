@@ -475,6 +475,9 @@ class SoftCluster(DriftAlgorithm):
             "cfl_norm": self.cfl_norm,
             "cfl_eps1": self.cfl_eps1,
             "cfl_eps2": self.cfl_eps2,
+            # rng state so a resumed run replays the same stochastic slot
+            # choices (LRU ties, FedDrift-C keep-one) as a continuous one
+            "rng_state": self.rng.bit_generator.state,
         }
 
     def load_state_dict(self, d: dict) -> None:
@@ -485,3 +488,5 @@ class SoftCluster(DriftAlgorithm):
         self.cfl_norm = float(d["cfl_norm"])
         self.cfl_eps1 = float(d["cfl_eps1"])
         self.cfl_eps2 = float(d["cfl_eps2"])
+        if "rng_state" in d:
+            self.rng.bit_generator.state = d["rng_state"]

@@ -1,0 +1,473 @@
+"""Host-side state-machine algorithms: DriftSurf, MultiModel(Acc/Geni/GeniEx),
+Adaptive-FedAvg, and the legacy one-shot ClusterFL.
+
+These are the reference's pickled cross-process states
+(DriftSurfState / MultiModelAccState / AdaState, FedAvgEnsDataLoader.py:146-563;
+FedAvgEnsAggregatorClusterFL.py) re-hosted as plain in-memory objects driving
+the jitted round program. All accuracy scoring runs as batched [M, C] device
+programs instead of per-model sequential inference.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from feddrift_tpu.algorithms.base import DriftAlgorithm, register_algorithm
+from feddrift_tpu.config import DEFAULT_DELTAS, DRIFTSURF_DELTAS
+from feddrift_tpu.data.retrain import time_weights
+
+
+@register_algorithm("driftsurf")
+class DriftSurf(DriftAlgorithm):
+    """Stable/reactive drift-detection state machine (DriftSurfState,
+    FedAvgEnsDataLoader.py:146-266; DriftSurf_data_loader :269-314;
+    FedAvgEnsAggregatorDriftSurf.py).
+
+    Two live model slots; slot i holds the model for ``train_keys[i]``
+    ('pred' always, plus 'stab' or 'reac'). Key->params continuity across
+    iterations is kept host-side (the reference pickles nn.Modules inside
+    ds_state; here a dict of single-model pytrees).
+    """
+
+    name = "driftsurf"
+
+    def __init__(self, cfg, ds, pool, step) -> None:
+        super().__init__(cfg, ds, pool, step)
+        assert self.M == 2
+        p = cfg.algo_params()
+        self.delta = p.get("delta", DRIFTSURF_DELTAS.get(cfg.dataset, 0.1))
+        self.reac_len = 3                       # r=3 (DriftSurfState.__init__)
+        self.win_len = 10                       # batch-window cap
+        self.key_params = {"pred": None, "stab": None, "reac": None}
+        self.train_data = {"pred": [0], "stab": [0], "reac": None}
+        self.train_keys = ["pred", "stab"]
+        self.acc_best = 0.0
+        self.acc_dict = None
+        self.reac_ctr = None
+        self.state = "stab"
+        self.model_key = "pred"
+        self._tw = None
+
+    # ------------------------------------------------------------------
+    def _score(self, key: str, t: int) -> float:
+        """Pooled accuracy of the stored model for ``key`` on step-t data
+        (DriftSurfState._score: global win-1 loader)."""
+        if self.key_params[key] is None:
+            return 0.0
+        params = jax.tree_util.tree_map(lambda p: p[None], self.key_params[key])
+        correct, _, total = self.step.acc_matrix(
+            params, self.x[:, t], self.y[:, t],
+            jnp.ones((1, *self._ones_feat_mask.shape[1:]), jnp.float32))
+        return float(np.asarray(correct)[0, : self.C].sum()
+                     / np.asarray(total)[: self.C].sum())
+
+    def _append(self, key: str, it: int) -> None:
+        self.train_data[key].append(it)
+        if len(self.train_data[key]) > self.win_len:
+            self.train_data[key].pop(0)
+
+    def _run_ds_algo(self, t: int) -> None:
+        """The transition logic, verbatim semantics of run_ds_algo
+        (:212-266)."""
+        acc_pred = self._score("pred", t)
+        if acc_pred > self.acc_best:
+            self.acc_best = acc_pred
+        if self.state == "stab":
+            acc_stab = 0.0 if not self.train_data["stab"] else self._score("stab", t)
+            if (acc_pred < self.acc_best - self.delta) or \
+               (acc_pred < acc_stab - self.delta / 2):
+                self.state = "reac"
+                self.key_params["reac"] = None
+                self.train_data["reac"] = []
+                self.reac_ctr = 0
+                self.acc_dict = {"pred": np.zeros(self.reac_len),
+                                 "reac": np.zeros(self.reac_len)}
+            else:
+                self._append("pred", t)
+                self._append("stab", t)
+                self.train_keys = ["pred", "stab"]
+        if self.state == "reac":
+            if self.reac_ctr > 0:
+                acc_reac = self._score("reac", t)
+                self.acc_dict["pred"][self.reac_ctr - 1] = acc_pred
+                self.acc_dict["reac"][self.reac_ctr - 1] = acc_reac
+                self.model_key = "reac" if acc_reac > acc_pred else "pred"
+            self._append("pred", t)
+            self._append("reac", t)
+            self.train_keys = ["pred", "reac"]
+            self.reac_ctr += 1
+            if self.reac_ctr == self.reac_len:
+                self.state = "stab"
+                self.key_params["stab"] = None
+                self.train_data["stab"] = []
+                if np.mean(self.acc_dict["pred"]) < np.mean(self.acc_dict["reac"]):
+                    self.key_params["pred"] = self.key_params["reac"]
+                    self.train_data["pred"] = list(self.train_data["reac"])
+                    self.acc_best = float(np.amax(self.acc_dict["reac"]))
+                    self.model_key = "pred"
+                self.acc_dict = None
+                self.reac_ctr = None
+
+    # ------------------------------------------------------------------
+    def begin_iteration(self, t: int) -> None:
+        if t > 0:
+            self._run_ds_algo(t)
+        # Slot assignment (AggregatorDriftSurf.init_ds_state:45-64): reuse
+        # stored params per key; fresh keys start from the deterministic init.
+        for idx, key in enumerate(self.train_keys):
+            if self.key_params[key] is not None:
+                self.pool.set_slot(idx, self.key_params[key])
+            else:
+                self.pool.reinit_slot(idx)
+        # Per-key retrain windows become sel-{iters} time weights (:299-304).
+        w = np.zeros((self.M, self.C, self.T1), dtype=np.float32)
+        for idx, key in enumerate(self.train_keys):
+            spec = "sel-" + ",".join(str(i) for i in self.train_data[key])
+            w[idx] = time_weights(spec, self.C, t, self.T1)
+        self._tw = jnp.asarray(w)
+
+    def round_inputs(self, t: int, r: int):
+        return self._tw, self._ones_sample_w, self._ones_feat_mask, jnp.float32(1.0)
+
+    def end_iteration(self, t: int) -> None:
+        for idx, key in enumerate(self.train_keys):
+            self.key_params[key] = self.pool.slot(idx)
+
+    # ------------------------------------------------------------------
+    def test_model_idx(self, t: int) -> np.ndarray:
+        idx = self.train_keys.index(self.model_key) \
+            if self.model_key in self.train_keys else 0
+        return np.full((self.C,), idx, dtype=np.int64)
+
+    # ------------------------------------------------------------------
+    def state_dict(self) -> dict:
+        return {"train_data": self.train_data, "train_keys": self.train_keys,
+                "acc_best": self.acc_best, "acc_dict": self.acc_dict,
+                "reac_ctr": self.reac_ctr, "state": self.state,
+                "model_key": self.model_key,
+                "key_params": {k: None if v is None else
+                               jax.tree_util.tree_map(np.asarray, v)
+                               for k, v in self.key_params.items()}}
+
+    def load_state_dict(self, d: dict) -> None:
+        self.train_data = d["train_data"]
+        self.train_keys = list(d["train_keys"])
+        self.acc_best = float(d["acc_best"])
+        self.acc_dict = d["acc_dict"]
+        self.reac_ctr = d["reac_ctr"]
+        self.state = d["state"]
+        self.model_key = d["model_key"]
+        self.key_params = {k: None if v is None else
+                           jax.tree_util.tree_map(jnp.asarray, v)
+                           for k, v in d["key_params"].items()}
+
+
+@register_algorithm("mmacc", "mmgeni", "mmgeniex")
+class MultiModel(DriftAlgorithm):
+    """FedDrift-Eager precursor: per-client best-model selection with drift
+    threshold spawning the next free model (MultiModelAccState,
+    FedAvgEnsDataLoader.py:317-563; FedAvgEnsAggregatorMultiModelAcc.py).
+
+    'mmgeni'/'mmgeniex' are oracles reading the ground-truth change-point
+    matrix (model_select_geni :392-398, model_select_geniex :400-419);
+    geniex additionally predicts the *test* model one step ahead.
+    """
+
+    name = "multimodel"
+
+    def __init__(self, cfg, ds, pool, step) -> None:
+        super().__init__(cfg, ds, pool, step)
+        self.delta = DEFAULT_DELTAS.get(cfg.dataset, 0.1)
+        # train_data[m][c] = list of iterations client c contributed to m
+        self.train_data = [[[] for _ in range(self.C)] for _ in range(self.M)]
+        self.train_idx = np.zeros((self.C,), dtype=np.int64)
+        self.test_idx = np.zeros((self.C,), dtype=np.int64)
+        self.acc_dict = np.zeros((self.C,))
+        self.concepts = ds.concepts[:, : self.C]   # oracle ground truth [T1, C]
+        self._tw = None
+
+    def _assigned(self) -> list[int]:
+        return [m for m in range(self.M)
+                if any(self.train_data[m][c] for c in range(self.C))]
+
+    # ------------------------------------------------------------------
+    def _select_acc(self, t: int) -> None:
+        """run_model_select (:350-390)."""
+        if t == 0:
+            for c in range(self.C):
+                self.train_data[0][c].append(0)
+            self.train_idx[:] = 0
+            self.test_idx[:] = 0
+            return
+        assigned = self._assigned()
+        next_free = next((m for m in range(self.M) if m not in assigned), -1)
+        acc = self.acc_matrix_at(t)                     # [M, C] device batched
+        for c in range(self.C):
+            best_model, best_acc = -1, 0.0
+            for m in assigned:
+                if acc[m, c] > best_acc:
+                    best_acc, best_model = acc[m, c], m
+            if self.acc_dict[c] - best_acc > self.delta and next_free != -1:
+                best_model = next_free
+            self.train_data[best_model][c].append(t)
+            self.train_idx[c] = best_model
+            self.test_idx[c] = best_model
+
+    def _select_geni(self, t: int) -> None:
+        for c in range(self.C):
+            m = int(self.concepts[t, c]) % self.M
+            self.train_data[m][c].append(t)
+            self.train_idx[c] = m
+            self.test_idx[c] = m
+
+    def _select_geniex(self, t: int) -> None:
+        drift_steps = np.nonzero(self.concepts.any(axis=1))[0]
+        min_cp = int(drift_steps[0]) if drift_steps.size else 10**9
+        for c in range(self.C):
+            m = int(self.concepts[t, c]) % self.M
+            test_m = int(self.concepts[t + 1, c]) % self.M if t >= min_cp else m
+            self.train_data[m][c].append(t)
+            self.train_idx[c] = m
+            self.test_idx[c] = test_m
+
+    # ------------------------------------------------------------------
+    def begin_iteration(self, t: int) -> None:
+        algo = self.cfg.concept_drift_algo
+        if algo == "mmacc":
+            self._select_acc(t)
+        elif algo == "mmgeni":
+            self._select_geni(t)
+        else:
+            self._select_geniex(t)
+        # Data routed per model by clientsel semantics (:452-493): client c
+        # contributes steps train_data[m][c] to model m.
+        w = np.zeros((self.M, self.C, self.T1), dtype=np.float32)
+        for m in range(self.M):
+            for c in range(self.C):
+                for it in self.train_data[m][c]:
+                    w[m, c, it] = 1.0
+        self._tw = jnp.asarray(w)
+
+    def round_inputs(self, t: int, r: int):
+        return self._tw, self._ones_sample_w, self._ones_feat_mask, jnp.float32(1.0)
+
+    def end_iteration(self, t: int) -> None:
+        # Arm the drift detector: train accuracy of each client's model at
+        # the final eval (AggregatorMultiModelAcc.py:140-145 set_acc).
+        acc = self.acc_matrix_at(t)
+        for c in range(self.C):
+            self.acc_dict[c] = acc[self.train_idx[c], c]
+
+    # ------------------------------------------------------------------
+    def train_model_idx(self, t: int) -> np.ndarray:
+        return self.train_idx.copy()
+
+    def test_model_idx(self, t: int) -> np.ndarray:
+        return self.test_idx.copy()
+
+    # ------------------------------------------------------------------
+    def state_dict(self) -> dict:
+        return {"train_data": self.train_data, "train_idx": self.train_idx,
+                "test_idx": self.test_idx, "acc_dict": self.acc_dict}
+
+    def load_state_dict(self, d: dict) -> None:
+        self.train_data = d["train_data"]
+        self.train_idx = np.asarray(d["train_idx"], np.int64)
+        self.test_idx = np.asarray(d["test_idx"], np.int64)
+        self.acc_dict = np.asarray(d["acc_dict"])
+
+
+@register_algorithm("ada")
+class AdaptiveFedAvg(DriftAlgorithm):
+    """Server-side adaptive learning rate from parameter-moment statistics
+    (AdaState, FedAvgEnsDataLoader.py:75-143; FedAvgEnsAggregatorAda.py;
+    client LR override FedAvgEnsTrainerAda.py:65).
+
+    eta = min(eta0, eta0 * gamma_hat / t), with beta-momentum estimates of the
+    aggregated-parameter mean/variance ratio. The LR reaches clients as a
+    multiplicative update scale (extra_info['lr'] in the reference).
+    """
+
+    name = "ada"
+
+    def __init__(self, cfg, ds, pool, step) -> None:
+        super().__init__(cfg, ds, pool, step)
+        assert self.M == 1
+        p = cfg.algo_params()
+        self.retrain = p.get("ada_retrain", "win-1")
+        self.update_each_round = p.get("ada_update", "round") == "round"
+        self.beta1 = self.beta2 = self.beta3 = 0.5
+        self.init_lr = cfg.lr
+        self.eta = cfg.lr
+        self.mu = None
+        self.s = 0.0
+        self.gam = 0.0
+        self._tw = None
+
+    # ------------------------------------------------------------------
+    def _ada_update(self, theta: np.ndarray, t: int) -> None:
+        """AdaState.update (:87-122), counting from 1."""
+        t = t + 1
+        prev_mu = self.mu if self.mu is not None else np.zeros(theta.shape)
+        prev_s, prev_gam = self.s, self.gam
+        if t != 1:
+            prev_muh = prev_mu / (1 - self.beta1 ** (t - 1))
+            prev_sh = prev_s / (1 - self.beta2 ** (t - 1))
+        else:
+            prev_muh = 0.0
+            prev_sh = 0.0
+        new_mu = self.beta1 * prev_mu + (1 - self.beta1) * theta
+        new_s = self.beta2 * prev_s + \
+            (1 - self.beta2) * float(np.mean((theta - prev_muh) ** 2))
+        new_sh = new_s / (1 - self.beta2 ** t)
+        ratio = new_sh / prev_sh if prev_sh != 0 else 1.0
+        new_gam = self.beta3 * prev_gam + (1 - self.beta3) * ratio
+        new_gamh = new_gam / (1 - self.beta3 ** t)
+        self.eta = min(self.init_lr, self.init_lr * new_gamh / t)
+        self.mu, self.s, self.gam = new_mu, new_s, new_gam
+
+    # ------------------------------------------------------------------
+    def begin_iteration(self, t: int) -> None:
+        w = time_weights(self.retrain, self.C, t, self.T1)
+        self._tw = jnp.asarray(w[None], jnp.float32)
+
+    def round_inputs(self, t: int, r: int):
+        return (self._tw, self._ones_sample_w, self._ones_feat_mask,
+                jnp.float32(self.eta / self.init_lr))
+
+    def after_round(self, t: int, r: int, prev_params, agg_params,
+                    client_params, n):
+        self.pool.params = agg_params
+        theta = np.concatenate([np.asarray(leaf[0]).ravel() for leaf in
+                                jax.tree_util.tree_leaves(agg_params)])
+        if self.update_each_round:
+            self._ada_update(theta, r + t * self.cfg.comm_round)
+        elif r == self.cfg.comm_round - 5:
+            self._ada_update(theta, t)
+        return self.pool.params
+
+    # ------------------------------------------------------------------
+    def state_dict(self) -> dict:
+        return {"eta": self.eta, "mu": self.mu, "s": self.s, "gam": self.gam}
+
+    def load_state_dict(self, d: dict) -> None:
+        self.eta = float(d["eta"])
+        self.mu = None if d["mu"] is None else np.asarray(d["mu"])
+        self.s = float(d["s"])
+        self.gam = float(d["gam"])
+
+
+@register_algorithm("clusterfl")
+class LegacyClusterFL(DriftAlgorithm):
+    """One-shot CFL bipartition inside the training run
+    (FedAvgEnsAggregatorClusterFL.py:114-190; trainer gate
+    FedAvgEnsTrainerClusterFL.py:58-59). Marked obsolete by the reference in
+    favor of softcluster+cfl (main_fedavg.py:350-352); kept for parity.
+    Models are NOT carried across iterations (reload rule 'clusterfl': pass,
+    main_fedavg.py:352-354), so the split state resets each time step.
+    """
+
+    name = "clusterfl"
+
+    def __init__(self, cfg, ds, pool, step) -> None:
+        super().__init__(cfg, ds, pool, step)
+        self.retrain = cfg.concept_drift_algo_arg or "win-1"
+        self.gamma_max = 0.5
+        self._reset_state()
+
+    def _reset_state(self) -> None:
+        self.is_split = False
+        self.assignment = np.zeros((self.C,), dtype=np.int64)
+        self.eps1 = 0.0
+        self.eps2 = 1e4
+        self.max_eps1 = 0.0
+
+    # ------------------------------------------------------------------
+    def begin_iteration(self, t: int) -> None:
+        self._reset_state()
+        for m in range(self.M):
+            self.pool.reinit_slot(m)
+        self._base_w = time_weights(self.retrain, self.C, t, self.T1)
+        self._sync_weights()
+
+    def _sync_weights(self) -> None:
+        w = np.zeros((self.M, self.C, self.T1), dtype=np.float32)
+        for c in range(self.C):
+            w[self.assignment[c], c] = self._base_w[c]
+        self._tw = jnp.asarray(w)
+
+    def round_inputs(self, t: int, r: int):
+        return self._tw, self._ones_sample_w, self._ones_feat_mask, jnp.float32(1.0)
+
+    # ------------------------------------------------------------------
+    def after_round(self, t: int, r: int, prev_params, agg_params,
+                    client_params, n):
+        self.pool.params = agg_params
+        if self.is_split:
+            return self.pool.params
+
+        # Weight updates of the (single) cluster-0 model across clients.
+        rows = []
+        for cp_leaf, pv_leaf in zip(jax.tree_util.tree_leaves(client_params),
+                                    jax.tree_util.tree_leaves(prev_params)):
+            delta = cp_leaf[0] - pv_leaf[0][None]
+            rows.append(np.asarray(delta.reshape(delta.shape[0], -1)))
+        dW = np.concatenate(rows, axis=1)[: self.C]       # [C, P]
+        norms = np.linalg.norm(dW, axis=1)
+        max_norm = float(norms.max())
+        mean_norm = float(np.linalg.norm(dW.mean(axis=0)))
+        if self.logger:
+            self.logger.set_summary("Max_Norm", max_norm)
+            self.logger.set_summary("Mean_Norm", mean_norm)
+
+        mean_norm_increase = False
+        if mean_norm > self.max_eps1:                     # (:126-134)
+            self.max_eps1 = mean_norm
+            mean_norm_increase = True
+            self.eps1 = self.max_eps1 / 10.0
+            self.eps2 = 6 * self.eps1
+        if mean_norm < self.eps1 and max_norm > self.eps2 and r > 100 \
+                and not mean_norm_increase:               # gate (:135-137)
+            S = (dW @ dW.T) / (np.outer(norms, norms) + 1e-12)
+            from sklearn.cluster import AgglomerativeClustering
+            labels = AgglomerativeClustering(
+                metric="precomputed", linkage="complete",
+                n_clusters=2).fit(-S).labels_             # (:105-112)
+            c1 = np.where(labels == 0)[0]
+            c2 = np.where(labels == 1)[0]
+            self.assignment[c1] = 0
+            self.assignment[c2] = 1
+            self.is_split = True
+            # Re-aggregate this round's model-0 uploads per new cluster
+            # (aggregate loop over cluster_indices, :148-185).
+            n0 = np.asarray(n)[0, : self.C]
+            for m_idx, cl in enumerate((c1, c2)):
+                wsum = n0[cl].sum()
+                if wsum <= 0:
+                    continue
+                wts = jnp.asarray(n0[cl] / wsum, jnp.float32)
+                def avg(leaf):
+                    sel = leaf[0][jnp.asarray(cl)]
+                    wb = wts.reshape((-1,) + (1,) * (sel.ndim - 1))
+                    return (sel * wb).sum(axis=0)
+                merged = jax.tree_util.tree_map(avg, client_params)
+                self.pool.set_slot(m_idx, merged)
+            self._sync_weights()
+        return self.pool.params
+
+    # ------------------------------------------------------------------
+    def test_model_idx(self, t: int) -> np.ndarray:
+        return self.assignment.copy()
+
+    def state_dict(self) -> dict:
+        return {"is_split": self.is_split, "assignment": self.assignment,
+                "eps1": self.eps1, "eps2": self.eps2, "max_eps1": self.max_eps1}
+
+    def load_state_dict(self, d: dict) -> None:
+        self.is_split = bool(d["is_split"])
+        self.assignment = np.asarray(d["assignment"], np.int64)
+        self.eps1, self.eps2 = float(d["eps1"]), float(d["eps2"])
+        self.max_eps1 = float(d["max_eps1"])

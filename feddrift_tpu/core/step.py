@@ -271,6 +271,26 @@ class TrainStep:
 
     # ------------------------------------------------------------------
     @partial(jax.jit, static_argnums=0)
+    def mse_matrix(self, params, x, y, feat_mask):
+        """Per-(model, client) Brier sums ``sum_n (1 - p_y(x_n))^2``.
+
+        Powers the AUE ensemble-weight formula ``1/(MSEr + MSEi + eps)``
+        (FedAvgEnsAggregatorAue.py:55-87, _mse at :219-234). x: [C, N, ...]
+        -> (mse_sum [M, C], total [C]).
+        """
+        def one(p_m, f_m):
+            def per_client(xc, yc):
+                xin = xc * f_m if xc.dtype != jnp.int32 else xc
+                probs = jax.nn.softmax(self.apply_fn(p_m, xin), axis=-1)
+                p_true = jnp.take_along_axis(probs, yc[:, None], axis=-1)[:, 0]
+                return ((1.0 - p_true) ** 2).sum()
+            return jax.vmap(per_client)(x, y)
+        mse_sum = jax.vmap(one)(params, feat_mask)
+        total = jnp.full((x.shape[0],), x.shape[1], dtype=jnp.int32)
+        return mse_sum, total
+
+    # ------------------------------------------------------------------
+    @partial(jax.jit, static_argnums=0)
     def confusion_matrices(self, params, x, y, feat_mask):
         """Per-(model, client) confusion matrices [M, C, K, K] (KUE kappa)."""
         K = self.num_classes

@@ -1,0 +1,9 @@
+"""Platform-parity subsystems inherited from the FedML base of the reference
+(SURVEY.md §2b): robust aggregation, decentralized topologies, server-side
+optimizers (FedOpt), secure aggregation primitives, hierarchical FL, and the
+split/vertical/knowledge-transfer training modes.
+
+These are interface-level capabilities of the reference platform that the
+FedDrift experiments don't exercise; here they are provided as TPU-idiomatic
+array programs composing with the same ``TrainStep``/mesh machinery.
+"""

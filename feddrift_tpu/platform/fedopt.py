@@ -1,0 +1,86 @@
+"""FedOpt: server-side optimizers applied to the aggregated pseudo-gradient.
+
+Re-design of the standalone FedOpt trainer + optimizer repository
+(fedml_api/standalone/fedopt/{fedopt_api.py,optrepo.py}): the reference
+reflects over ``torch.optim.Optimizer`` subclasses by name; here the registry
+maps the same lowercase names onto optax transforms. The server treats
+``global - weighted_avg(client)`` as a gradient and applies its optimizer —
+one jitted step over the whole pytree.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+import optax
+
+
+class OptRepo:
+    """Name -> optax constructor registry (optrepo.py:7-60 equivalent)."""
+
+    repo: dict[str, Callable[..., optax.GradientTransformation]] = {
+        "sgd": lambda lr=1.0, momentum=0.0, **kw: optax.sgd(lr, momentum=momentum),
+        "adam": lambda lr=1e-3, **kw: optax.adam(lr, **kw),
+        "adamw": lambda lr=1e-3, weight_decay=1e-2, **kw:
+            optax.adamw(lr, weight_decay=weight_decay),
+        "adagrad": lambda lr=1e-2, **kw: optax.adagrad(lr),
+        "yogi": lambda lr=1e-2, **kw: optax.yogi(lr),
+        "lamb": lambda lr=1e-3, **kw: optax.lamb(lr),
+        "rmsprop": lambda lr=1e-2, **kw: optax.rmsprop(lr),
+        "adamax": lambda lr=2e-3, **kw: optax.adamax(lr),
+        "sm3": lambda lr=1e-2, **kw: optax.sm3(lr),
+    }
+
+    @classmethod
+    def get_opt_names(cls) -> list[str]:
+        return sorted(cls.repo)
+
+    @classmethod
+    def name2cls(cls, name: str) -> Callable[..., optax.GradientTransformation]:
+        try:
+            return cls.repo[name.lower()]
+        except KeyError:
+            raise KeyError(f"Invalid optimizer: {name}! registered: "
+                           f"{cls.get_opt_names()}")
+
+
+class FedOptServer:
+    """Server optimizer state + one jitted FedOpt update.
+
+    update: g = global - sum_c w_c * client_c   (pseudo-gradient)
+            global <- opt.update(g)
+    (fedopt_api equivalent of Reddi et al. adaptive federated optimization.)
+    """
+
+    def __init__(self, name: str = "adam", **opt_kwargs) -> None:
+        self.optimizer = OptRepo.name2cls(name)(**opt_kwargs)
+        self.opt_state = None
+
+    def init(self, params) -> None:
+        self.opt_state = self.optimizer.init(params)
+
+    def step(self, global_params, client_params, n):
+        """client_params: [C, ...]; n: [C]. Returns new global params."""
+        if self.opt_state is None:
+            self.init(global_params)
+        new_params, self.opt_state = _fedopt_step(
+            self.optimizer, global_params, client_params, n, self.opt_state)
+        return new_params
+
+
+from functools import partial  # noqa: E402
+
+
+@partial(jax.jit, static_argnums=0)
+def _fedopt_step(optimizer, global_params, client_params, n, opt_state):
+    w = n / jnp.maximum(n.sum(), 1e-12)
+    def avg(leaf):
+        wb = w.reshape((-1,) + (1,) * (leaf.ndim - 1))
+        return (leaf * wb).sum(axis=0)
+    avg_params = jax.tree_util.tree_map(avg, client_params)
+    pseudo_grad = jax.tree_util.tree_map(lambda g, a: g - a,
+                                         global_params, avg_params)
+    updates, opt_state = optimizer.update(pseudo_grad, opt_state, global_params)
+    return optax.apply_updates(global_params, updates), opt_state

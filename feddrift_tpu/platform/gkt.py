@@ -1,0 +1,110 @@
+"""Group Knowledge Transfer (FedGKT): split training with bidirectional
+distillation.
+
+Re-design of fedml_api/distributed/fedgkt/ (clients run a small feature
+extractor + local classifier; the server runs a large CNN on the uploaded
+features; both sides distill from each other's logits with a
+KL-divergence + CE loss, GKTServerTrainer/GKTClientTrainer). The MPI
+feature/logit exchange becomes function composition: one jitted client step
+(CE + KL towards server logits) and one jitted server step (CE + KL towards
+client logits) sharing an activations tensor.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from feddrift_tpu.core.functional import cross_entropy
+
+
+def kl_divergence(student_logits, teacher_logits, temperature: float = 1.0):
+    """KL(teacher || student) on temperature-softened distributions
+    (fedgkt/utils KL_Loss)."""
+    t = temperature
+    p_teacher = jax.nn.softmax(teacher_logits / t, axis=-1)
+    log_p_student = jax.nn.log_softmax(student_logits / t, axis=-1)
+    log_p_teacher = jax.nn.log_softmax(teacher_logits / t, axis=-1)
+    return (p_teacher * (log_p_teacher - log_p_student)).sum(-1).mean() * t * t
+
+
+@dataclass(eq=False)
+class GktTrainer:
+    """client_extractor: (params, x) -> features
+    client_head:      (params, features) -> logits
+    server_apply:     (params, features) -> logits
+    """
+
+    client_extractor: Callable
+    client_head: Callable
+    server_apply: Callable
+    client_opt: optax.GradientTransformation
+    server_opt: optax.GradientTransformation
+    alpha: float = 1.0          # KL weight
+    temperature: float = 3.0
+
+    # ------------------------------------------------------------------
+    @partial(jax.jit, static_argnums=0)
+    def client_step(self, c_ext, c_head, opt_state, x, y, server_logits):
+        """Local step: CE + alpha * KL(server teacher) (GKTClientTrainer)."""
+        def loss_fn(ext, head):
+            feats = self.client_extractor(ext, x)
+            logits = self.client_head(head, feats)
+            ce = cross_entropy(logits, y)
+            kl = kl_divergence(logits, server_logits, self.temperature)
+            return ce + self.alpha * kl
+        loss, grads = jax.value_and_grad(loss_fn, argnums=(0, 1))(c_ext, c_head)
+        updates, opt_state = self.client_opt.update(grads, opt_state,
+                                                    (c_ext, c_head))
+        c_ext, c_head = optax.apply_updates((c_ext, c_head), updates)
+        return c_ext, c_head, opt_state, loss
+
+    # ------------------------------------------------------------------
+    @partial(jax.jit, static_argnums=0)
+    def server_step(self, s_params, opt_state, features, y, client_logits):
+        """Server step on uploaded features: CE + alpha * KL(client teacher)
+        (GKTServerTrainer train_large_model_on_the_server)."""
+        def loss_fn(sp):
+            logits = self.server_apply(sp, features)
+            return (cross_entropy(logits, y)
+                    + self.alpha * kl_divergence(logits, client_logits,
+                                                 self.temperature))
+        loss, grads = jax.value_and_grad(loss_fn)(s_params)
+        updates, opt_state = self.server_opt.update(grads, opt_state, s_params)
+        return optax.apply_updates(s_params, updates), opt_state, loss
+
+    # ------------------------------------------------------------------
+    @partial(jax.jit, static_argnums=0)
+    def extract(self, c_ext, x):
+        return self.client_extractor(c_ext, x)
+
+    @partial(jax.jit, static_argnums=0)
+    def server_logits(self, s_params, features):
+        return self.server_apply(s_params, features)
+
+    @partial(jax.jit, static_argnums=0)
+    def client_logits(self, c_ext, c_head, x):
+        return self.client_head(c_head, self.client_extractor(c_ext, x))
+
+    # ------------------------------------------------------------------
+    def alternating_round(self, c_ext, c_head, c_opt, s_params, s_opt, x, y,
+                          client_epochs: int = 1, server_epochs: int = 1):
+        """One GKT round: client trains with the server's current logits as
+        teacher, uploads features+logits, server trains with client logits as
+        teacher (the fedgkt message loop collapsed)."""
+        feats = self.extract(c_ext, x)
+        s_logits = self.server_logits(s_params, feats)
+        for _ in range(client_epochs):
+            c_ext, c_head, c_opt, c_loss = self.client_step(
+                c_ext, c_head, c_opt, x, y, s_logits)
+        feats = self.extract(c_ext, x)
+        c_logits = self.client_logits(c_ext, c_head, x)
+        for _ in range(server_epochs):
+            s_params, s_opt, s_loss = self.server_step(
+                s_params, s_opt, feats, y, c_logits)
+        return c_ext, c_head, c_opt, s_params, s_opt, float(c_loss), float(s_loss)

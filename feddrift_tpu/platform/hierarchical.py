@@ -1,0 +1,71 @@
+"""Hierarchical (cloud-edge-client) FL and decentralized online learners.
+
+Re-design of fedml_api/standalone/hierarchical_fl/trainer.py (groups of
+clients average per-edge every ``group_comm_round`` rounds, edges average
+globally) and fedml_api/standalone/decentralized/{client_dsgd,
+client_pushsum}.py (online gossip learners over a topology).
+
+On TPU the group structure is a [C] -> group-id map and both averaging
+levels are segment-sum reductions — one program, no edge processes.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+
+@partial(jax.jit, static_argnames=("num_groups",))
+def group_average(client_params, n, group_ids, num_groups: int):
+    """Per-group weighted average (the edge aggregation).
+
+    client_params: [C, ...] pytree; n: [C]; group_ids: [C] int.
+    Returns ([G, ...] group params, [G] group weights).
+    """
+    seg_n = jax.ops.segment_sum(n, group_ids, num_segments=num_groups)
+    def avg(leaf):
+        wb = n.reshape((-1,) + (1,) * (leaf.ndim - 1))
+        seg = jax.ops.segment_sum(leaf * wb, group_ids,
+                                  num_segments=num_groups)
+        return seg / jnp.maximum(seg_n.reshape((-1,) + (1,) * (leaf.ndim - 1)),
+                                 1e-12)
+    return jax.tree_util.tree_map(avg, client_params), seg_n
+
+
+@partial(jax.jit, static_argnames=())
+def global_average(group_params, group_n):
+    """Cloud aggregation over edge groups (trainer.py global round)."""
+    w = group_n / jnp.maximum(group_n.sum(), 1e-12)
+    def avg(leaf):
+        wb = w.reshape((-1,) + (1,) * (leaf.ndim - 1))
+        return (leaf * wb).sum(axis=0)
+    return jax.tree_util.tree_map(avg, group_params)
+
+
+def scatter_groups(group_params, group_ids):
+    """Broadcast each group's params back to its clients: [G, ...] -> [C, ...]."""
+    return jax.tree_util.tree_map(lambda leaf: leaf[group_ids], group_params)
+
+
+class HierarchicalSchedule:
+    """Round cadence of hierarchical_fl/trainer.py: every round ends with an
+    edge (group) average; every ``global_period`` rounds the edges average
+    globally."""
+
+    def __init__(self, num_groups: int, group_ids, global_period: int) -> None:
+        self.num_groups = num_groups
+        self.group_ids = jnp.asarray(group_ids)
+        self.global_period = global_period
+
+    def end_of_round(self, client_params, n, round_idx: int):
+        g_params, g_n = group_average(client_params, n, self.group_ids,
+                                      self.num_groups)
+        if (round_idx + 1) % self.global_period == 0:
+            g = global_average(g_params, g_n)
+            g_params = jax.tree_util.tree_map(
+                lambda leaf: jnp.broadcast_to(leaf[None],
+                                              (self.num_groups, *leaf.shape)),
+                g)
+        return scatter_groups(g_params, self.group_ids)

@@ -1,0 +1,182 @@
+"""Secure-aggregation primitives: finite-field Shamir/BGW and Lagrange-coded
+(LCC) encode/decode, plus additive secret sharing and fixed-point
+quantization.
+
+Re-design of TurboAggregate's MPC toolbox
+(fedml_api/distributed/turboaggregate/mpc_function.py:4-271). The reference
+computes polynomial evaluations with Python triple loops over int64 numpy;
+here encoding/decoding are Vandermonde/Lagrange *matrix products* in the
+field — `mod p` matmuls that vectorise over the share dimension (and run on
+TPU as int32 lanes when the field fits).
+
+Field: default prime 2^31 - 1 (Mersenne), int64 accumulation on host.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+P_DEFAULT = np.int64(2**31 - 1)
+
+
+# ----------------------------------------------------------------------
+# modular arithmetic
+def modular_inv(a: np.ndarray, p: np.int64 = P_DEFAULT) -> np.ndarray:
+    """Vectorised a^{-1} mod p via Fermat (p prime): a^(p-2) mod p
+    (reference iterative extended-Euclid, mpc_function.py:4-18)."""
+    a = np.mod(np.asarray(a, dtype=np.int64), p)
+    result = np.ones_like(a)
+    base = a.copy()
+    e = int(p - 2)
+    while e > 0:
+        if e & 1:
+            result = np.mod(result * base % p, p)
+        base = np.mod(base * base % p, p)
+        e >>= 1
+    return result
+
+
+def field_divmod(num, den, p: np.int64 = P_DEFAULT):
+    """num / den mod p (divmod, mpc_function.py:21-27)."""
+    return np.mod(np.mod(num, p) * modular_inv(den, p), p)
+
+
+def _matmul_mod(A: np.ndarray, B: np.ndarray, p: np.int64) -> np.ndarray:
+    """Exact int64 modular matmul, chunked so products never overflow."""
+    A = np.mod(A, p).astype(np.int64)
+    B = np.mod(B, p).astype(np.int64)
+    # Split B's values into hi/lo 16-bit halves so A@B stays < 2^63.
+    lo = B & 0xFFFF
+    hi = B >> 16
+    out = (A @ lo) % p + (((A @ hi) % p) << 16)
+    return np.mod(out, p)
+
+
+def gen_lagrange_coeffs(alpha_s, beta_s, p: np.int64 = P_DEFAULT) -> np.ndarray:
+    """U[i, j] = prod_{k!=j} (alpha_i - beta_k) / (beta_j - beta_k) mod p
+    (gen_Lagrange_coeffs, mpc_function.py:39-59)."""
+    alpha_s = np.mod(np.asarray(alpha_s, np.int64), p)
+    beta_s = np.mod(np.asarray(beta_s, np.int64), p)
+    A, B = len(alpha_s), len(beta_s)
+    U = np.zeros((A, B), dtype=np.int64)
+    for j in range(B):
+        others = np.delete(beta_s, j)
+        den = np.int64(1)
+        for o in others:
+            den = np.mod(den * np.mod(beta_s[j] - o, p), p)
+        num = np.ones(A, dtype=np.int64)
+        for o in others:
+            num = np.mod(num * np.mod(alpha_s - o, p), p)
+        U[:, j] = field_divmod(num, den, p)
+    return U
+
+
+# ----------------------------------------------------------------------
+# BGW (Shamir) sharing
+def bgw_encode(X: np.ndarray, N: int, T: int, p: np.int64 = P_DEFAULT,
+               rng: np.random.Generator | None = None) -> np.ndarray:
+    """[m, d] secret -> [N, m, d] degree-T Shamir shares at alpha=1..N
+    (BGW_encoding, mpc_function.py:62-76)."""
+    rng = rng or np.random.default_rng()
+    X = np.mod(np.asarray(X, np.int64), p)
+    m, d = X.shape
+    R = rng.integers(0, int(p), size=(T + 1, m, d), dtype=np.int64)
+    R[0] = X
+    alpha = np.arange(1, N + 1, dtype=np.int64) % p
+    # Vandermonde [N, T+1] @ coeffs [T+1, m*d]
+    V = np.ones((N, T + 1), dtype=np.int64)
+    for t in range(1, T + 1):
+        V[:, t] = np.mod(V[:, t - 1] * alpha, p)
+    shares = _matmul_mod(V, R.reshape(T + 1, m * d), p)
+    return shares.reshape(N, m, d)
+
+
+def bgw_decode(f_eval: np.ndarray, worker_idx, p: np.int64 = P_DEFAULT) -> np.ndarray:
+    """Reconstruct the secret from >= T+1 shares (BGW_decoding,
+    mpc_function.py:90-108). f_eval: [RT, d]; worker_idx 0-based."""
+    worker_idx = np.asarray(worker_idx)
+    alpha_eval = (worker_idx + 1).astype(np.int64) % p
+    lam = gen_lagrange_coeffs(np.zeros(1, np.int64), alpha_eval, p)  # eval at 0
+    return _matmul_mod(lam, np.asarray(f_eval, np.int64), p)
+
+
+# ----------------------------------------------------------------------
+# LCC (Lagrange coded computing)
+def lcc_encode(X: np.ndarray, N: int, K: int, T: int,
+               p: np.int64 = P_DEFAULT,
+               rng: np.random.Generator | None = None) -> np.ndarray:
+    """[m, d] -> [N, m//K, d] Lagrange-coded shares (LCC_encoding,
+    mpc_function.py:111-134): data split into K chunks + T random chunks,
+    interpolated at beta=1..K+T, evaluated at alpha=K+T+1..K+T+N."""
+    rng = rng or np.random.default_rng()
+    X = np.mod(np.asarray(X, np.int64), p)
+    m, d = X.shape
+    assert m % K == 0, (m, K)
+    chunk = m // K
+    X_sub = np.zeros((K + T, chunk, d), dtype=np.int64)
+    for i in range(K):
+        X_sub[i] = X[i * chunk: (i + 1) * chunk]
+    for i in range(K, K + T):
+        X_sub[i] = rng.integers(0, int(p), size=(chunk, d), dtype=np.int64)
+    beta = np.arange(1, K + T + 1, dtype=np.int64)
+    alpha = np.arange(K + T + 1, K + T + N + 1, dtype=np.int64)
+    U = gen_lagrange_coeffs(alpha, beta, p)              # [N, K+T]
+    enc = _matmul_mod(U, X_sub.reshape(K + T, chunk * d), p)
+    return enc.reshape(N, chunk, d)
+
+
+def lcc_decode(f_eval: np.ndarray, worker_idx, K: int, T: int, N: int,
+               p: np.int64 = P_DEFAULT) -> np.ndarray:
+    """Invert lcc_encode from K+T shares for a *linear* f (degree 1)
+    (LCC_decoding, mpc_function.py:195-211): interpolate back to the K data
+    points. f_eval: [RT, chunk, d]."""
+    worker_idx = np.asarray(worker_idx)
+    beta = np.arange(1, K + T + 1, dtype=np.int64)
+    alpha_eval = (K + T + 1 + worker_idx).astype(np.int64)
+    U = gen_lagrange_coeffs(beta[:K], alpha_eval, p)     # [K, RT]
+    flat = np.asarray(f_eval, np.int64).reshape(len(worker_idx), -1)
+    dec = _matmul_mod(U, flat, p)
+    return dec.reshape((K,) + f_eval.shape[1:])
+
+
+def gen_additive_ss(d: int, n_out: int, p: np.int64 = P_DEFAULT,
+                    rng: np.random.Generator | None = None) -> np.ndarray:
+    """[n_out, d] additive shares of zero (Gen_Additive_SS,
+    mpc_function.py:214-224)."""
+    rng = rng or np.random.default_rng()
+    shares = rng.integers(0, int(p), size=(n_out, d), dtype=np.int64)
+    shares[-1] = np.mod(-shares[:-1].sum(axis=0), p)
+    return shares
+
+
+# ----------------------------------------------------------------------
+# fixed-point bridging (floats <-> field)
+def quantize(x: np.ndarray, scale: int = 2**16,
+             p: np.int64 = P_DEFAULT) -> np.ndarray:
+    """Map floats to field elements, negatives wrapped to [p/2, p)."""
+    q = np.round(np.asarray(x, np.float64) * scale).astype(np.int64)
+    return np.mod(q, p)
+
+
+def dequantize(q: np.ndarray, scale: int = 2**16,
+               p: np.int64 = P_DEFAULT) -> np.ndarray:
+    q = np.asarray(q, np.int64)
+    signed = np.where(q > p // 2, q - p, q)
+    return signed.astype(np.float64) / scale
+
+
+def secure_sum(client_vectors: np.ndarray, T: int = 1,
+               p: np.int64 = P_DEFAULT,
+               rng: np.random.Generator | None = None) -> np.ndarray:
+    """End-to-end demo of the TurboAggregate flow for a float sum: quantize,
+    BGW-share each client's vector, sum shares (the linear secure op),
+    reconstruct from T+1 shares, dequantize."""
+    rng = rng or np.random.default_rng(0)
+    C, d = client_vectors.shape
+    N = max(2 * T + 1, 3)
+    share_sum = np.zeros((N, 1, d), dtype=np.int64)
+    for c in range(C):
+        shares = bgw_encode(quantize(client_vectors[c])[None, :], N, T, p, rng)
+        share_sum = np.mod(share_sum + shares, p)
+    dec = bgw_decode(share_sum[: T + 1, 0, :], np.arange(T + 1), p)
+    return dequantize(dec[0], p=p)

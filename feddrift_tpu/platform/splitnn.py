@@ -1,0 +1,104 @@
+"""Split learning: client-side bottom segment + server-side top segment.
+
+Re-design of the SplitNN subsystem (fedml_api/distributed/split_nn/: clients
+forward activations to the server over MPI, receive activation grads back,
+and relay model weights around a client ring, client.py:24-41, server.py).
+On TPU the activation/grad exchange IS function composition inside one jitted
+step — the process boundary disappears but the *parameter isolation* is kept:
+client and server segments have separate param trees and optimizers, and the
+ring-relay semantics (one client active per epoch, weights passed on) become
+an index into a stacked [C] client-segment pool.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from feddrift_tpu.core.functional import cross_entropy
+
+
+@dataclass(eq=False)
+class SplitNNTrainer:
+    """One client segment + one server segment trained jointly.
+
+    client_apply: (client_params, x) -> activations
+    server_apply: (server_params, activations) -> logits
+    """
+
+    client_apply: Callable
+    server_apply: Callable
+    client_opt: optax.GradientTransformation
+    server_opt: optax.GradientTransformation
+
+    def init_states(self, client_params, server_params):
+        return (self.client_opt.init(client_params),
+                self.server_opt.init(server_params))
+
+    # ------------------------------------------------------------------
+    @partial(jax.jit, static_argnums=0)
+    def train_step(self, client_params, server_params, c_opt, s_opt, x, y):
+        """Forward through both segments, backprop across the cut.
+
+        The reference's two-process act/grad exchange
+        (client.forward_pass/backward_pass, client.py:24-35; server
+        backward) is the chain rule applied across the segment boundary —
+        here jax.grad w.r.t. both trees in one program.
+        """
+        def loss_fn(cp, sp):
+            acts = self.client_apply(cp, x)
+            logits = self.server_apply(sp, acts)
+            return cross_entropy(logits, y)
+
+        loss, (g_c, g_s) = jax.value_and_grad(loss_fn, argnums=(0, 1))(
+            client_params, server_params)
+        up_c, c_opt = self.client_opt.update(g_c, c_opt, client_params)
+        up_s, s_opt = self.server_opt.update(g_s, s_opt, server_params)
+        return (optax.apply_updates(client_params, up_c),
+                optax.apply_updates(server_params, up_s),
+                c_opt, s_opt, loss)
+
+    # ------------------------------------------------------------------
+    @partial(jax.jit, static_argnums=0)
+    def eval_step(self, client_params, server_params, x, y):
+        logits = self.server_apply(server_params,
+                                   self.client_apply(client_params, x))
+        return (logits.argmax(-1) == y).mean()
+
+    # ------------------------------------------------------------------
+    def train_ring(self, client_params, server_params, c_opt, s_opt,
+                   data_per_client, epochs_per_client: int = 1):
+        """Ring relay (client.py:12-13 node_left/right): client c trains for
+        its epochs starting from the weights client c-1 left behind, exactly
+        the reference's weight hand-off, then passes on."""
+        losses = []
+        for xc, yc in data_per_client:
+            for _ in range(epochs_per_client):
+                client_params, server_params, c_opt, s_opt, loss = \
+                    self.train_step(client_params, server_params,
+                                    c_opt, s_opt, xc, yc)
+            losses.append(float(loss))
+        return client_params, server_params, c_opt, s_opt, losses
+
+
+def make_split_mlp(hidden: int, num_classes: int):
+    """A reference-style FNN split at the hidden layer: client owns the
+    feature extractor, server owns the classifier head."""
+    import flax.linen as nn
+
+    class Bottom(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            return nn.relu(nn.Dense(hidden)(x))
+
+    class Top(nn.Module):
+        @nn.compact
+        def __call__(self, acts):
+            return nn.Dense(num_classes)(acts)
+
+    return Bottom(), Top()

@@ -1,0 +1,88 @@
+"""Classical vertical FL: one guest (labels + partial features) + hosts.
+
+Re-design of the two/three-party VFL subsystem
+(fedml_api/distributed/classical_vertical_fl/{vfl_api,guest_trainer,
+host_trainer}.py and fedml_api/standalone/classical_vertical_fl/vfl.py:
+hosts send logit *components*; the guest sums them with its own component,
+computes the loss, and broadcasts the common gradient back,
+vfl.py:22-50). Here the component exchange is function composition inside
+one jitted step, but the party boundary is preserved exactly where it
+matters for the protocol: each party owns a separate param tree, and the
+hosts' backward uses ONLY the common gradient d(loss)/d(sum_logits) — the
+same information the wire protocol carries — never the guest's labels.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+from typing import Callable, Sequence
+
+import jax
+import jax.numpy as jnp
+import optax
+
+
+@dataclass(eq=False)
+class VflTrainer:
+    """Guest + N hosts, each a (params, x) -> logit-component function."""
+
+    guest_apply: Callable
+    host_applies: Sequence[Callable]
+    optimizer: optax.GradientTransformation
+
+    def init_states(self, guest_params, host_params_list):
+        return (self.optimizer.init(guest_params),
+                [self.optimizer.init(hp) for hp in host_params_list])
+
+    # ------------------------------------------------------------------
+    @partial(jax.jit, static_argnums=0)
+    def train_step(self, guest_params, host_params_list, g_opt, h_opts,
+                   x_guest, x_hosts, y):
+        """One VFL fit step (vfl.py fit: component sum -> guest loss ->
+        common grad -> host updates)."""
+        def total_logits(gp, hps):
+            comp = self.guest_apply(gp, x_guest)
+            for apply_fn, hp, xh in zip(self.host_applies, hps, x_hosts):
+                comp = comp + apply_fn(hp, xh)
+            return comp
+
+        def loss_fn(gp, hps):
+            logits = total_logits(gp, hps)
+            # binary logistic loss on the summed component (guest_trainer)
+            p = jax.nn.log_sigmoid(logits[:, 0])
+            notp = jax.nn.log_sigmoid(-logits[:, 0])
+            return -(y * p + (1 - y) * notp).mean()
+
+        loss, (g_g, g_hs) = jax.value_and_grad(loss_fn, argnums=(0, 1))(
+            guest_params, list(host_params_list))
+        up, g_opt = self.optimizer.update(g_g, g_opt, guest_params)
+        new_guest = optax.apply_updates(guest_params, up)
+        new_hosts, new_h_opts = [], []
+        for hp, gh, ho in zip(host_params_list, g_hs, h_opts):
+            u, ho = self.optimizer.update(gh, ho, hp)
+            new_hosts.append(optax.apply_updates(hp, u))
+            new_h_opts.append(ho)
+        return new_guest, new_hosts, g_opt, new_h_opts, loss
+
+    # ------------------------------------------------------------------
+    @partial(jax.jit, static_argnums=0)
+    def predict(self, guest_params, host_params_list, x_guest, x_hosts):
+        comp = self.guest_apply(guest_params, x_guest)
+        for apply_fn, hp, xh in zip(self.host_applies, host_params_list,
+                                    x_hosts):
+            comp = comp + apply_fn(hp, xh)
+        return jax.nn.sigmoid(comp[:, 0])
+
+
+def make_linear_party(in_dim: int):
+    """Reference party model: a linear logit component (model/finance/
+    vfl_models_standalone.py LocalModel equivalents)."""
+    import flax.linen as nn
+
+    class Party(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            return nn.Dense(1)(x)
+
+    return Party()

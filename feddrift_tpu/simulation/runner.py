@@ -83,6 +83,8 @@ class Experiment:
         self.algo.bind(self.x, self.y, self.logger, self.C_pad)
         self.key = experiment_key(cfg.seed)
         self.global_round = 0
+        self.start_iteration = 0
+        self.out_dir = out_dir
 
     # ------------------------------------------------------------------
     def evaluate(self, t: int, round_idx: int) -> dict:
@@ -101,10 +103,11 @@ class Experiment:
         loss_sum = np.asarray(loss_sum)[:, :C]
         total = np.asarray(total)[:C]
 
+        tidx = self.algo.train_model_idx(t)                    # [C]
         idx = self.algo.test_model_idx(t)                      # [C]
         cr = np.arange(self.C_)
-        train_correct = correct[idx, cr]
-        train_loss = loss_sum[idx, cr]
+        train_correct = correct[tidx, cr]
+        train_loss = loss_sum[tidx, cr]
 
         spec = self.algo.ensemble_spec(t)
         if spec is None:
@@ -179,13 +182,44 @@ class Experiment:
             self.global_round += 1
 
         self.algo.end_iteration(t)
+        if self.cfg.checkpoint_every_iteration and self.out_dir:
+            self.save_checkpoint(t)
         log.info("iteration %d done in %.1fs (Test/Acc=%.4f)", t,
                  time.time() - t0, self.logger.last("Test/Acc", -1))
 
     def run(self) -> MetricsLogger:
-        for t in range(self.cfg.train_iterations):
+        for t in range(self.start_iteration, self.cfg.train_iterations):
             self.run_iteration(t)
         return self.logger
+
+    # ------------------------------------------------------------------
+    # checkpoint / resume (iteration-granular, like the reference's CWD state
+    # files but atomic and single-directory; SURVEY.md §5)
+    def ckpt_path(self) -> str:
+        import os
+        return os.path.join(self.out_dir or self.cfg.out_dir, "ckpt")
+
+    def save_checkpoint(self, completed_iteration: int) -> None:
+        from feddrift_tpu.utils.checkpoint import save_checkpoint
+        save_checkpoint(
+            self.ckpt_path(), config_json=self.cfg.to_json(),
+            iteration=completed_iteration, global_round=self.global_round,
+            pool_params=self.pool.params, algo_state=self.algo.state_dict())
+
+    @classmethod
+    def resume(cls, cfg: ExperimentConfig, out_dir: str, mesh=None,
+               use_wandb: bool = False) -> "Experiment":
+        """Rebuild an Experiment and continue after the last completed
+        iteration recorded in ``out_dir``'s checkpoint."""
+        import os
+        from feddrift_tpu.utils.checkpoint import load_checkpoint
+        exp = cls(cfg, mesh=mesh, use_wandb=use_wandb, out_dir=out_dir)
+        state = load_checkpoint(os.path.join(out_dir, "ckpt"), exp.pool.params)
+        exp.pool.params = state["pool_params"]
+        exp.algo.load_state_dict(state["algo_state"])
+        exp.global_round = state["global_round"]
+        exp.start_iteration = state["iteration"] + 1
+        return exp
 
 
 def run_experiment(cfg: ExperimentConfig, mesh=None, use_wandb: bool = False,

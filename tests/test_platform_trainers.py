@@ -1,0 +1,102 @@
+"""Vertical FL, hierarchical FL, and GKT trainer tests."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+
+class TestVerticalFL:
+    def test_two_party_learns_split_features(self):
+        from feddrift_tpu.platform.vertical import VflTrainer, make_linear_party
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(128, 6)).astype(np.float32)
+        y = ((x[:, 0] + x[:, 4]) > 0).astype(np.float32)
+        xg, xh = jnp.asarray(x[:, :3]), jnp.asarray(x[:, 3:])
+
+        guest, host = make_linear_party(3), make_linear_party(3)
+        gp = guest.init(jax.random.PRNGKey(0), xg[:2])["params"]
+        hp = host.init(jax.random.PRNGKey(1), xh[:2])["params"]
+        tr = VflTrainer(
+            guest_apply=lambda p, xx: guest.apply({"params": p}, xx),
+            host_applies=[lambda p, xx: host.apply({"params": p}, xx)],
+            optimizer=optax.sgd(0.5))
+        g_opt, h_opts = tr.init_states(gp, [hp])
+        for _ in range(100):
+            gp, hps, g_opt, h_opts, loss = tr.train_step(
+                gp, [hp], g_opt, h_opts, xg, [xh], jnp.asarray(y))
+            hp = hps[0]
+        preds = tr.predict(gp, [hp], xg, [xh])
+        acc = float(((np.asarray(preds) > 0.5) == y).mean())
+        assert acc > 0.9, acc
+
+
+class TestHierarchical:
+    def test_group_then_global_average(self):
+        from feddrift_tpu.platform.hierarchical import (HierarchicalSchedule,
+                                                        group_average,
+                                                        global_average)
+        params = {"w": jnp.arange(8.0)[:, None] * jnp.ones((8, 2))}
+        n = jnp.ones((8,))
+        gids = jnp.asarray([0, 0, 0, 0, 1, 1, 1, 1])
+        gp, gn = group_average(params, n, gids, 2)
+        np.testing.assert_allclose(np.asarray(gp["w"][0]), 1.5)
+        np.testing.assert_allclose(np.asarray(gp["w"][1]), 5.5)
+        g = global_average(gp, gn)
+        np.testing.assert_allclose(np.asarray(g["w"]), 3.5)
+
+        sched = HierarchicalSchedule(2, gids, global_period=2)
+        out = sched.end_of_round(params, n, round_idx=0)   # group-only round
+        np.testing.assert_allclose(np.asarray(out["w"][0]), 1.5)
+        out = sched.end_of_round(params, n, round_idx=1)   # global round
+        np.testing.assert_allclose(np.asarray(out["w"][7]), 3.5)
+
+
+class TestGkt:
+    def test_bidirectional_distillation_learns(self):
+        import flax.linen as nn
+        from feddrift_tpu.platform.gkt import GktTrainer, kl_divergence
+
+        class Ext(nn.Module):
+            @nn.compact
+            def __call__(self, x):
+                return nn.relu(nn.Dense(8)(x))
+
+        class Head(nn.Module):
+            @nn.compact
+            def __call__(self, f):
+                return nn.Dense(2)(f)
+
+        class Server(nn.Module):
+            @nn.compact
+            def __call__(self, f):
+                return nn.Dense(2)(nn.relu(nn.Dense(16)(f)))
+
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(64, 4)).astype(np.float32)
+        y = (x[:, 0] - x[:, 2] > 0).astype(np.int32)
+        ext, head, srv = Ext(), Head(), Server()
+        pe = ext.init(jax.random.PRNGKey(0), x[:2])["params"]
+        f2 = ext.apply({"params": pe}, x[:2])
+        ph = head.init(jax.random.PRNGKey(1), f2)["params"]
+        ps = srv.init(jax.random.PRNGKey(2), f2)["params"]
+
+        tr = GktTrainer(
+            client_extractor=lambda p, xx: ext.apply({"params": p}, xx),
+            client_head=lambda p, f: head.apply({"params": p}, f),
+            server_apply=lambda p, f: srv.apply({"params": p}, f),
+            client_opt=optax.sgd(0.3), server_opt=optax.sgd(0.3))
+        c_opt = tr.client_opt.init((pe, ph))
+        s_opt = tr.server_opt.init(ps)
+        for _ in range(30):
+            pe, ph, c_opt, ps, s_opt, cl, sl = tr.alternating_round(
+                pe, ph, c_opt, ps, s_opt, jnp.asarray(x), jnp.asarray(y))
+        logits = tr.server_logits(ps, tr.extract(pe, jnp.asarray(x)))
+        acc = float((np.asarray(logits).argmax(-1) == y).mean())
+        assert acc > 0.85, acc
+
+    def test_kl_zero_for_identical(self):
+        from feddrift_tpu.platform.gkt import kl_divergence
+        logits = jnp.asarray(np.random.default_rng(0).normal(size=(8, 5)),
+                             jnp.float32)
+        assert float(kl_divergence(logits, logits)) < 1e-6
