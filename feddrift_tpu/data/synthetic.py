@@ -69,23 +69,42 @@ def generate_synthetic(
     noise_prob: float = 0.0,
     time_stretch: int = 1,
     seed: int = 0,
+    backend: str | None = None,
 ) -> DriftDataset:
     """Generate a full ``[C, T+1, N, F]`` drifting dataset.
 
     Step T (the extra slot) is the held-out test step for training step T-1,
     mirroring the reference's generation of ``train_iteration + 1`` per-step
     files (sea/data_loader.py:69).
+
+    ``backend``: 'numpy' (default) or 'native' — the threaded C++ kernel
+    (feddrift_tpu/native/drift_gen.cpp), same label rules, its own
+    deterministic per-cell RNG streams. Env FEDDRIFT_NATIVE_DATA=1 makes
+    native the default when the library builds.
     """
     sampler, fdim, n_classes, n_concepts = _SAMPLERS[name]
     if int(change_points.max()) >= n_concepts:
         raise ValueError(
             f"change-point matrix references concept {int(change_points.max())} "
             f"but dataset {name!r} defines only {n_concepts} concepts")
-    rng = np.random.default_rng(seed)
     T = train_iterations
+    concepts = concept_matrix(change_points, T + 1, num_clients, time_stretch)
+
+    if backend is None:
+        import os
+        backend = "native" if os.environ.get("FEDDRIFT_NATIVE_DATA") == "1" \
+            else "numpy"
+    if backend == "native":
+        from feddrift_tpu import native
+        if native.available():
+            x, y = native.generate(name, concepts, sample_num, noise_prob, seed)
+            return DriftDataset(x=x, y=y, num_classes=n_classes,
+                                concepts=concepts, name=name)
+        backend = "numpy"   # graceful fallback
+
+    rng = np.random.default_rng(seed)
     x = np.zeros((num_clients, T + 1, sample_num, fdim), dtype=np.float32)
     y = np.zeros((num_clients, T + 1, sample_num), dtype=np.int32)
-    concepts = concept_matrix(change_points, T + 1, num_clients, time_stretch)
     for t in range(T + 1):
         for c in range(num_clients):
             concept = int(concepts[t, c])

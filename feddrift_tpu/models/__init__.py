@@ -77,6 +77,14 @@ def _resnet18(ds: DriftDataset, cfg) -> nn.Module:
     return ResNet18(num_classes=ds.num_classes)
 
 
+@register_model("transformer")
+def _transformer(ds: DriftDataset, cfg) -> nn.Module:
+    from feddrift_tpu.models.transformer import TransformerLM
+    return TransformerLM(vocab_size=ds.num_classes,
+                         max_len=max(ds.feature_shape[0]
+                                     if ds.is_sequence else 128, 128))
+
+
 @register_model("rnn")
 def _rnn(ds: DriftDataset, cfg) -> nn.Module:
     return CharLSTM(vocab_size=ds.num_classes)

@@ -1,0 +1,144 @@
+// Native drift-data generator: the host-side data pipeline of the framework.
+//
+// The reference generates its drift data by writing one CSV per
+// (client, time step) from single-threaded Python and re-reading the files in
+// every MPI process (fedml_api/data_preprocessing/sea/data_loader.py:37-99,
+// prepare_data.py). Here generation is an in-memory, multi-threaded C++
+// kernel filling the framework's dense [C, T1, N, F] arrays directly — no
+// files, no serialization, deterministic per (seed, client, step) cell
+// regardless of thread count.
+//
+// Exposed via a plain C ABI consumed with ctypes
+// (feddrift_tpu/native/__init__.py). Datasets: SEA / SINE / CIRCLE with the
+// same label rules as the numpy path (feddrift_tpu/data/synthetic.py).
+//
+// Build: make -C feddrift_tpu/native   (g++ -O3 -shared -fPIC)
+
+#include <cstdint>
+#include <cmath>
+#include <cstring>
+#include <thread>
+#include <vector>
+
+namespace {
+
+// ---------------------------------------------------------------------
+// Deterministic counter-based RNG: splitmix64 streams keyed by
+// (seed, client, step). Threading cannot change the output.
+struct SplitMix64 {
+  uint64_t state;
+  explicit SplitMix64(uint64_t s) : state(s) {}
+  uint64_t next() {
+    uint64_t z = (state += 0x9E3779B97f4A7C15ULL);
+    z = (z ^ (z >> 30)) * 0xBF58476D1CE4E5B9ULL;
+    z = (z ^ (z >> 27)) * 0x94D049BB133111EBULL;
+    return z ^ (z >> 31);
+  }
+  // uniform in [0, 1)
+  double uniform() { return (next() >> 11) * (1.0 / 9007199254740992.0); }
+};
+
+inline uint64_t cell_seed(uint64_t seed, int64_t c, int64_t t) {
+  // one multiply-xor mix per coordinate; distinct streams per cell
+  uint64_t h = seed ^ 0xD6E8FEB86659FD93ULL;
+  h ^= (uint64_t)(c + 1) * 0xA24BAED4963EE407ULL;
+  h ^= (h >> 33);
+  h ^= (uint64_t)(t + 1) * 0x9FB21C651E98DF25ULL;
+  h ^= (h >> 29);
+  return h;
+}
+
+constexpr double kSeaThresholds[4] = {8.0, 9.0, 7.0, 9.5};
+constexpr double kSeaBaseNoise = 0.1;
+
+enum Dataset { SEA = 0, SINE = 1, CIRCLE = 2 };
+
+void fill_cell(Dataset ds, float* x, int32_t* y, int64_t n, int concept,
+               double noise_prob, uint64_t cseed) {
+  SplitMix64 rng(cseed);
+  switch (ds) {
+    case SEA: {
+      for (int64_t i = 0; i < n; ++i) {
+        float f0 = (float)(rng.uniform() * 10.0);
+        float f1 = (float)(rng.uniform() * 10.0);
+        float f2 = (float)(rng.uniform() * 10.0);
+        x[i * 3 + 0] = f0;
+        x[i * 3 + 1] = f1;
+        x[i * 3 + 2] = f2;
+        int32_t label = (f1 + f2 > kSeaThresholds[concept & 3]) ? 1 : 0;
+        if (rng.uniform() < kSeaBaseNoise) label = 1 - label;
+        y[i] = label;
+      }
+      break;
+    }
+    case SINE: {
+      for (int64_t i = 0; i < n; ++i) {
+        float f0 = (float)rng.uniform();
+        float f1 = (float)rng.uniform();
+        x[i * 2 + 0] = f0;
+        x[i * 2 + 1] = f1;
+        bool below = f1 <= std::sin(f0);
+        y[i] = (concept == 0) ? (below ? 1 : 0) : (below ? 0 : 1);
+      }
+      break;
+    }
+    case CIRCLE: {
+      for (int64_t i = 0; i < n; ++i) {
+        float f0 = (float)rng.uniform();
+        float f1 = (float)rng.uniform();
+        x[i * 2 + 0] = f0;
+        x[i * 2 + 1] = f1;
+        double cx = concept == 0 ? 0.2 : 0.6;
+        double cy = 0.5;
+        double r = concept == 0 ? 0.15 : 0.25;
+        double z = (f0 - cx) * (f0 - cx) + (f1 - cy) * (f1 - cy) - r * r;
+        y[i] = z > 0.0 ? 1 : 0;
+      }
+      break;
+    }
+  }
+  if (noise_prob > 0.0) {
+    for (int64_t i = 0; i < n; ++i) {
+      if (rng.uniform() < noise_prob) y[i] = 1 - y[i];
+    }
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+// x: [C, T1, N, F] float32; y: [C, T1, N] int32; concepts: [T1, C] int32.
+// Returns 0 on success, -1 on unknown dataset.
+int fd_generate(int dataset, float* x, int32_t* y, const int32_t* concepts,
+                int64_t C, int64_t T1, int64_t N, double noise_prob,
+                uint64_t seed, int n_threads) {
+  if (dataset < 0 || dataset > 2) return -1;
+  Dataset ds = (Dataset)dataset;
+  int64_t fdim = (ds == SEA) ? 3 : 2;
+  if (n_threads <= 0) {
+    n_threads = (int)std::thread::hardware_concurrency();
+    if (n_threads <= 0) n_threads = 1;
+  }
+  auto worker = [&](int64_t c_begin, int64_t c_end) {
+    for (int64_t c = c_begin; c < c_end; ++c) {
+      for (int64_t t = 0; t < T1; ++t) {
+        int concept = concepts[t * C + c];
+        float* xc = x + ((c * T1 + t) * N) * fdim;
+        int32_t* yc = y + (c * T1 + t) * N;
+        fill_cell(ds, xc, yc, N, concept, noise_prob, cell_seed(seed, c, t));
+      }
+    }
+  };
+  int64_t per = (C + n_threads - 1) / n_threads;
+  std::vector<std::thread> threads;
+  for (int64_t b = 0; b < C; b += per)
+    threads.emplace_back(worker, b, std::min(b + per, C));
+  for (auto& th : threads) th.join();
+  return 0;
+}
+
+// Feature dimension per dataset id (SEA=3, SINE/CIRCLE=2).
+int fd_feature_dim(int dataset) { return dataset == 0 ? 3 : 2; }
+
+}  // extern "C"
