@@ -40,6 +40,7 @@ class ExperimentConfig:
     client_num_per_round: int = 10
     batch_size: int = 500
     fnn_hidden_dim: int = 10
+    fmow_image_size: int = 32          # fmow partition image resolution
 
     # --- optimization ----------------------------------------------------
     client_optimizer: str = "adam"     # adam (amsgrad, as reference FedAvgEnsTrainer.py:31-33) | sgd

@@ -58,6 +58,15 @@ for _name in ("MNIST", "femnist", "cifar10"):
             cfg.sample_num, cfg.noise_prob, cfg.time_stretch, cfg.seed, cfg.data_dir)
 
 
+@register_dataset("fmow")
+def _mk_fmow(cfg: ExperimentConfig, change_points: np.ndarray) -> DriftDataset:
+    from feddrift_tpu.data.fmow import generate_fmow_drift
+    return generate_fmow_drift(
+        change_points, cfg.train_iterations, cfg.client_num_in_total,
+        cfg.sample_num, cfg.noise_prob, cfg.time_stretch, cfg.seed,
+        cfg.data_dir, cfg.fmow_image_size, cfg.change_points)
+
+
 @register_dataset("shakespeare", "fed_shakespeare")
 def _mk_text(cfg: ExperimentConfig, change_points: np.ndarray) -> DriftDataset:
     return generate_text_drift(

@@ -77,6 +77,30 @@ def _resnet18(ds: DriftDataset, cfg) -> nn.Module:
     return ResNet18(num_classes=ds.num_classes)
 
 
+@register_model("mobilenet")
+def _mobilenet(ds: DriftDataset, cfg) -> nn.Module:
+    from feddrift_tpu.models.mobilenet import MobileNet
+    return MobileNet(num_classes=ds.num_classes)
+
+
+@register_model("mobilenet_gn")
+def _mobilenet_gn(ds: DriftDataset, cfg) -> nn.Module:
+    from feddrift_tpu.models.mobilenet import MobileNet
+    return MobileNet(num_classes=ds.num_classes, norm="group")
+
+
+@register_model("densenet", "densenet121")
+def _densenet(ds: DriftDataset, cfg) -> nn.Module:
+    from feddrift_tpu.models.mobilenet import DenseNet
+    return DenseNet(num_classes=ds.num_classes)
+
+
+@register_model("darts")
+def _darts(ds: DriftDataset, cfg) -> nn.Module:
+    from feddrift_tpu.models.darts import DARTSNetwork
+    return DARTSNetwork(num_classes=ds.num_classes)
+
+
 @register_model("transformer")
 def _transformer(ds: DriftDataset, cfg) -> nn.Module:
     from feddrift_tpu.models.transformer import TransformerLM

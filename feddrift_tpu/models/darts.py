@@ -1,0 +1,138 @@
+"""DARTS searchable-cell network (reference: fedml_api/model/cv/darts/ —
+model_search.py's MixedOp/Cell/Network used by FedNAS,
+fedml_api/distributed/fednas/).
+
+Design for TPU + federation:
+- Architecture parameters (the DARTS "alphas") are ordinary flax params whose
+  names start with ``arch_``; ``split_arch_params`` partitions a param pytree
+  into (weights, alphas) by that prefix. FedNAS (platform/fednas.py) uses the
+  split to run the bilevel update — weights on train data, alphas on search
+  data — while plain FedAvg over the whole pytree still works (alphas simply
+  average, which is exactly the reference server's behaviour,
+  fednas/FedNASAggregator.py).
+- Every candidate op runs and is mixed by softmax(alpha): no data-dependent
+  control flow, so one traced XLA program covers all architectures. This is
+  the DARTS continuous relaxation itself — it maps to TPU better than
+  discrete NAS because the mixture is a dense weighted sum the compiler
+  fuses.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from feddrift_tpu.models.resnet import _Norm
+
+OPS: Sequence[str] = ("skip", "conv3", "sep3", "avgpool", "maxpool")
+
+
+class _Op(nn.Module):
+    kind: str
+    filters: int
+    norm: str = "batch"
+
+    @nn.compact
+    def __call__(self, x):
+        if self.kind == "skip":
+            if x.shape[-1] != self.filters:
+                x = nn.Conv(self.filters, (1, 1), use_bias=False)(x)
+            return x
+        if self.kind == "conv3":
+            y = nn.Conv(self.filters, (3, 3), padding="SAME", use_bias=False)(x)
+            return nn.relu(_Norm(self.norm)(y))
+        if self.kind == "sep3":
+            y = nn.Conv(x.shape[-1], (3, 3), padding="SAME",
+                        feature_group_count=x.shape[-1], use_bias=False)(x)
+            y = nn.Conv(self.filters, (1, 1), use_bias=False)(y)
+            return nn.relu(_Norm(self.norm)(y))
+        if self.kind == "avgpool":
+            y = nn.avg_pool(x, (3, 3), strides=(1, 1), padding="SAME")
+            if y.shape[-1] != self.filters:
+                y = nn.Conv(self.filters, (1, 1), use_bias=False)(y)
+            return y
+        if self.kind == "maxpool":
+            y = nn.max_pool(x, (3, 3), strides=(1, 1), padding="SAME")
+            if y.shape[-1] != self.filters:
+                y = nn.Conv(self.filters, (1, 1), use_bias=False)(y)
+            return y
+        raise ValueError(self.kind)
+
+
+class MixedOp(nn.Module):
+    """softmax(alpha)-weighted sum of all candidate ops (model_search.py MixedOp)."""
+
+    filters: int
+    norm: str = "batch"
+
+    @nn.compact
+    def __call__(self, x):
+        alpha = self.param("arch_alpha", nn.initializers.normal(1e-3),
+                           (len(OPS),))
+        w = nn.softmax(alpha)
+        outs = [_Op(k, self.filters, self.norm, name=f"op_{k}")(x) for k in OPS]
+        return sum(w[i] * outs[i] for i in range(len(OPS)))
+
+
+class Cell(nn.Module):
+    """DARTS cell: ``nodes`` intermediate nodes, each summing mixed ops from
+    all predecessors; output concatenates the intermediate nodes."""
+
+    filters: int
+    nodes: int = 3
+    reduce: bool = False
+    norm: str = "batch"
+
+    @nn.compact
+    def __call__(self, x):
+        if self.reduce:
+            x = nn.avg_pool(x, (2, 2), strides=(2, 2))
+        states = [nn.Conv(self.filters, (1, 1), use_bias=False)(x)]
+        for i in range(self.nodes):
+            s = sum(MixedOp(self.filters, self.norm,
+                            name=f"edge_{j}_{i}")(states[j])
+                    for j in range(len(states)))
+            states.append(s)
+        return jnp.concatenate(states[1:], axis=-1)
+
+
+class DARTSNetwork(nn.Module):
+    """The searchable network (model_search.py Network): stem, alternating
+    normal/reduce cells, global pool, classifier."""
+
+    num_classes: int = 10
+    filters: int = 16
+    cells: int = 3
+    nodes: int = 3
+    norm: str = "batch"
+
+    @nn.compact
+    def __call__(self, x):
+        if x.ndim == 2:
+            x = x.reshape((x.shape[0], 32, 32, 3))
+        x = nn.Conv(self.filters, (3, 3), padding="SAME", use_bias=False)(x)
+        x = nn.relu(_Norm(self.norm)(x))
+        for i in range(self.cells):
+            reduce = i > 0 and i % 2 == 0
+            x = Cell(self.filters * (2 if reduce else 1), self.nodes,
+                     reduce=reduce, norm=self.norm, name=f"cell_{i}")(x)
+        x = x.mean(axis=(1, 2))
+        return nn.Dense(self.num_classes)(x)
+
+
+def is_arch_param(path) -> bool:
+    """True if a tree_map_with_path path addresses an architecture alpha."""
+    return any(str(getattr(k, "key", getattr(k, "idx", k))).startswith("arch_")
+               for k in path)
+
+
+def split_arch_params(params):
+    """Partition a DARTS param pytree into (weight_mask, arch_mask) boolean
+    pytrees usable with ``optax.masked`` or manual update gating."""
+    import jax
+    arch_mask = jax.tree_util.tree_map_with_path(
+        lambda p, _leaf: is_arch_param(p), params)
+    weight_mask = jax.tree_util.tree_map(lambda a: not a, arch_mask)
+    return weight_mask, arch_mask

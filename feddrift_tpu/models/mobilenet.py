@@ -1,0 +1,104 @@
+"""MobileNet (reference: fedml_api/model/cv/mobilenet.py:60) and a compact
+DenseNet (torchvision densenet121 is the reference's pretrained option,
+main_fedavg.py:219-222).
+
+TPU-first notes: NHWC layout; depthwise convolutions use
+``feature_group_count`` which XLA lowers to efficient TPU convolutions; the
+width multiplier keeps channel counts multiples of 8 so tiles land on the MXU
+cleanly. Norms are the stateless per-batch / GroupNorm variants shared with
+the ResNets (see models/resnet.py) so the modules stay pure functions of
+``(params, x)`` and stack under vmap on the model-pool axis.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+
+from feddrift_tpu.models.resnet import _Norm
+
+
+class _DepthwiseSeparable(nn.Module):
+    filters: int
+    strides: int = 1
+    norm: str = "batch"
+
+    @nn.compact
+    def __call__(self, x):
+        # depthwise 3x3: one group per input channel
+        x = nn.Conv(x.shape[-1], (3, 3), strides=(self.strides, self.strides),
+                    padding="SAME", feature_group_count=x.shape[-1],
+                    use_bias=False)(x)
+        x = nn.relu(_Norm(self.norm)(x))
+        # pointwise 1x1 — this is where the FLOPs (and the MXU work) are
+        x = nn.Conv(self.filters, (1, 1), use_bias=False)(x)
+        return nn.relu(_Norm(self.norm)(x))
+
+
+class MobileNet(nn.Module):
+    """MobileNetV1-style network (mobilenet.py:60), CIFAR-sized stem.
+
+    ``alpha`` is the width multiplier; channels are rounded to multiples of 8.
+    """
+
+    num_classes: int = 10
+    alpha: float = 1.0
+    norm: str = "batch"
+
+    @nn.compact
+    def __call__(self, x):
+        if x.ndim == 2:
+            x = x.reshape((x.shape[0], 32, 32, 3))
+
+        def ch(c: int) -> int:
+            return max(8, int(c * self.alpha + 4) // 8 * 8)
+
+        x = nn.Conv(ch(32), (3, 3), padding="SAME", use_bias=False)(x)
+        x = nn.relu(_Norm(self.norm)(x))
+        # (filters, strides) schedule of the V1 body, CIFAR-compressed: the
+        # three stride-2 stages map 32x32 -> 4x4.
+        for filters, strides in ((64, 1), (128, 2), (128, 1), (256, 2),
+                                 (256, 1), (512, 2), (512, 1)):
+            x = _DepthwiseSeparable(ch(filters), strides, self.norm)(x)
+        x = x.mean(axis=(1, 2))
+        return nn.Dense(self.num_classes)(x)
+
+
+class _DenseBlock(nn.Module):
+    layers: int
+    growth: int
+    norm: str = "batch"
+
+    @nn.compact
+    def __call__(self, x):
+        import jax.numpy as jnp
+        for _ in range(self.layers):
+            y = nn.relu(_Norm(self.norm)(x))
+            y = nn.Conv(4 * self.growth, (1, 1), use_bias=False)(y)
+            y = nn.relu(_Norm(self.norm)(y))
+            y = nn.Conv(self.growth, (3, 3), padding="SAME", use_bias=False)(y)
+            x = jnp.concatenate([x, y], axis=-1)
+        return x
+
+
+class DenseNet(nn.Module):
+    """Compact DenseNet-BC (densenet121 flavor at CIFAR scale)."""
+
+    num_classes: int = 10
+    growth: int = 12
+    blocks: tuple = (6, 12, 8)
+    norm: str = "batch"
+
+    @nn.compact
+    def __call__(self, x):
+        if x.ndim == 2:
+            x = x.reshape((x.shape[0], 32, 32, 3))
+        x = nn.Conv(2 * self.growth, (3, 3), padding="SAME", use_bias=False)(x)
+        for i, layers in enumerate(self.blocks):
+            x = _DenseBlock(layers, self.growth, self.norm)(x)
+            if i < len(self.blocks) - 1:   # transition: halve channels + pool
+                x = nn.relu(_Norm(self.norm)(x))
+                x = nn.Conv(x.shape[-1] // 2, (1, 1), use_bias=False)(x)
+                x = nn.avg_pool(x, (2, 2), strides=(2, 2))
+        x = nn.relu(_Norm(self.norm)(x))
+        x = x.mean(axis=(1, 2))
+        return nn.Dense(self.num_classes)(x)

@@ -10,8 +10,6 @@ gradients reduced with one psum over the whole mesh.
 from __future__ import annotations
 
 from dataclasses import dataclass
-from functools import partial
-from typing import Any
 
 import jax
 import jax.numpy as jnp

@@ -31,19 +31,23 @@ from jax import lax
 NEG_INF = -1e30
 
 
-def _block_attn(q, k, v, acc, m, l, q_off, k_off, causal: bool, scale: float):
+def _block_attn(q, k, v, acc, m, l, q_off, k_off, causal: bool, scale: float,
+                k_len=None):
     """One online-softmax accumulation step.
 
     q: [B, H, Lq, D]; k, v: [B, H, Lk, D]; acc: [B, H, Lq, D];
     m, l: [B, H, Lq] running max / denominator; q_off, k_off: global offsets
-    of the first query / key position in this pair of blocks.
+    of the first query / key position in this pair of blocks; k_len masks
+    global key positions >= k_len (padding).
     """
     scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+    Lq, Lk = q.shape[2], k.shape[2]
+    qpos = q_off + jnp.arange(Lq)[:, None]
+    kpos = k_off + jnp.arange(Lk)[None, :]
     if causal:
-        Lq, Lk = q.shape[2], k.shape[2]
-        qpos = q_off + jnp.arange(Lq)[:, None]
-        kpos = k_off + jnp.arange(Lk)[None, :]
         scores = jnp.where(kpos > qpos, NEG_INF, scores)
+    if k_len is not None:
+        scores = jnp.where(kpos >= k_len, NEG_INF, scores)
     m_new = jnp.maximum(m, scores.max(axis=-1))
     # guard fully-masked rows (can only occur for non-causal callers passing
     # disjoint offsets); exp(NEG_INF - NEG_INF) would be 1, so clamp.
@@ -59,8 +63,13 @@ def blockwise_attention(q, k, v, *, causal: bool = True,
     """Single-device flash-style attention via lax.scan over key blocks."""
     B, H, L, D = q.shape
     scale = 1.0 / jnp.sqrt(D).astype(q.dtype)
-    nblocks = max(L // block_size, 1)
-    bs = L // nblocks
+    bs = min(block_size, L)
+    nblocks = -(-L // bs)
+    pad = nblocks * bs - L
+    if pad:
+        # padded keys are masked out via NEG_INF scores (kpos >= L)
+        k = jnp.pad(k, ((0, 0), (0, 0), (0, pad), (0, 0)))
+        v = jnp.pad(v, ((0, 0), (0, 0), (0, pad), (0, 0)))
     k_blocks = k.reshape(B, H, nblocks, bs, D).transpose(2, 0, 1, 3, 4)
     v_blocks = v.reshape(B, H, nblocks, bs, D).transpose(2, 0, 1, 3, 4)
 
@@ -73,7 +82,7 @@ def blockwise_attention(q, k, v, *, causal: bool = True,
         (kb, vb, b_idx) = inp
         acc, m, l = _block_attn(q, kb, vb, acc, m, l,
                                 q_off=0, k_off=b_idx * bs,
-                                causal=causal, scale=scale)
+                                causal=causal, scale=scale, k_len=L)
         return (acc, m, l), None
 
     (acc, m, l), _ = lax.scan(step, (acc, m, l),

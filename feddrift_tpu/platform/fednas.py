@@ -1,0 +1,128 @@
+"""FedNAS: federated neural architecture search with DARTS cells.
+
+Re-design of fedml_api/distributed/fednas/ (FedNASAggregator.py,
+FedNASTrainer.py) + the DARTS architect (fedml_api/model/cv/darts/
+architect.py): each client alternates
+  - an ARCHITECTURE step: grad of the *search* (validation) loss w.r.t. the
+    arch alphas only (first-order DARTS, the reference's
+    ``--arch_search first_order``), and
+  - a WEIGHT step: grad of the train loss w.r.t. the weights only,
+and the server averages weights AND alphas sample-weighted — which is
+exactly the reference aggregator's behaviour (it averages both state dicts).
+
+TPU-first: the (weights, alphas) split is two boolean masks over one param
+pytree (models/darts.py:split_arch_params); both phases are gradient steps of
+the same pure loss with the complementary halves frozen via mask gating, so
+the client round is one jitted scan over clients under vmap — no per-client
+processes, no separate architect object.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+import optax
+
+
+def masked_sgd_step(params, grads, mask, lr):
+    """params -= lr * grads where mask is True; identity elsewhere."""
+    return jax.tree_util.tree_map(
+        lambda p, g, m: p - lr * g * jnp.float32(m), params, grads, mask)
+
+
+def make_loss(apply_fn: Callable):
+    def loss_fn(params, x, y):
+        logits = apply_fn(params, x)
+        onehot = jax.nn.one_hot(y, logits.shape[-1])
+        return optax.softmax_cross_entropy(logits, onehot).mean()
+    return loss_fn
+
+
+@partial(jax.jit, static_argnames=("apply_fn", "steps"))
+def client_search_round(apply_fn, params_stack, weight_mask, arch_mask,
+                        x_train, y_train, x_search, y_search,
+                        w_lr: float, arch_lr: float, steps: int = 1):
+    """One local search round for ALL clients at once.
+
+    params_stack: [C, ...] pytree (each client's copy of the DARTS net);
+    x_train/y_train, x_search/y_search: [C, B, ...] local splits
+    (FedNASTrainer holds separate train/search loaders). Returns
+    (new params_stack, [C] train loss after the round).
+    """
+    loss_fn = make_loss(apply_fn)
+
+    def one_client(params, xt, yt, xs, ys):
+        def body(p, _):
+            # first-order DARTS: alphas step on the search split...
+            a_grads = jax.grad(loss_fn)(p, xs, ys)
+            p = masked_sgd_step(p, a_grads, arch_mask, arch_lr)
+            # ...then weights step on the train split
+            w_grads = jax.grad(loss_fn)(p, xt, yt)
+            p = masked_sgd_step(p, w_grads, weight_mask, w_lr)
+            return p, None
+        params, _ = jax.lax.scan(body, params, None, length=steps)
+        return params, loss_fn(params, xt, yt)
+
+    return jax.vmap(one_client)(params_stack, x_train, y_train,
+                                x_search, y_search)
+
+
+@jax.jit
+def aggregate_search(params_stack, n):
+    """Server: sample-weighted average of weights and alphas together
+    (FedNASAggregator.aggregate averages the full state dicts)."""
+    w = n / jnp.maximum(n.sum(), 1e-12)
+    def avg(leaf):
+        wb = w.reshape((-1,) + (1,) * (leaf.ndim - 1))
+        return (leaf * wb).sum(axis=0)
+    return jax.tree_util.tree_map(avg, params_stack)
+
+
+def derive_architecture(params) -> dict[str, int]:
+    """Discretize: argmax op per mixed edge (the reference's genotype
+    derivation, darts/model_search.py genotype())."""
+    from feddrift_tpu.models.darts import is_arch_param
+    arch = {}
+    for path, leaf in jax.tree_util.tree_flatten_with_path(params)[0]:
+        if is_arch_param(path):
+            keys = [str(getattr(k, "key", getattr(k, "idx", k))) for k in path]
+            arch["/".join(keys)] = int(jnp.argmax(leaf))
+    return arch
+
+
+class FedNAS:
+    """Round driver mirroring the FedNAS server loop: broadcast, local
+    search, aggregate; ``search`` runs R rounds and returns the final params
+    + discrete architecture."""
+
+    def __init__(self, module, sample_input, num_clients: int,
+                 w_lr: float = 0.025, arch_lr: float = 3e-4,
+                 local_steps: int = 1, seed: int = 0) -> None:
+        from feddrift_tpu.models.darts import split_arch_params
+        self.module = module
+        params = module.init(jax.random.PRNGKey(seed), sample_input)["params"]
+        self.params = params
+        self.weight_mask, self.arch_mask = split_arch_params(params)
+        self.C = num_clients
+        self.w_lr, self.arch_lr, self.local_steps = w_lr, arch_lr, local_steps
+        self.apply_fn = lambda p, x: module.apply({"params": p}, x)
+
+    def round(self, x_train, y_train, x_search, y_search, n):
+        stack = jax.tree_util.tree_map(
+            lambda l: jnp.broadcast_to(l[None], (self.C, *l.shape)),
+            self.params)
+        stack, losses = client_search_round(
+            self.apply_fn, stack, self.weight_mask, self.arch_mask,
+            x_train, y_train, x_search, y_search,
+            self.w_lr, self.arch_lr, self.local_steps)
+        self.params = aggregate_search(stack, n)
+        return losses
+
+    def search(self, rounds: int, x_train, y_train, x_search, y_search, n):
+        losses = jnp.zeros((self.C,), jnp.float32)
+        for _ in range(rounds):
+            losses = self.round(x_train, y_train, x_search, y_search, n)
+        return self.params, derive_architecture(self.params), losses

@@ -121,6 +121,22 @@ class TestRegistry:
         assert ds.x.shape == (10, 3, 16, 80)
         assert ds.is_sequence and ds.num_classes == 90
 
+    def test_make_fmow(self):
+        cfg = ExperimentConfig(dataset="fmow", train_iterations=2, sample_num=8,
+                               client_num_in_total=4, client_num_per_round=4,
+                               change_points="A")
+        ds = make_dataset(cfg)
+        assert ds.x.shape == (4, 3, 8, 32, 32, 3)
+        assert ds.num_classes == 62
+        # covariate drift: same labels, shifted inputs across concepts
+        import numpy as np
+        k = ds.concepts  # [T+1, C]
+        drifted = [(c, t) for c in range(4) for t in range(3)
+                   if k[t, c] != k[0, c]]
+        if drifted:
+            c, t = drifted[0]
+            assert abs(ds.x[c, t].mean() - ds.x[c, 0].mean()) > 0.01
+
     def test_rand_changepoints(self):
         cfg = ExperimentConfig(dataset="sea", change_points="rand",
                                train_iterations=6, sample_num=20)

@@ -39,6 +39,30 @@ class TestModels:
         out = mod.apply({"params": params}, x)
         assert out.shape == (2, 90)
 
+    @pytest.mark.parametrize("name", ["mobilenet", "mobilenet_gn", "densenet"])
+    def test_cv_zoo_forward(self, name):
+        ds, cfg = _ds("cifar10")
+        mod = create_model(name, ds, cfg)
+        x = jnp.zeros((2, 32, 32, 3), jnp.float32)
+        params = mod.init(jax.random.PRNGKey(0), x)["params"]
+        out = mod.apply({"params": params}, x)
+        assert out.shape == (2, ds.num_classes)
+
+    def test_darts_forward_and_arch_split(self):
+        from feddrift_tpu.models.darts import split_arch_params
+        ds, cfg = _ds("cifar10")
+        mod = create_model("darts", ds, cfg)
+        x = jnp.zeros((2, 32, 32, 3), jnp.float32)
+        params = mod.init(jax.random.PRNGKey(0), x)["params"]
+        out = mod.apply({"params": params}, x)
+        assert out.shape == (2, ds.num_classes)
+        wmask, amask = split_arch_params(params)
+        leaves_w = jax.tree_util.tree_leaves(wmask)
+        leaves_a = jax.tree_util.tree_leaves(amask)
+        # masks partition the tree: exactly one of (w, a) true per leaf
+        assert all(w != a for w, a in zip(leaves_w, leaves_a))
+        assert any(leaves_a)   # some arch alphas exist
+
     def test_unknown_model(self):
         ds, cfg = _ds()
         with pytest.raises(KeyError):

@@ -38,6 +38,17 @@ class TestBlockwise:
                                    np.asarray(naive_attention(q, k, v, causal)),
                                    atol=1e-5)
 
+    @pytest.mark.parametrize("causal", [True, False])
+    @pytest.mark.parametrize("L", [65, 100, 17])
+    def test_non_divisible_length(self, causal, L):
+        # regression: L not a multiple of block_size must pad+mask, not crash
+        from feddrift_tpu.parallel.ring_attention import blockwise_attention
+        q, k, v = _qkv(jax.random.PRNGKey(1), L=L)
+        out = blockwise_attention(q, k, v, causal=causal, block_size=32)
+        np.testing.assert_allclose(np.asarray(out),
+                                   np.asarray(naive_attention(q, k, v, causal)),
+                                   atol=1e-5)
+
 
 class TestRing:
     def _mesh(self, n):
