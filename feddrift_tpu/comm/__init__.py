@@ -1,0 +1,23 @@
+"""Communication layer (L0/L1 of the reference, SURVEY.md §1).
+
+The reference stack is mpi4py point-to-point pickles under daemon threads
+(fedml_core/distributed/communication/mpi/) with an MQTT alternative; the
+server/client role managers (fedml_core/distributed/{server,client}/) drive a
+handler-registry event loop on top and terminate via MPI_Abort.
+
+On TPU the data plane is XLA collectives (core/step.py aggregates with a
+masked weighted mean, multi-host syncs over DCN under
+jax.distributed.initialize) — but the *control plane* abstraction is still
+worth having: pluggable transports for simulation, tests, and driving
+non-collective deployments (the reference's MQTT/mobile use cases). This
+package provides that control plane with clean-shutdown semantics (sentinel
+close, no thread kills — contrast mpi_send_thread.py:47-53's
+PyThreadState_SetAsyncExc).
+"""
+
+from feddrift_tpu.comm.message import Message, MsgType           # noqa: F401
+from feddrift_tpu.comm.base import (                              # noqa: F401
+    Observer, BaseCommManager)
+from feddrift_tpu.comm.loopback import LoopbackNetwork            # noqa: F401
+from feddrift_tpu.comm.managers import (                          # noqa: F401
+    ServerManager, ClientManager)

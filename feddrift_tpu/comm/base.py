@@ -1,0 +1,52 @@
+"""Observer + communication-manager interfaces
+(fedml_core/distributed/communication/{observer.py,base_com_manager.py}).
+
+The reference's receive path busy-polls a queue.Queue every 0.3 s
+(mpi/com_manager.py:78) — here delivery is blocking-get with a shutdown
+sentinel, so idle endpoints cost nothing and shutdown is race-free.
+"""
+
+from __future__ import annotations
+
+import abc
+from typing import Any
+
+from feddrift_tpu.comm.message import Message
+
+
+class Observer(abc.ABC):
+    """communication/observer.py:4 interface."""
+
+    @abc.abstractmethod
+    def receive_message(self, msg_type: int, msg: Message) -> None:
+        ...
+
+
+class BaseCommManager(abc.ABC):
+    """communication/base_com_manager.py:7 interface: transports implement
+    send/run/stop; observers get dispatched by message type."""
+
+    def __init__(self) -> None:
+        self._observers: list[Observer] = []
+
+    def add_observer(self, observer: Observer) -> None:
+        self._observers.append(observer)
+
+    def remove_observer(self, observer: Observer) -> None:
+        self._observers.remove(observer)
+
+    def notify(self, msg: Message) -> None:
+        for obs in list(self._observers):
+            obs.receive_message(msg.msg_type, msg)
+
+    @abc.abstractmethod
+    def send_message(self, msg: Message) -> None:
+        ...
+
+    @abc.abstractmethod
+    def handle_receive_message(self) -> None:
+        """Run the receive loop until stopped."""
+
+    @abc.abstractmethod
+    def stop_receive_message(self) -> None:
+        ...

@@ -1,0 +1,70 @@
+"""In-process loopback transport: N endpoints over queue.Queue.
+
+Replaces the reference's MPI transport (communication/mpi/: one OS process
+per rank, pickled dicts, send/receive daemon threads killed via
+PyThreadState_SetAsyncExc) for simulation and tests: endpoints share one
+process, payloads pass by reference (zero serialisation), and shutdown is a
+sentinel drain — the same role the `--ci 1` smoke path plays for the
+reference's MPI pipeline.
+
+The MQTT transport's pub/sub shape (mqtt_comm_manager.py:14) maps onto the
+same Network object: topic == receiver id.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Optional
+
+from feddrift_tpu.comm.base import BaseCommManager
+from feddrift_tpu.comm.message import Message
+
+_STOP = object()
+
+
+class LoopbackNetwork:
+    """The shared 'wire': per-endpoint inboxes addressable by rank id."""
+
+    def __init__(self, num_endpoints: int) -> None:
+        self.inboxes: list[queue.Queue] = [queue.Queue()
+                                           for _ in range(num_endpoints)]
+
+    def endpoint(self, rank: int) -> "LoopbackCommManager":
+        return LoopbackCommManager(self, rank)
+
+    def deliver(self, msg: Message) -> None:
+        self.inboxes[msg.receiver_id].put(msg)
+
+
+class LoopbackCommManager(BaseCommManager):
+    def __init__(self, network: LoopbackNetwork, rank: int) -> None:
+        super().__init__()
+        self.network = network
+        self.rank = rank
+        self._thread: Optional[threading.Thread] = None
+
+    # -- transport interface -------------------------------------------
+    def send_message(self, msg: Message) -> None:
+        self.network.deliver(msg)
+
+    def handle_receive_message(self) -> None:
+        """Blocking receive-dispatch loop; returns after stop_receive_message.
+        Call directly (single-threaded simulation) or via run_async."""
+        inbox = self.network.inboxes[self.rank]
+        while True:
+            item = inbox.get()
+            if item is _STOP:
+                return
+            self.notify(item)
+
+    def run_async(self) -> None:
+        self._thread = threading.Thread(target=self.handle_receive_message,
+                                        daemon=True)
+        self._thread.start()
+
+    def stop_receive_message(self) -> None:
+        self.network.inboxes[self.rank].put(_STOP)
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+            self._thread = None

@@ -1,0 +1,65 @@
+"""Role managers (fedml_core/distributed/{server/server_manager.py:11,
+client/client_manager.py:12}) and the generic manager skeletons of
+fedml_api/distributed/{base_framework,decentralized_framework}.
+
+Handler-registry event loop preserved; termination is a clean loop stop
+instead of MPI.COMM_WORLD.Abort() (server_manager.py:57) — a crashed peer
+can't wedge the barrier because there is no cross-process barrier to wedge.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Callable
+
+from feddrift_tpu.comm.base import BaseCommManager, Observer
+from feddrift_tpu.comm.message import Message
+
+log = logging.getLogger("feddrift_tpu")
+
+
+class _Manager(Observer):
+    def __init__(self, rank: int, size: int,
+                 com_manager: BaseCommManager) -> None:
+        self.rank = rank
+        self.size = size
+        self.com_manager = com_manager
+        self.com_manager.add_observer(self)
+        self.message_handler_dict: dict[int, Callable[[Message], None]] = {}
+        self.register_message_receive_handlers()
+
+    # subclasses populate the registry (client_manager.py:41-46 pattern)
+    def register_message_receive_handlers(self) -> None:
+        ...
+
+    def register_message_receive_handler(self, msg_type: int,
+                                         handler: Callable[[Message], None]) -> None:
+        self.message_handler_dict[msg_type] = handler
+
+    def receive_message(self, msg_type: int, msg: Message) -> None:
+        handler = self.message_handler_dict.get(msg_type)
+        if handler is None:
+            # drop + log rather than raise: an exception here would
+            # propagate into the transport's receive loop and silently kill
+            # a run_async daemon thread, wedging the endpoint
+            log.warning("rank %d: dropping message with unhandled type %s "
+                        "from rank %d", self.rank, msg_type, msg.sender_id)
+            return
+        handler(msg)
+
+    def send_message(self, msg: Message) -> None:
+        self.com_manager.send_message(msg)
+
+    def run(self) -> None:
+        self.com_manager.handle_receive_message()
+
+    def finish(self) -> None:
+        self.com_manager.stop_receive_message()
+
+
+class ServerManager(_Manager):
+    """rank 0 by convention (FedAvgEnsAPI.py:86-92)."""
+
+
+class ClientManager(_Manager):
+    """ranks 1..N."""

@@ -1,0 +1,51 @@
+"""Message schema (reference: fedml_core/distributed/communication/message.py:5
+plus the FedAvgEns schema, fedml_api/distributed/fedavg_ens/message_define.py).
+
+A Message is a typed dict of params with sender/receiver ids. The four
+FedDrift round-trip types are preserved verbatim so the control-plane state
+machine is run-for-run comparable; payloads are arbitrary Python objects
+(pytrees of jax/numpy arrays in practice) — no pickling unless a transport
+needs it.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from enum import IntEnum
+from typing import Any
+
+
+class MsgType(IntEnum):
+    """message_define.py:3-9 equivalents."""
+
+    S2C_INIT_CONFIG = 1
+    S2C_SYNC_MODEL = 2
+    C2S_SEND_MODEL = 3
+    C2S_SEND_STATS = 4
+
+
+# message_define.py:12-23 argument keys
+ARG_MODEL_PARAMS = "model_params"
+ARG_MODEL_AND_NUM_SAMPLES = "model_and_num_samples"
+ARG_CLIENT_INDEX = "client_index"
+ARG_EXTRA_INFO = "extra_info"
+ARG_NUM_SAMPLES = "num_samples"
+ARG_LOCAL_TRAINING_ACC = "local_training_acc"
+
+
+@dataclass
+class Message:
+    msg_type: int
+    sender_id: int
+    receiver_id: int
+    params: dict[str, Any] = field(default_factory=dict)
+
+    def add_params(self, key: str, value: Any) -> None:
+        self.params[key] = value
+
+    def get(self, key: str, default: Any = None) -> Any:
+        return self.params.get(key, default)
+
+    def __repr__(self) -> str:  # payloads can be huge; show keys only
+        return (f"Message(type={self.msg_type}, {self.sender_id}->"
+                f"{self.receiver_id}, keys={sorted(self.params)})")

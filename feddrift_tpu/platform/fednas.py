@@ -24,7 +24,8 @@ from typing import Callable
 
 import jax
 import jax.numpy as jnp
-import optax
+
+from feddrift_tpu.core.functional import cross_entropy
 
 
 def masked_sgd_step(params, grads, mask, lr):
@@ -35,9 +36,7 @@ def masked_sgd_step(params, grads, mask, lr):
 
 def make_loss(apply_fn: Callable):
     def loss_fn(params, x, y):
-        logits = apply_fn(params, x)
-        onehot = jax.nn.one_hot(y, logits.shape[-1])
-        return optax.softmax_cross_entropy(logits, onehot).mean()
+        return cross_entropy(apply_fn(params, x), y)
     return loss_fn
 
 

@@ -1,0 +1,141 @@
+"""Model-serving executor: HTTP control plane for external (mobile/edge)
+clients.
+
+Interface-level re-design of the reference's mobile backend
+(fedml_mobile/server/executor/app.py — a Flask app that registers devices,
+hands out the current global model, and accepts trained uploads). Flask is
+not assumed; the stdlib http.server is enough for the executor's tiny JSON
+API, and the aggregation path reuses the same weighted-average semantics as
+the in-process framework.
+
+Endpoints (all JSON):
+  POST /api/register           -> {"device_id": int}
+  GET  /api/get_model          -> {"round": int, "params": {leaf: list}}
+  POST /api/upload_model       body {"device_id", "num_samples",
+                                     "params": {leaf: list}}
+       -> {"accepted": true, "round": int}; when all registered devices
+       have uploaded, the server aggregates and advances the round.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+
+
+class ServingState:
+    """Round state: registered devices, current params, pending uploads."""
+
+    def __init__(self, init_params: dict[str, np.ndarray]) -> None:
+        self.lock = threading.Lock()
+        self.params = {k: np.asarray(v, np.float32)
+                       for k, v in init_params.items()}
+        self.round = 0
+        self.next_device = 0
+        self.uploads: dict[int, tuple[dict[str, np.ndarray], float]] = {}
+
+    def register(self) -> int:
+        with self.lock:
+            dev = self.next_device
+            self.next_device += 1
+            return dev
+
+    def get_model(self):
+        with self.lock:
+            return self.round, {k: v.tolist() for k, v in self.params.items()}
+
+    def upload(self, device_id: int, num_samples: float,
+               params: dict[str, list]) -> int:
+        with self.lock:
+            if not (0 <= device_id < self.next_device):
+                raise ValueError(f"unregistered device_id {device_id}")
+            if set(params) != set(self.params):
+                raise ValueError(
+                    f"param keys {sorted(params)} != expected "
+                    f"{sorted(self.params)}")
+            self.uploads[device_id] = (
+                {k: np.asarray(v, np.float32) for k, v in params.items()},
+                float(num_samples))
+            if len(self.uploads) >= self.next_device and self.next_device > 0:
+                total = sum(n for _, n in self.uploads.values())
+                agg = {k: np.zeros_like(v) for k, v in self.params.items()}
+                for p, n in self.uploads.values():
+                    for k in agg:
+                        agg[k] += p[k] * (n / total)
+                self.params = agg
+                self.uploads = {}
+                self.round += 1
+            return self.round
+
+
+def _make_handler(state: ServingState):
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, *a):   # quiet
+            pass
+
+        def _json(self, code: int, obj) -> None:
+            body = json.dumps(obj).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self):
+            if self.path == "/api/get_model":
+                rnd, params = state.get_model()
+                self._json(200, {"round": rnd, "params": params})
+            else:
+                self._json(404, {"error": "unknown endpoint"})
+
+        def do_POST(self):
+            length = int(self.headers.get("Content-Length", 0))
+            body = json.loads(self.rfile.read(length) or b"{}")
+            if self.path == "/api/register":
+                self._json(200, {"device_id": state.register()})
+            elif self.path == "/api/upload_model":
+                try:
+                    rnd = state.upload(body["device_id"],
+                                       body["num_samples"], body["params"])
+                except KeyError as e:
+                    self._json(400, {"error": f"missing field {e}"})
+                    return
+                except ValueError as e:
+                    self._json(400, {"error": str(e)})
+                    return
+                self._json(200, {"accepted": True, "round": rnd})
+            else:
+                self._json(404, {"error": "unknown endpoint"})
+
+    return Handler
+
+
+class ServingExecutor:
+    """Owns the HTTP server thread; ``url`` after start()."""
+
+    def __init__(self, init_params: dict[str, np.ndarray],
+                 host: str = "127.0.0.1", port: int = 0) -> None:
+        self.state = ServingState(init_params)
+        self.httpd = ThreadingHTTPServer((host, port),
+                                         _make_handler(self.state))
+        self._thread: threading.Thread | None = None
+
+    @property
+    def url(self) -> str:
+        h, p = self.httpd.server_address[:2]
+        return f"http://{h}:{p}"
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self.httpd.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self.httpd.shutdown()
+        self.httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+            self._thread = None

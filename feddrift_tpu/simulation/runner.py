@@ -37,6 +37,7 @@ from feddrift_tpu.models import create_model
 from feddrift_tpu.parallel.mesh import make_mesh, shard_client_arrays, replicate
 from feddrift_tpu.utils.metrics import MetricsLogger
 from feddrift_tpu.utils.prng import experiment_key, round_key
+from feddrift_tpu.utils.tracing import PhaseTracer
 
 log = logging.getLogger("feddrift_tpu")
 
@@ -85,6 +86,7 @@ class Experiment:
         self.global_round = 0
         self.start_iteration = 0
         self.out_dir = out_dir
+        self.tracer = PhaseTracer()
 
     # ------------------------------------------------------------------
     def evaluate(self, t: int, round_idx: int) -> dict:
@@ -163,7 +165,8 @@ class Experiment:
     def run_iteration(self, t: int) -> None:
         cfg = self.cfg
         t0 = time.time()
-        self.algo.begin_iteration(t)
+        with self.tracer.phase("cluster"):   # drift detection / clustering
+            self.algo.begin_iteration(t)
         opt_states = self.step.init_opt_states(
             self.pool.params, self.pool.num_models, self.C_pad)
 
@@ -172,20 +175,30 @@ class Experiment:
             tw = self._pad_clients(tw)                  # phantom clients: w=0
             sw = self._pad_clients(sw, value=1.0)
             prev_params = self.pool.params
-            new_params, opt_states, client_params, n, losses = self.step.train_round(
-                prev_params, opt_states, round_key(self.key, t, r),
-                self.x, self.y, tw, sw, fm, lr_scale)
-            self.pool.params = self.algo.after_round(
-                t, r, prev_params, new_params, client_params, n)
+            with self.tracer.phase("train_round"):
+                new_params, opt_states, client_params, n, losses = self.step.train_round(
+                    prev_params, opt_states, round_key(self.key, t, r),
+                    self.x, self.y, tw, sw, fm, lr_scale)
+                if cfg.trace_sync:
+                    # attribute device time to this phase instead of letting
+                    # async dispatch spill it into whichever phase blocks next
+                    jax.block_until_ready(new_params)
+                self.pool.params = self.algo.after_round(
+                    t, r, prev_params, new_params, client_params, n)
             if r % cfg.frequency_of_the_test == 0 or r == cfg.comm_round - 1:
-                self.evaluate(t, r)
+                with self.tracer.phase("eval"):
+                    self.evaluate(t, r)
             self.global_round += 1
 
-        self.algo.end_iteration(t)
+        with self.tracer.phase("cluster"):
+            self.algo.end_iteration(t)
         if self.cfg.checkpoint_every_iteration and self.out_dir:
             self.save_checkpoint(t)
         log.info("iteration %d done in %.1fs (Test/Acc=%.4f)", t,
                  time.time() - t0, self.logger.last("Test/Acc", -1))
+        self.tracer.log_summary(prefix=f"iter {t}: ")
+        self.last_phase_summary = self.tracer.summary()
+        self.tracer.reset()   # per-iteration deltas, not cumulative totals
 
     def run(self) -> MetricsLogger:
         for t in range(self.start_iteration, self.cfg.train_iterations):

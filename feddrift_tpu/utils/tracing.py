@@ -1,0 +1,88 @@
+"""Tracing & profiling.
+
+The reference's only instrumentation is wall-clock logging of the aggregate
+step ("aggregate time cost", FedAvgEnsAggregatorSoftCluster.py:193-194) plus
+setproctitle labels (SURVEY.md §5 'Tracing/profiling: nothing beyond...').
+Here per-phase timing is first-class and the XLA profiler is one context
+manager away.
+
+Usage:
+    tracer = PhaseTracer()
+    with tracer.phase("cluster"):
+        ...
+    with tracer.phase("train_round"):
+        ...
+    tracer.summary()  # {"cluster": {"total_s": ..., "count": ...}, ...}
+
+    with xla_trace("/tmp/trace"):   # TensorBoard-loadable XLA trace
+        run_step()
+"""
+
+from __future__ import annotations
+
+import contextlib
+import logging
+import time
+from collections import defaultdict
+from typing import Iterator
+
+log = logging.getLogger("feddrift_tpu")
+
+
+class PhaseTracer:
+    """Accumulates wall-clock per named phase; nestable and re-entrant."""
+
+    def __init__(self) -> None:
+        self.totals: dict[str, float] = defaultdict(float)
+        self.counts: dict[str, int] = defaultdict(int)
+
+    @contextlib.contextmanager
+    def phase(self, name: str) -> Iterator[None]:
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            dt = time.perf_counter() - t0
+            self.totals[name] += dt
+            self.counts[name] += 1
+
+    def summary(self) -> dict[str, dict[str, float]]:
+        return {name: {"total_s": self.totals[name],
+                       "count": self.counts[name],
+                       "mean_s": self.totals[name] / max(self.counts[name], 1)}
+                for name in self.totals}
+
+    def log_summary(self, prefix: str = "") -> None:
+        for name, s in sorted(self.summary().items()):
+            log.info("%sphase %-16s total=%.3fs mean=%.4fs n=%d",
+                     prefix, name, s["total_s"], s["mean_s"], s["count"])
+
+    def reset(self) -> None:
+        self.totals.clear()
+        self.counts.clear()
+
+
+@contextlib.contextmanager
+def xla_trace(log_dir: str) -> Iterator[None]:
+    """jax.profiler trace (TensorBoard format). No-op-safe: if the profiler
+    cannot start (e.g. already active), the body still runs."""
+    import jax
+    started = False
+    try:
+        jax.profiler.start_trace(log_dir)
+        started = True
+    except Exception as e:                      # pragma: no cover
+        log.warning("xla_trace: profiler unavailable (%s)", e)
+    try:
+        yield
+    finally:
+        if started:
+            jax.profiler.stop_trace()
+
+
+@contextlib.contextmanager
+def annotate(name: str) -> Iterator[None]:
+    """Named region inside a trace (shows up on the TraceMe timeline)."""
+    import jax
+    with jax.profiler.TraceAnnotation(name):
+        yield

@@ -1,0 +1,130 @@
+"""Serving executor + Dirichlet partition tests."""
+
+import json
+import urllib.request
+
+import numpy as np
+
+
+def _post(url, obj):
+    req = urllib.request.Request(url, data=json.dumps(obj).encode(),
+                                 headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=10) as r:
+        return json.loads(r.read())
+
+
+def _get(url):
+    with urllib.request.urlopen(url, timeout=10) as r:
+        return json.loads(r.read())
+
+
+class TestServingExecutor:
+    def test_register_train_aggregate_cycle(self):
+        from feddrift_tpu.platform.serving import ServingExecutor
+        ex = ServingExecutor({"w": np.zeros((2,), np.float32)})
+        ex.start()
+        try:
+            d0 = _post(ex.url + "/api/register", {})["device_id"]
+            d1 = _post(ex.url + "/api/register", {})["device_id"]
+            assert {d0, d1} == {0, 1}
+            m = _get(ex.url + "/api/get_model")
+            assert m["round"] == 0 and m["params"]["w"] == [0.0, 0.0]
+            # device 0 uploads w=[2,2] with n=1; device 1 w=[8,8] with n=3
+            r = _post(ex.url + "/api/upload_model",
+                      {"device_id": d0, "num_samples": 1,
+                       "params": {"w": [2.0, 2.0]}})
+            assert r["round"] == 0      # waiting for device 1
+            r = _post(ex.url + "/api/upload_model",
+                      {"device_id": d1, "num_samples": 3,
+                       "params": {"w": [8.0, 8.0]}})
+            assert r["round"] == 1      # aggregated
+            m = _get(ex.url + "/api/get_model")
+            np.testing.assert_allclose(m["params"]["w"], [6.5, 6.5])
+        finally:
+            ex.stop()
+
+    def test_unregistered_device_rejected(self):
+        from feddrift_tpu.platform.serving import ServingExecutor
+        ex = ServingExecutor({"w": np.zeros((1,), np.float32)})
+        ex.start()
+        try:
+            _post(ex.url + "/api/register", {})
+            try:
+                _post(ex.url + "/api/upload_model",
+                      {"device_id": 100, "num_samples": 1,
+                       "params": {"w": [1.0]}})
+                assert False, "expected 400"
+            except urllib.error.HTTPError as e:
+                assert e.code == 400
+            assert ex.state.round == 0 and not ex.state.uploads
+        finally:
+            ex.stop()
+
+    def test_wrong_param_keys_rejected(self):
+        from feddrift_tpu.platform.serving import ServingExecutor
+        ex = ServingExecutor({"w": np.zeros((1,), np.float32)})
+        ex.start()
+        try:
+            d = _post(ex.url + "/api/register", {})["device_id"]
+            try:
+                _post(ex.url + "/api/upload_model",
+                      {"device_id": d, "num_samples": 1,
+                       "params": {"not_w": [1.0]}})
+                assert False, "expected 400"
+            except urllib.error.HTTPError as e:
+                assert e.code == 400
+            # server not wedged: a correct upload still aggregates
+            r = _post(ex.url + "/api/upload_model",
+                      {"device_id": d, "num_samples": 1,
+                       "params": {"w": [3.0]}})
+            assert r["round"] == 1
+        finally:
+            ex.stop()
+
+    def test_bad_request(self):
+        from feddrift_tpu.platform.serving import ServingExecutor
+        ex = ServingExecutor({"w": np.zeros((1,), np.float32)})
+        ex.start()
+        try:
+            try:
+                _post(ex.url + "/api/upload_model", {"device_id": 0})
+                assert False, "expected 400"
+            except urllib.error.HTTPError as e:
+                assert e.code == 400
+        finally:
+            ex.stop()
+
+
+import urllib.error  # noqa: E402
+
+
+class TestPartition:
+    def test_homo_covers_all(self):
+        from feddrift_tpu.data.partition import partition_homo
+        parts = partition_homo(103, 4, seed=1)
+        allidx = np.concatenate(parts)
+        assert len(allidx) == 103 and len(np.unique(allidx)) == 103
+
+    def test_hetero_dirichlet_skew(self):
+        from feddrift_tpu.data.partition import (partition_hetero,
+                                                 partition_counts)
+        rng = np.random.default_rng(0)
+        y = rng.integers(0, 10, size=2000).astype(np.int64)
+        parts = partition_hetero(y, 8, alpha=0.2, seed=3)
+        allidx = np.concatenate(parts)
+        assert len(np.unique(allidx)) == len(allidx) == 2000
+        assert min(len(p) for p in parts) >= 10
+        counts = partition_counts(y, parts, 10)
+        assert counts.shape == (8, 10)
+        # low alpha -> label skew: per-client class distribution far from
+        # uniform for at least some clients
+        frac = counts / counts.sum(axis=1, keepdims=True)
+        assert (frac.max(axis=1) > 0.3).any()
+
+    def test_hetero_high_alpha_balanced(self):
+        from feddrift_tpu.data.partition import partition_hetero
+        rng = np.random.default_rng(1)
+        y = rng.integers(0, 10, size=2000).astype(np.int64)
+        parts = partition_hetero(y, 4, alpha=100.0, seed=5)
+        sizes = np.array([len(p) for p in parts])
+        assert sizes.min() > 0.5 * sizes.mean()

@@ -1,0 +1,60 @@
+"""Tracing subsystem tests."""
+
+import time
+
+import numpy as np
+
+
+class TestPhaseTracer:
+    def test_accumulates(self):
+        from feddrift_tpu.utils.tracing import PhaseTracer
+        tr = PhaseTracer()
+        for _ in range(3):
+            with tr.phase("a"):
+                time.sleep(0.01)
+        with tr.phase("b"):
+            pass
+        s = tr.summary()
+        assert s["a"]["count"] == 3 and s["a"]["total_s"] >= 0.03
+        assert s["b"]["count"] == 1
+        assert abs(s["a"]["mean_s"] - s["a"]["total_s"] / 3) < 1e-9
+        tr.reset()
+        assert tr.summary() == {}
+
+    def test_exception_still_recorded(self):
+        from feddrift_tpu.utils.tracing import PhaseTracer
+        tr = PhaseTracer()
+        try:
+            with tr.phase("boom"):
+                raise RuntimeError
+        except RuntimeError:
+            pass
+        assert tr.summary()["boom"]["count"] == 1
+
+    def test_runner_integration(self):
+        from feddrift_tpu.config import ExperimentConfig
+        from feddrift_tpu.simulation.runner import Experiment
+        cfg = ExperimentConfig(dataset="sea", model="fnn",
+                               concept_drift_algo="win-1",
+                               train_iterations=1, comm_round=2, epochs=1,
+                               sample_num=16, batch_size=8,
+                               client_num_in_total=4, client_num_per_round=4,
+                               concept_num=2, frequency_of_the_test=1)
+        exp = Experiment(cfg)
+        exp.run_iteration(0)
+        s = exp.last_phase_summary
+        assert s["train_round"]["count"] == 2
+        assert s["eval"]["count"] == 2
+        assert s["cluster"]["count"] == 2   # begin + end
+        assert all(np.isfinite(v["total_s"]) for v in s.values())
+        # per-iteration deltas: tracer resets between iterations
+        assert exp.tracer.summary() == {}
+
+
+class TestAnnotate:
+    def test_annotation_context(self):
+        import jax.numpy as jnp
+        from feddrift_tpu.utils.tracing import annotate
+        with annotate("region"):
+            x = jnp.ones((4,)) * 2
+        assert float(x.sum()) == 8.0
