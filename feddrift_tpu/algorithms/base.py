@@ -114,8 +114,19 @@ class DriftAlgorithm:
 
     def after_round(self, t: int, r: int, prev_params, agg_params,
                     client_params, n) -> Any:
-        """Return the params the pool adopts for the next round."""
+        """Return the params the pool adopts for the next round.
+
+        In chunked execution (``chunkable``) this is only called at chunk
+        boundaries with ``prev_params=None, client_params=None`` — an
+        algorithm that needs either every round must keep chunkable False.
+        """
         return agg_params
+
+    def chunkable(self, t: int) -> bool:
+        """True if rounds of time step t may run as one device program
+        (TrainStep.train_rounds_eval): round_inputs must be round-invariant and
+        after_round must not need per-round host work. Default conservative."""
+        return False
 
     def end_iteration(self, t: int) -> None:
         pass

@@ -39,6 +39,9 @@ class WindowBaseline(DriftAlgorithm):
     def round_inputs(self, t: int, r: int):
         return self._tw, self._ones_sample_w, self._ones_feat_mask, jnp.float32(1.0)
 
+    def chunkable(self, t: int) -> bool:
+        return True
+
 
 @register_algorithm("exp", "lin")
 class RecencyWeighted(DriftAlgorithm):
@@ -54,3 +57,6 @@ class RecencyWeighted(DriftAlgorithm):
 
     def round_inputs(self, t: int, r: int):
         return self._tw, self._ones_sample_w, self._ones_feat_mask, jnp.float32(1.0)
+
+    def chunkable(self, t: int) -> bool:
+        return True

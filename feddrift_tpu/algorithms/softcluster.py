@@ -95,6 +95,11 @@ class SoftCluster(DriftAlgorithm):
     def round_inputs(self, t: int, r: int):
         return self._tw, self._ones_sample_w, self._ones_feat_mask, jnp.float32(1.0)
 
+    def chunkable(self, t: int) -> bool:
+        # cfl needs per-round split checks on client updates; hard-r
+        # re-clusters every round (after_round above) — both steer per round
+        return self.kind not in ("cfl", "hard-r")
+
     def test_model_idx(self, t: int) -> np.ndarray:
         return np.argmax(self.weights[t], axis=0)        # (:1257-1258)
 

@@ -132,6 +132,9 @@ class DriftSurf(DriftAlgorithm):
     def round_inputs(self, t: int, r: int):
         return self._tw, self._ones_sample_w, self._ones_feat_mask, jnp.float32(1.0)
 
+    def chunkable(self, t: int) -> bool:
+        return True
+
     def end_iteration(self, t: int) -> None:
         for idx, key in enumerate(self.train_keys):
             self.key_params[key] = self.pool.slot(idx)
@@ -253,6 +256,9 @@ class MultiModel(DriftAlgorithm):
 
     def round_inputs(self, t: int, r: int):
         return self._tw, self._ones_sample_w, self._ones_feat_mask, jnp.float32(1.0)
+
+    def chunkable(self, t: int) -> bool:
+        return True
 
     def end_iteration(self, t: int) -> None:
         # Arm the drift detector: train accuracy of each client's model at

@@ -45,7 +45,13 @@ class _Manager(Observer):
             log.warning("rank %d: dropping message with unhandled type %s "
                         "from rank %d", self.rank, msg_type, msg.sender_id)
             return
-        handler(msg)
+        try:
+            handler(msg)
+        except Exception:
+            # same rationale as the unknown-type drop: a raising handler must
+            # not kill the transport's (possibly daemon-threaded) receive loop
+            log.exception("rank %d: handler for msg_type=%s raised; "
+                          "message dropped", self.rank, msg_type)
 
     def send_message(self, msg: Message) -> None:
         self.com_manager.send_message(msg)

@@ -2,21 +2,20 @@
 
 The reference synchronizes hosts by MPI point-to-point sends of pickled
 state_dicts (mpi_send_thread.py:27). In a TPU pod the equivalent is: every
-host holds the same jitted program, and cross-host agreement on *array* state
-is a collective — here implemented as psum-style broadcast/mean over the
-devices of all processes, following jax.experimental.multihost_utils'
-technique (zero out on non-source hosts, all-reduce).
+host runs the same program and cross-host agreement on *array* state is a
+collective. These wrappers delegate to jax.experimental.multihost_utils —
+the supported implementation of the zero-on-non-source + all-reduce trick —
+so every process compiles the identical program, which is a hard requirement
+of JAX's multi-controller model.
 
-Single-process (this environment, and all tests): these degrade to cheap
-device round-trips, so the same experiment code runs unmodified from laptop
-sim to pod.
+Single-process (this environment, and all tests): the helpers are identity
+functions, so the same experiment code runs unmodified from laptop sim to
+pod.
 """
 
 from __future__ import annotations
 
 import jax
-import jax.numpy as jnp
-import numpy as np
 
 
 def process_count() -> int:
@@ -28,55 +27,29 @@ def is_coordinator() -> bool:
 
 
 def broadcast_from_coordinator(tree):
-    """Every host returns the coordinator's pytree value.
-
-    Technique of multihost_utils.broadcast_one_to_all: non-coordinator hosts
-    contribute zeros; a global psum over all hosts' devices reconstructs the
-    coordinator's arrays everywhere.
-    """
+    """Every host returns process 0's pytree value."""
     if jax.process_count() == 1:
         return tree
-    scale = 1.0 if is_coordinator() else 0.0
+    from jax.experimental import multihost_utils
+    return multihost_utils.broadcast_one_to_all(tree)
 
-    mesh = jax.sharding.Mesh(np.asarray(jax.devices()), ("all",))
 
-    def bcast(leaf):
-        leaf = jnp.asarray(leaf) * scale
-
-        def psum_leaf(x):
-            return jax.lax.psum(x, "all") / jax.lax.psum(
-                jnp.float32(scale), "all")
-
-        return jax.jit(
-            jax.shard_map(psum_leaf, mesh=mesh, in_specs=jax.sharding.PartitionSpec(),
-                          out_specs=jax.sharding.PartitionSpec()))(leaf)
-
-    return jax.tree_util.tree_map(bcast, tree)
+def _gather(tree):
+    from jax.experimental import multihost_utils
+    return multihost_utils.process_allgather(tree)   # leading [P] axis
 
 
 def all_hosts_mean(tree):
     """Mean of each host's pytree across hosts (metric aggregation)."""
     if jax.process_count() == 1:
         return tree
-    n = jax.process_count()
-    summed = broadcast_sum(tree)
-    return jax.tree_util.tree_map(lambda l: l / n, summed)
+    g = _gather(tree)
+    return jax.tree_util.tree_map(lambda l: l.mean(axis=0), g)
 
 
 def broadcast_sum(tree):
-    """Element-wise sum of every host's contribution (one value per host:
-    each host's devices are assumed to hold identical replicas, so the psum
-    over all devices is divided back by local device count)."""
+    """Element-wise sum of every host's contribution."""
     if jax.process_count() == 1:
         return tree
-    ldc = jax.local_device_count()
-    mesh = jax.sharding.Mesh(np.asarray(jax.devices()), ("all",))
-
-    def red(leaf):
-        def psum_leaf(x):
-            return jax.lax.psum(x, "all") / ldc
-        return jax.jit(
-            jax.shard_map(psum_leaf, mesh=mesh, in_specs=jax.sharding.PartitionSpec(),
-                          out_specs=jax.sharding.PartitionSpec()))(jnp.asarray(leaf))
-
-    return jax.tree_util.tree_map(red, tree)
+    g = _gather(tree)
+    return jax.tree_util.tree_map(lambda l: l.sum(axis=0), g)

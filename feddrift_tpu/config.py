@@ -41,6 +41,9 @@ class ExperimentConfig:
     batch_size: int = 500
     fnn_hidden_dim: int = 10
     fmow_image_size: int = 32          # fmow partition image resolution
+    chunk_rounds: bool = True          # scan rounds between evals as one
+                                       # device program when the algorithm
+                                       # permits (bitwise-identical results)
     trace_sync: bool = False           # block on device inside traced phases
                                        # for exact per-phase attribution (off:
                                        # keep async dispatch for throughput)

@@ -141,12 +141,10 @@ class TrainStep:
         return p_out, o_out, n, losses.mean()
 
     # ------------------------------------------------------------------
-    @partial(jax.jit, static_argnums=0)
-    def train_round(self, params, opt_states, key, x, y, time_w, sample_w,
+    def _round_body(self, params, opt_states, key, x, y, time_w, sample_w,
                     feat_mask, lr_scale):
-        """One communication round. Returns (new_params [M, ...],
-        new_opt_states, client_params [M, C, ...], n [M, C], mean_loss [M, C]).
-        """
+        """One communication round (untraced body shared by train_round and
+        the chunked train_rounds_eval scan)."""
         M = time_w.shape[0]
         C = x.shape[0]
         keys = jax.random.split(key, M * C).reshape(M, C, 2)
@@ -174,6 +172,56 @@ class TrainStep:
         new_params = jax.tree_util.tree_map(avg, client_params, params)
         return new_params, new_opt, client_params, n, losses
 
+    @partial(jax.jit, static_argnums=0)
+    def train_round(self, params, opt_states, key, x, y, time_w, sample_w,
+                    feat_mask, lr_scale):
+        """One communication round. Returns (new_params [M, ...],
+        new_opt_states, client_params [M, C, ...], n [M, C], mean_loss [M, C]).
+        """
+        return self._round_body(params, opt_states, key, x, y, time_w,
+                                sample_w, feat_mask, lr_scale)
+
+    @partial(jax.jit, static_argnums=0, donate_argnums=(1, 2))
+    def train_rounds_eval(self, params, opt_states, iter_key, x, y, time_w,
+                          sample_w, feat_mask, lr_scale, round_idxs, t):
+        """K communication rounds + fused end-of-chunk evaluation as ONE
+        device program.
+
+        Valid when the steering inputs are round-invariant and no host-side
+        after_round work happens between the rounds (DriftAlgorithm.chunkable)
+        — the steady-state round loop of most algorithms. The per-round PRNG
+        key is fold_in(iter_key, r), identical to what the per-round path
+        receives from utils.prng.round_key, so chunked and unchunked
+        trajectories are bitwise-identical.
+
+        After the lax.scan over round_idxs ([K] int32, traced so one compile
+        serves every chunk of the same length), the [M, C] train (step t) and
+        test (step t+1, the temporal holdout of retrain.py:78-83)
+        accuracy/loss matrices are computed on the final params inside the
+        same program, so an eval costs zero extra host round-trips over the
+        TPU link. ``t`` is traced. x: [C, T1, N, ...]. Returns (params,
+        opt_states, n, losses, (corr_tr, loss_tr, corr_te, loss_te) all
+        [M, C], total [C]).
+        """
+        def one(carry, r):
+            p, o = carry
+            key = jax.random.fold_in(iter_key, r)
+            p, o, _cp, n, losses = self._round_body(
+                p, o, key, x, y, time_w, sample_w, feat_mask, lr_scale)
+            return (p, o), (n, losses)
+
+        (params, opt_states), (ns, ls) = jax.lax.scan(
+            one, (params, opt_states), round_idxs)
+
+        xt = jnp.take(x, t, axis=1)
+        yt = jnp.take(y, t, axis=1)
+        xe = jnp.take(x, t + 1, axis=1)
+        ye = jnp.take(y, t + 1, axis=1)
+        corr_tr, loss_tr, total = self._acc_matrix_body(params, xt, yt, feat_mask)
+        corr_te, loss_te, _ = self._acc_matrix_body(params, xe, ye, feat_mask)
+        return (params, opt_states, ns[-1], ls[-1],
+                (corr_tr, loss_tr, corr_te, loss_te), total)
+
     # ------------------------------------------------------------------
     @partial(jax.jit, static_argnums=0)
     def acc_matrix(self, params, x, y, feat_mask):
@@ -184,6 +232,9 @@ class TrainStep:
         FedAvgEnsDataLoader.py:1074-1085) — with one [M, C, N] forward.
         x: [C, N, ...]; returns (correct [M, C], loss_sum [M, C], total [C]).
         """
+        return self._acc_matrix_body(params, x, y, feat_mask)
+
+    def _acc_matrix_body(self, params, x, y, feat_mask):
         def one(p_m, f_m):
             def per_client(xc, yc):
                 xin = xc * f_m if xc.dtype != jnp.int32 else xc

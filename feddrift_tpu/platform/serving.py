@@ -61,6 +61,11 @@ class ServingState:
                 float(num_samples))
             if len(self.uploads) >= self.next_device and self.next_device > 0:
                 total = sum(n for _, n in self.uploads.values())
+                if total <= 0:
+                    # un-wedge: drop the round's uploads and report the error
+                    self.uploads = {}
+                    raise ValueError("all uploads reported num_samples <= 0; "
+                                     "round discarded")
                 agg = {k: np.zeros_like(v) for k, v in self.params.items()}
                 for p, n in self.uploads.values():
                     for k in agg:
@@ -93,7 +98,11 @@ def _make_handler(state: ServingState):
 
         def do_POST(self):
             length = int(self.headers.get("Content-Length", 0))
-            body = json.loads(self.rfile.read(length) or b"{}")
+            try:
+                body = json.loads(self.rfile.read(length) or b"{}")
+            except json.JSONDecodeError as e:
+                self._json(400, {"error": f"malformed JSON body: {e}"})
+                return
             if self.path == "/api/register":
                 self._json(200, {"device_id": state.register()})
             elif self.path == "/api/upload_model":

@@ -36,7 +36,7 @@ from feddrift_tpu.data.registry import make_dataset
 from feddrift_tpu.models import create_model
 from feddrift_tpu.parallel.mesh import make_mesh, shard_client_arrays, replicate
 from feddrift_tpu.utils.metrics import MetricsLogger
-from feddrift_tpu.utils.prng import experiment_key, round_key
+from feddrift_tpu.utils.prng import experiment_key, iteration_key, round_key
 from feddrift_tpu.utils.tracing import PhaseTracer
 
 log = logging.getLogger("feddrift_tpu")
@@ -89,21 +89,40 @@ class Experiment:
         self.tracer = PhaseTracer()
 
     # ------------------------------------------------------------------
-    def evaluate(self, t: int, round_idx: int) -> dict:
+    def evaluate(self, t: int, round_idx: int, precomputed=None) -> dict:
         """Reference ``test_on_all_clients`` (AggregatorSoftCluster.py:210-285):
         per-client train acc on step t with that client's plurality model, and
         test acc on step t+1 data (temporal holdout); AUE/KUE use ensemble
-        votes instead (FedAvgEnsAggregatorAue.py:256-283, Kue:234-262)."""
+        votes instead (FedAvgEnsAggregatorAue.py:256-283, Kue:234-262).
+
+        ``precomputed``: optional ((corr_tr, loss_tr, corr_te, loss_te),
+        total) matrices already computed on device inside the chunked train
+        program (TrainStep.train_rounds_eval) — skips both acc_matrix calls.
+        """
         cfg = self.cfg
         C = self.C_
-        xt, yt = self.x[:, t], self.y[:, t]
         xtest, ytest = self.x[:, t + 1], self.y[:, t + 1]
         fm = self.algo.round_inputs(t, round_idx)[2]
 
-        correct, loss_sum, total = self.step.acc_matrix(self.pool.params, xt, yt, fm)
-        correct = np.asarray(correct)[:, :C]
-        loss_sum = np.asarray(loss_sum)[:, :C]
-        total = np.asarray(total)[:C]
+        spec = self.algo.ensemble_spec(t)
+        if precomputed is not None:
+            # one bulk D2H transfer: per-array fetches each pay a host<->TPU
+            # round-trip, which dominated eval time on tunneled links
+            (correct, loss_sum, corr_te, loss_te), total = \
+                jax.device_get(precomputed)
+        else:
+            xt, yt = self.x[:, t], self.y[:, t]
+            fetch = [self.step.acc_matrix(self.pool.params, xt, yt, fm)]
+            if spec is None:
+                fetch.append(self.step.acc_matrix(
+                    self.pool.params, xtest, ytest, fm))
+            fetched = jax.device_get(fetch)
+            correct, loss_sum, total = fetched[0]
+            if spec is None:
+                corr_te, loss_te, _ = fetched[1]
+        correct = correct[:, :C]
+        loss_sum = loss_sum[:, :C]
+        total = total[:C]
 
         tidx = self.algo.train_model_idx(t)                    # [C]
         idx = self.algo.test_model_idx(t)                      # [C]
@@ -111,13 +130,10 @@ class Experiment:
         train_correct = correct[tidx, cr]
         train_loss = loss_sum[tidx, cr]
 
-        spec = self.algo.ensemble_spec(t)
         if spec is None:
-            tcorrect, tloss_sum, ttotal = self.step.acc_matrix(
-                self.pool.params, xtest, ytest, fm)
-            tcorrect = np.asarray(tcorrect)[:, :C][idx, cr]
-            tloss = np.asarray(tloss_sum)[:, :C][idx, cr]
-            ttotal = np.asarray(ttotal)[:C]
+            tcorrect = corr_te[:, :C][idx, cr]
+            tloss = loss_te[:, :C][idx, cr]
+            ttotal = total
         else:
             ew = jnp.asarray(spec.weights, jnp.float32)
             if ew.ndim == 2:  # per-client weights (AUE-PC): pad phantom clients
@@ -127,9 +143,10 @@ class Experiment:
                 None if spec.model_mask is None
                 else jnp.asarray(spec.model_mask, jnp.float32),
                 fm)
-            tcorrect = np.asarray(ec)[:C]
-            ttotal = np.asarray(et)[:C]
-            tloss = np.asarray(el)[:C]
+            ec, et, el = jax.device_get((ec, et, el))
+            tcorrect = ec[:C]
+            ttotal = et[:C]
+            tloss = el[:C]
 
         metrics = {
             "round": self.global_round,
@@ -170,6 +187,24 @@ class Experiment:
         opt_states = self.step.init_opt_states(
             self.pool.params, self.pool.num_models, self.C_pad)
 
+        if cfg.chunk_rounds and self.algo.chunkable(t):
+            self._run_rounds_chunked(t, opt_states)
+        else:
+            self._run_rounds(t, opt_states)
+
+        with self.tracer.phase("cluster"):
+            self.algo.end_iteration(t)
+        if self.cfg.checkpoint_every_iteration and self.out_dir:
+            self.save_checkpoint(t)
+        log.info("iteration %d done in %.1fs (Test/Acc=%.4f)", t,
+                 time.time() - t0, self.logger.last("Test/Acc", -1))
+        self.tracer.log_summary(prefix=f"iter {t}: ")
+        self.last_phase_summary = self.tracer.summary()
+        self.tracer.reset()   # per-iteration deltas, not cumulative totals
+
+    def _run_rounds(self, t: int, opt_states) -> None:
+        """Per-round host loop: algorithms that steer every round."""
+        cfg = self.cfg
         for r in range(cfg.comm_round):
             tw, sw, fm, lr_scale = self.algo.round_inputs(t, r)
             tw = self._pad_clients(tw)                  # phantom clients: w=0
@@ -190,15 +225,44 @@ class Experiment:
                     self.evaluate(t, r)
             self.global_round += 1
 
-        with self.tracer.phase("cluster"):
-            self.algo.end_iteration(t)
-        if self.cfg.checkpoint_every_iteration and self.out_dir:
-            self.save_checkpoint(t)
-        log.info("iteration %d done in %.1fs (Test/Acc=%.4f)", t,
-                 time.time() - t0, self.logger.last("Test/Acc", -1))
-        self.tracer.log_summary(prefix=f"iter {t}: ")
-        self.last_phase_summary = self.tracer.summary()
-        self.tracer.reset()   # per-iteration deltas, not cumulative totals
+    def _run_rounds_chunked(self, t: int, opt_states) -> None:
+        """Scan consecutive rounds between eval points as ONE device program
+        (TrainStep.train_rounds_eval) — removes per-round dispatch overhead, which
+        dominates wall-clock for small models exactly as the reference's
+        0.3 s comm polls did (SURVEY.md §7 'Wall-clock target'). Bitwise-
+        identical trajectories: the scan folds the same per-round keys.
+
+        Only entered when the algorithm declared chunkable(t): round_inputs
+        round-invariant and no per-round after_round work, so after_round is
+        called once per chunk with prev_params/client_params None.
+        """
+        cfg = self.cfg
+        R, freq = cfg.comm_round, cfg.frequency_of_the_test
+        it_key = iteration_key(self.key, t)
+        tw, sw, fm, lr_scale = self.algo.round_inputs(t, 0)
+        tw = self._pad_clients(tw)
+        sw = self._pad_clients(sw, value=1.0)
+        g0 = self.global_round
+        r = 0
+        while r < R:
+            # this chunk ends at the next eval round (inclusive):
+            # evals land on r % freq == 0 and on the final round
+            end = r if r % freq == 0 else min((r // freq + 1) * freq, R - 1)
+            idxs = jnp.arange(r, end + 1, dtype=jnp.int32)
+            with self.tracer.phase("train_round"):
+                new_params, opt_states, n, losses, acc_mats, total = \
+                    self.step.train_rounds_eval(
+                        self.pool.params, opt_states, it_key, self.x, self.y,
+                        tw, sw, fm, lr_scale, idxs, jnp.int32(t))
+                if cfg.trace_sync:
+                    jax.block_until_ready(new_params)
+                self.pool.params = self.algo.after_round(
+                    t, end, None, new_params, None, n)
+            self.global_round = g0 + end
+            with self.tracer.phase("eval"):
+                self.evaluate(t, end, precomputed=(acc_mats, total))
+            r = end + 1
+        self.global_round = g0 + R
 
     def run(self) -> MetricsLogger:
         for t in range(self.start_iteration, self.cfg.train_iterations):

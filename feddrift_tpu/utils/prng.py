@@ -19,7 +19,13 @@ def experiment_key(seed: int) -> jax.Array:
     return jax.random.PRNGKey(seed)
 
 
-def round_key(seed_key: jax.Array, t: int, r: int, purpose: str = "train") -> jax.Array:
+def iteration_key(seed_key: jax.Array, t: int, purpose: str = "train") -> jax.Array:
+    """Key for one (purpose, time step); fold_in(r) yields the round key —
+    the device-side chunked round loop (TrainStep.train_rounds_eval) does exactly
+    that, keeping chunked and per-round execution bitwise-identical."""
     k = jax.random.fold_in(seed_key, PURPOSES[purpose])
-    k = jax.random.fold_in(k, t)
-    return jax.random.fold_in(k, r)
+    return jax.random.fold_in(k, t)
+
+
+def round_key(seed_key: jax.Array, t: int, r: int, purpose: str = "train") -> jax.Array:
+    return jax.random.fold_in(iteration_key(seed_key, t, purpose), r)

@@ -35,6 +35,21 @@ class TestEndToEnd:
         # flipped concepts (preset A) -> win-1 single model falls toward 0.5
         assert by_round[35] < 0.75, by_round
 
+    def test_chunked_matches_per_round(self):
+        # the scanned multi-round program must reproduce the per-round host
+        # loop bitwise (same fold_in key sequence)
+        a = run_experiment(_cfg(chunk_rounds=True)).logger.series("Test/Acc")
+        b = run_experiment(_cfg(chunk_rounds=False)).logger.series("Test/Acc")
+        assert a == b, (a, b)
+
+    def test_chunked_matches_per_round_softcluster(self):
+        kw = dict(concept_drift_algo="softcluster",
+                  concept_drift_algo_arg="H_A_C_1_10_0", concept_num=3,
+                  train_iterations=3, comm_round=8, frequency_of_the_test=4)
+        a = run_experiment(_cfg(chunk_rounds=True, **kw)).logger.series("Test/Acc")
+        b = run_experiment(_cfg(chunk_rounds=False, **kw)).logger.series("Test/Acc")
+        assert a == b, (a, b)
+
     def test_determinism(self):
         a = run_experiment(_cfg()).logger.series("Test/Acc")
         b = run_experiment(_cfg()).logger.series("Test/Acc")
