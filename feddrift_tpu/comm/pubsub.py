@@ -1,0 +1,123 @@
+"""Topic-based pub/sub transport (the reference's MQTT alternative,
+fedml_core/distributed/communication/mqtt/mqtt_comm_manager.py:14-135).
+
+The reference publishes JSON-serialized messages to a public broker
+(broker.emqx.io) with one topic per receiver id. Here the broker is an
+in-process object with the same topic semantics and the same JSON wire
+constraint — payloads must survive JSON round-trips (lists/floats, not live
+arrays), which is exactly the MQTT manager's contract and what a real broker
+binding would need. Swapping in a network broker means reimplementing
+``Broker`` only; managers and message schema stay untouched.
+"""
+
+from __future__ import annotations
+
+import json
+import queue
+import threading
+from collections import defaultdict
+from typing import Optional
+
+import numpy as np
+
+from feddrift_tpu.comm.base import BaseCommManager
+from feddrift_tpu.comm.message import Message
+
+_STOP = object()
+
+
+def _jsonify(obj):
+    """numpy/jax arrays -> nested lists (MQTT JSON wire format)."""
+    if isinstance(obj, np.ndarray):
+        return obj.tolist()
+    if hasattr(obj, "__array__") and not isinstance(obj, (str, bytes)):
+        return np.asarray(obj).tolist()      # jax.Array and array-likes
+    if isinstance(obj, dict):
+        return {k: _jsonify(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [_jsonify(v) for v in obj]
+    if isinstance(obj, (np.integer,)):
+        return int(obj)
+    if isinstance(obj, (np.floating,)):
+        return float(obj)
+    return obj
+
+
+class Broker:
+    """Topic -> subscriber queues. One topic per endpoint id, as the MQTT
+    manager subscribes to its own client id topic."""
+
+    def __init__(self) -> None:
+        self._subs: dict[str, list[queue.Queue]] = defaultdict(list)
+        self._lock = threading.Lock()
+
+    def subscribe(self, topic: str) -> queue.Queue:
+        q: queue.Queue = queue.Queue()
+        with self._lock:
+            self._subs[topic].append(q)
+        return q
+
+    def publish(self, topic: str, payload: str) -> None:
+        with self._lock:
+            qs = list(self._subs.get(topic, ()))
+        for q in qs:
+            q.put(payload)
+
+    def unsubscribe(self, topic: str, q: queue.Queue) -> None:
+        with self._lock:
+            subs = self._subs.get(topic, [])
+            if q in subs:
+                subs.remove(q)
+            if not subs:
+                self._subs.pop(topic, None)
+
+    def close_topic(self, topic: str) -> None:
+        """Stop + deregister every subscriber of a topic (publishes to a
+        closed topic are dropped, not accumulated in orphaned queues)."""
+        with self._lock:
+            qs = self._subs.pop(topic, [])
+        for q in qs:
+            q.put(_STOP)
+
+
+class PubSubCommManager(BaseCommManager):
+    """MQTT-shaped transport: JSON on the wire, topic = receiver id."""
+
+    def __init__(self, broker: Broker, rank: int) -> None:
+        super().__init__()
+        self.broker = broker
+        self.rank = rank
+        self.topic = str(rank)
+        self._inbox = broker.subscribe(self.topic)
+        self._thread: Optional[threading.Thread] = None
+
+    def send_message(self, msg: Message) -> None:
+        wire = json.dumps({
+            "msg_type": int(msg.msg_type),
+            "sender_id": int(msg.sender_id),
+            "receiver_id": int(msg.receiver_id),
+            "params": _jsonify(msg.params),
+        })
+        self.broker.publish(str(msg.receiver_id), wire)
+
+    def handle_receive_message(self) -> None:
+        while True:
+            item = self._inbox.get()
+            if item is _STOP:
+                return
+            d = json.loads(item)
+            self.notify(Message(d["msg_type"], d["sender_id"],
+                                d["receiver_id"], d["params"]))
+
+    def run_async(self) -> None:
+        self._thread = threading.Thread(target=self.handle_receive_message,
+                                        daemon=True)
+        self._thread.start()
+
+    def stop_receive_message(self) -> None:
+        # deregister first so the broker never enqueues into a dead queue
+        self.broker.unsubscribe(self.topic, self._inbox)
+        self._inbox.put(_STOP)
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+            self._thread = None

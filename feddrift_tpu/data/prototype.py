@@ -33,6 +33,8 @@ SPECS = {
     "MNIST": ((784,), 10),
     "femnist": ((784,), 62),
     "cifar10": ((32, 32, 3), 10),
+    "cifar100": ((32, 32, 3), 100),
+    "cinic10": ((32, 32, 3), 10),
 }
 
 

@@ -17,7 +17,7 @@ from feddrift_tpu.data import changepoints as cp
 from feddrift_tpu.data.drift_dataset import DriftDataset
 from feddrift_tpu.data.prototype import generate_prototype_drift
 from feddrift_tpu.data.synthetic import generate_synthetic
-from feddrift_tpu.data.text import generate_text_drift
+from feddrift_tpu.data.text import generate_text_drift, generate_word_drift
 
 _REGISTRY: dict[str, Callable[..., DriftDataset]] = {}
 
@@ -50,7 +50,7 @@ for _name in ("sea", "sine", "circle"):
             _n, change_points, cfg.train_iterations, cfg.client_num_in_total,
             cfg.sample_num, cfg.noise_prob, cfg.time_stretch, cfg.seed)
 
-for _name in ("MNIST", "femnist", "cifar10"):
+for _name in ("MNIST", "femnist", "cifar10", "cifar100", "cinic10"):
     @register_dataset(_name)
     def _mk_img(cfg: ExperimentConfig, change_points: np.ndarray, *, _n=_name) -> DriftDataset:
         return generate_prototype_drift(
@@ -70,6 +70,13 @@ def _mk_fmow(cfg: ExperimentConfig, change_points: np.ndarray) -> DriftDataset:
 @register_dataset("shakespeare", "fed_shakespeare")
 def _mk_text(cfg: ExperimentConfig, change_points: np.ndarray) -> DriftDataset:
     return generate_text_drift(
+        change_points, cfg.train_iterations, cfg.client_num_in_total,
+        cfg.sample_num, cfg.noise_prob, cfg.time_stretch, cfg.seed)
+
+
+@register_dataset("stackoverflow", "stackoverflow_nwp")
+def _mk_word(cfg: ExperimentConfig, change_points: np.ndarray) -> DriftDataset:
+    return generate_word_drift(
         change_points, cfg.train_iterations, cfg.client_num_in_total,
         cfg.sample_num, cfg.noise_prob, cfg.time_stretch, cfg.seed)
 

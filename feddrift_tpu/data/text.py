@@ -34,6 +34,57 @@ def _concept_transition(concept: int, vocab: int) -> np.ndarray:
     return mat / mat.sum(axis=1, keepdims=True)
 
 
+def generate_word_drift(
+    change_points: np.ndarray,
+    train_iterations: int,
+    num_clients: int,
+    sample_num: int,
+    noise_prob: float = 0.0,
+    time_stretch: int = 1,
+    seed: int = 0,
+    seq_len: int = 20,
+    vocab: int = 10000,
+) -> DriftDataset:
+    """Word-level next-word-prediction drift (StackOverflow NWP scale,
+    reference fedml_api/data_preprocessing/stackoverflow_nwp/, WordLSTM
+    model rnn.py:36-67).
+
+    At 10k vocab a dense Markov matrix would be 800 MB per concept, so each
+    concept k is instead an affine language: next = (a_k * cur + b_k) mod V
+    with per-step uniform noise — a deterministic map the embedding LSTM can
+    learn, whose parameters (the language statistics) change at drift points.
+    """
+    rng = np.random.default_rng(seed)
+    T = train_iterations
+    n_concepts = max(int(change_points.max()) + 1, 2)
+    crng = np.random.default_rng(104729)
+    a = crng.integers(2, vocab - 1, size=n_concepts)
+    b = crng.integers(0, vocab, size=n_concepts)
+
+    x = np.zeros((num_clients, T + 1, sample_num, seq_len), dtype=np.int32)
+    y = np.zeros((num_clients, T + 1, sample_num), dtype=np.int32)
+    concepts = concept_matrix(change_points, T + 1, num_clients, time_stretch)
+    for t in range(T + 1):
+        for c in range(num_clients):
+            k = int(concepts[t, c]) % n_concepts
+            seq = np.zeros((sample_num, seq_len + 1), dtype=np.int64)
+            seq[:, 0] = rng.integers(0, vocab, size=sample_num)
+            noise = rng.random((sample_num, seq_len)) < 0.1
+            repl = rng.integers(0, vocab, size=(sample_num, seq_len))
+            for s in range(seq_len):
+                nxt = (a[k] * seq[:, s] + b[k]) % vocab
+                seq[:, s + 1] = np.where(noise[:, s], repl[:, s], nxt)
+            x[c, t] = seq[:, :seq_len].astype(np.int32)
+            ys = seq[:, seq_len].astype(np.int32)
+            if noise_prob > 0:
+                flip = rng.random(sample_num) < noise_prob
+                ys = np.where(flip, rng.integers(0, vocab, size=sample_num), ys)
+            y[c, t] = ys
+    return DriftDataset(x=x, y=y, num_classes=vocab, concepts=concepts,
+                        name="stackoverflow_nwp", is_sequence=True,
+                        meta={"vocab": vocab, "seq_len": seq_len})
+
+
 def generate_text_drift(
     change_points: np.ndarray,
     train_iterations: int,

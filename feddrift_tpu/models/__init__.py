@@ -67,6 +67,11 @@ def _resnet56(ds: DriftDataset, cfg) -> nn.Module:
     return ResNetCifar(num_classes=ds.num_classes, depth=56)
 
 
+@register_model("resnet110")
+def _resnet110(ds: DriftDataset, cfg) -> nn.Module:
+    return ResNetCifar(num_classes=ds.num_classes, depth=110)
+
+
 @register_model("resnet56_gn")
 def _resnet56gn(ds: DriftDataset, cfg) -> nn.Module:
     return ResNetCifar(num_classes=ds.num_classes, depth=56, norm="group")

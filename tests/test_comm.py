@@ -110,6 +110,63 @@ class TestLoopbackFedAvg:
         assert any("unhandled type" in r.message for r in caplog.records)
 
 
+class TestPubSub:
+    def test_fedavg_over_json_wire(self):
+        # the same manager state machines run over the MQTT-shaped transport
+        from feddrift_tpu.comm.pubsub import Broker, PubSubCommManager
+        C, rounds = 2, 2
+        broker = Broker()
+        server = _FedAvgServer(0, C + 1, PubSubCommManager(broker, 0), rounds,
+                               init_params=0.0)   # JSON wire: plain floats
+        clients = [_FedAvgClient(c, C + 1, PubSubCommManager(broker, c),
+                                 delta=float(c)) for c in range(1, C + 1)]
+        threads = [threading.Thread(target=m.run) for m in [server, *clients]]
+        for th in threads:
+            th.start()
+        server.send_init_msg()
+        for th in threads:
+            th.join(timeout=30)
+        assert not any(th.is_alive() for th in threads)
+        # weighted mean with n=rank: (1*1 + 2*2)/3 per round
+        assert abs(float(server.params) - rounds * (5.0 / 3.0)) < 1e-9
+
+    def test_array_payload_json_roundtrip(self):
+        import time as _time
+        from feddrift_tpu.comm.pubsub import Broker, PubSubCommManager
+        from feddrift_tpu.comm.message import ARG_MODEL_PARAMS
+        broker = Broker()
+        a, b = PubSubCommManager(broker, 0), PubSubCommManager(broker, 1)
+        got = []
+
+        class Sink:
+            def receive_message(self, mt, msg):
+                got.append(msg.get(ARG_MODEL_PARAMS))
+
+        b.add_observer(Sink())
+        b.run_async()
+        m = Message(MsgType.S2C_SYNC_MODEL, 0, 1)
+        m.add_params(ARG_MODEL_PARAMS,
+                     np.arange(6, dtype=np.float32).reshape(2, 3))
+        a.send_message(m)
+        for _ in range(100):
+            if got:
+                break
+            _time.sleep(0.02)
+        b.stop_receive_message()
+        # arrays arrive as nested lists: the JSON wire constraint of MQTT
+        np.testing.assert_allclose(np.asarray(got[0]),
+                                   [[0, 1, 2], [3, 4, 5]])
+        # stopped endpoints are deregistered: no orphaned-queue growth
+        assert "1" not in broker._subs
+        a.send_message(m)   # dropped, not accumulated
+
+    def test_jax_array_payload(self):
+        import jax.numpy as jnp
+        from feddrift_tpu.comm.pubsub import _jsonify
+        out = _jsonify({"w": jnp.ones((2, 2)), "n": np.int64(3)})
+        assert out == {"w": [[1.0, 1.0], [1.0, 1.0]], "n": 3}
+
+
 class TestMultihost:
     def test_single_process_gates(self):
         from feddrift_tpu.comm import multihost as mh

@@ -137,6 +137,25 @@ class TestRegistry:
             c, t = drifted[0]
             assert abs(ds.x[c, t].mean() - ds.x[c, 0].mean()) > 0.01
 
+    def test_make_cifar100_cinic(self):
+        for name, k in (("cifar100", 100), ("cinic10", 10)):
+            cfg = ExperimentConfig(dataset=name, train_iterations=1,
+                                   sample_num=6, client_num_in_total=3,
+                                   client_num_per_round=3)
+            ds = make_dataset(cfg)
+            assert ds.x.shape == (3, 2, 6, 32, 32, 3)
+            assert ds.num_classes == k
+
+    def test_make_stackoverflow_nwp(self):
+        cfg = ExperimentConfig(dataset="stackoverflow_nwp", train_iterations=2,
+                               sample_num=8, client_num_in_total=4,
+                               client_num_per_round=4, change_points="A")
+        ds = make_dataset(cfg)
+        assert ds.x.shape == (4, 3, 8, 20)
+        assert ds.num_classes == 10000 and ds.is_sequence
+        # labels follow the concept's affine map for non-noise steps
+        assert (ds.y >= 0).all() and (ds.y < 10000).all()
+
     def test_rand_changepoints(self):
         cfg = ExperimentConfig(dataset="sea", change_points="rand",
                                train_iterations=6, sample_num=20)
