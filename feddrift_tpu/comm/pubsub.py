@@ -58,10 +58,13 @@ class Broker:
         return q
 
     def publish(self, topic: str, payload: str) -> None:
+        # puts happen under the lock (queue.Queue is unbounded, so this
+        # can't block): otherwise a concurrent unsubscribe could deregister
+        # a queue between the snapshot and the put, losing the message into
+        # an orphaned queue
         with self._lock:
-            qs = list(self._subs.get(topic, ()))
-        for q in qs:
-            q.put(payload)
+            for q in self._subs.get(topic, ()):
+                q.put(payload)
 
     def unsubscribe(self, topic: str, q: queue.Queue) -> None:
         with self._lock:
@@ -70,14 +73,6 @@ class Broker:
                 subs.remove(q)
             if not subs:
                 self._subs.pop(topic, None)
-
-    def close_topic(self, topic: str) -> None:
-        """Stop + deregister every subscriber of a topic (publishes to a
-        closed topic are dropped, not accumulated in orphaned queues)."""
-        with self._lock:
-            qs = self._subs.pop(topic, [])
-        for q in qs:
-            q.put(_STOP)
 
 
 class PubSubCommManager(BaseCommManager):

@@ -1,0 +1,46 @@
+"""Pallas flash-attention kernel tests (interpret mode on the CPU mesh)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tests.test_ring_attention import naive_attention, _qkv
+
+
+class TestFlashAttention:
+    @pytest.mark.parametrize("causal", [True, False])
+    @pytest.mark.parametrize("L", [64, 100])
+    def test_matches_naive(self, causal, L):
+        from feddrift_tpu.parallel.pallas_attention import flash_attention
+        q, k, v = _qkv(jax.random.PRNGKey(0), L=L)
+        out = flash_attention(q, k, v, causal, 32, 32, True)
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(naive_attention(q, k, v, causal)),
+            atol=1e-5)
+
+    def test_gradients_match_naive(self):
+        from feddrift_tpu.parallel.pallas_attention import flash_attention
+        q, k, v = _qkv(jax.random.PRNGKey(1), L=64)
+
+        def loss_flash(q, k, v):
+            return flash_attention(q, k, v, True, 32, 32, True).sum()
+
+        def loss_naive(q, k, v):
+            return naive_attention(q, k, v, True).sum()
+
+        gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+        gn = jax.grad(loss_naive, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(gf, gn):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=1e-4)
+
+    def test_jit_and_small_blocks(self):
+        from feddrift_tpu.parallel.pallas_attention import flash_attention
+        q, k, v = _qkv(jax.random.PRNGKey(2), B=1, H=1, L=24, D=8)
+        f = jax.jit(lambda q, k, v: flash_attention(q, k, v, True, 16, 16,
+                                                    True))
+        out = f(q, k, v)
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(naive_attention(q, k, v, True)),
+            atol=1e-5)
