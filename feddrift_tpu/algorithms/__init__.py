@@ -1,4 +1,5 @@
-from feddrift_tpu.algorithms.base import DriftAlgorithm, make_algorithm, available_algorithms  # noqa: F401
+from feddrift_tpu.algorithms.base import (DriftAlgorithm, algorithm_class,  # noqa: F401
+                                          available_algorithms, make_algorithm)
 
 # Import algorithm modules for registration side effects.
 import feddrift_tpu.algorithms.singlemodel  # noqa: F401,E402

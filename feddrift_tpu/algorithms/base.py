@@ -51,6 +51,15 @@ def make_algorithm(cfg, ds, pool, step) -> "DriftAlgorithm":
     return _REGISTRY[name](cfg, ds, pool, step)
 
 
+def algorithm_class(name: str) -> type:
+    """Registered class without instantiation (the runner needs class-level
+    traits like ``uses_sample_weights`` before the algorithm exists)."""
+    if name not in _REGISTRY:
+        raise KeyError(f"unknown concept_drift_algo {name!r}; "
+                       f"available: {available_algorithms()}")
+    return _REGISTRY[name]
+
+
 @dataclass
 class EnsembleSpec:
     """Ensemble-vote evaluation (AUE hard vote / KUE soft vote)."""
@@ -61,6 +70,10 @@ class EnsembleSpec:
 
 class DriftAlgorithm:
     name = "base"
+    # Class trait: True if round_inputs returns non-unit per-sample weights
+    # (KUE's Poisson bootstrap). Compiled statically into TrainStep — an
+    # algorithm that sets sample_w without this trait would have it ignored.
+    uses_sample_weights = False
 
     def __init__(self, cfg, ds, pool, step) -> None:
         self.cfg = cfg

@@ -160,6 +160,7 @@ class Kue(DriftAlgorithm):
     """
 
     name = "kue"
+    uses_sample_weights = True   # Poisson-bootstrap sample_w in round_inputs
 
     def __init__(self, cfg, ds, pool, step) -> None:
         super().__init__(cfg, ds, pool, step)

@@ -71,6 +71,10 @@ class ExperimentConfig:
     ensemble_window: int = 3           # AUE window (main_fedavg.py)
     retrain_data: str = "win-1"        # for single-model continual baselines
     report_client: int = 1
+    # stackoverflow_lr scale (reference: vocab 10000 / 500 tags; defaults are
+    # scaled down so the dense [C, T, N, F] array stays small — data/tabular.py)
+    so_vocab_size: int = 1000
+    so_tag_size: int = 50
 
     # --- reproducibility & numerics -------------------------------------
     seed: int = 0                      # reference --dummy_arg (main_fedavg.py:292-298)

@@ -68,6 +68,11 @@ class TrainStep:
     batch_size: int
     num_steps: int              # local SGD steps per round (reference `epochs`)
     num_classes: int
+    # Static: per-sample weighted batch sampling (KUE's Poisson bootstrap,
+    # retrain.py:65-74). When False (every other algorithm: sample_w == 1)
+    # the B-draw categorical over the flattened [T1*N] axis — by far the most
+    # expensive op of a small-model round — is never emitted.
+    weighted_sampling: bool = False
 
     # ------------------------------------------------------------------
     def init_opt_states(self, params, num_models: int, num_clients: int):
@@ -93,17 +98,16 @@ class TrainStep:
         total_w = w_t.sum()
         active = total_w > 0
 
-        # Per-sample categorical logits over the flattened [T1*N] axis:
-        # p[t, n] ∝ w_t[t] * s_n[n]. Uniform fallback keeps logits finite
-        # for inactive pairs (their result is masked out below).
-        probs = jnp.where(active, 1.0, 0.0) * (w_t[:, None] * s_n[None, :])
-        probs = jnp.where(probs.sum() > 0, probs, jnp.ones_like(probs))
-        logits_flat = jnp.log(probs.reshape(-1) + 1e-30)
+        if self.weighted_sampling:
+            # Per-sample categorical logits over the flattened [T1*N] axis:
+            # p[t, n] ∝ w_t[t] * s_n[n]. Uniform fallback keeps logits finite
+            # for inactive pairs (their result is masked out below).
+            probs = jnp.where(active, 1.0, 0.0) * (w_t[:, None] * s_n[None, :])
+            probs = jnp.where(probs.sum() > 0, probs, jnp.ones_like(probs))
+            logits_flat = jnp.log(probs.reshape(-1) + 1e-30)
         # Time-step-level logits for contiguous-batch mode.
         wt_safe = jnp.where(total_w > 0, w_t, jnp.ones_like(w_t))
         logits_t = jnp.log(wt_safe + 1e-30)
-
-        weighted_sampling = (s_n != 1.0).any()
 
         x_flat = x_ct.reshape((T1 * N,) + x_ct.shape[2:])
         y_flat = y_ct.reshape((T1 * N,))
@@ -115,14 +119,14 @@ class TrainStep:
         def step(carry, k):
             p, o = carry
             k1, k2 = jax.random.split(k)
-            # contiguous batch: t ~ Cat(w), slot ~ U[0, nb)
-            t_idx = jax.random.categorical(k1, logits_t)
-            slot = jax.random.randint(k2, (), 0, nb)
-            base = t_idx * N + slot * B
-            idx_contig = base + jnp.arange(B)
-            # weighted per-sample batch (with replacement)
-            idx_weighted = jax.random.categorical(k1, logits_flat, shape=(B,))
-            idx = jnp.where(weighted_sampling, idx_weighted, idx_contig)
+            if self.weighted_sampling:
+                # weighted per-sample batch (with replacement)
+                idx = jax.random.categorical(k1, logits_flat, shape=(B,))
+            else:
+                # contiguous batch: t ~ Cat(w), slot ~ U[0, nb)
+                t_idx = jax.random.categorical(k1, logits_t)
+                slot = jax.random.randint(k2, (), 0, nb)
+                idx = t_idx * N + slot * B + jnp.arange(B)
             xb, yb = x_flat[idx], y_flat[idx]
             loss, grads = jax.value_and_grad(loss_fn)(p, xb, yb)
             updates, o = self.optimizer.update(grads, o, p)
@@ -221,6 +225,79 @@ class TrainStep:
         corr_te, loss_te, _ = self._acc_matrix_body(params, xe, ye, feat_mask)
         return (params, opt_states, ns[-1], ls[-1],
                 (corr_tr, loss_tr, corr_te, loss_te), total)
+
+    @staticmethod
+    def eval_rounds(R: int, freq: int) -> list[int]:
+        """The reference's eval cadence: every ``frequency_of_the_test``
+        rounds plus the final round (AggregatorSoftCluster.py:211)."""
+        rounds = list(range(0, R, freq))
+        if rounds[-1] != R - 1:
+            rounds.append(R - 1)
+        return rounds
+
+    @partial(jax.jit, static_argnums=(0, 10, 11), donate_argnums=(1, 2))
+    def train_iteration_eval(self, params, opt_states, iter_key, x, y, time_w,
+                             sample_w, feat_mask, lr_scale, R: int, freq: int,
+                             t):
+        """ALL R communication rounds of a time step + every scheduled eval
+        as ONE device program.
+
+        Collapses the per-chunk dispatch of train_rounds_eval into a single
+        host->device->host round trip per time step: on tunneled TPU links the
+        per-call latency dominates wall-clock for small models, exactly as the
+        reference's 0.3 s comm polls did (SURVEY.md §7). Valid under the same
+        conditions as train_rounds_eval (DriftAlgorithm.chunkable) plus a
+        non-ensemble test path. Trajectories are bitwise-identical to the
+        per-round and per-chunk paths: round r folds the same
+        fold_in(iter_key, r) key, and eval matrices are computed on the params
+        right after each eval round.
+
+        Returns (params, opt_states, n [M, C], losses [M, C],
+        (corr_tr, loss_tr, corr_te, loss_te) each [E, M, C], total [C]) where
+        E = len(eval_rounds(R, freq)).
+        """
+        evs = self.eval_rounds(R, freq)
+        E = len(evs)
+        # slot(r): r//freq for the regular cadence; the final round takes the
+        # last slot (it may coincide with a regular slot when R-1 % freq == 0,
+        # in which case it IS that slot and E == R//freq rounded up).
+        xt = jnp.take(x, t, axis=1)
+        yt = jnp.take(y, t, axis=1)
+        xe = jnp.take(x, t + 1, axis=1)
+        ye = jnp.take(y, t + 1, axis=1)
+        M = time_w.shape[0]
+        C = x.shape[0]
+        zero_mats = (jnp.zeros((M, C), jnp.int32), jnp.zeros((M, C), jnp.float32),
+                     jnp.zeros((M, C), jnp.int32), jnp.zeros((M, C), jnp.float32))
+
+        def one(carry, r):
+            p, o, bufs = carry
+            key = jax.random.fold_in(iter_key, r)
+            p, o, _cp, n, losses = self._round_body(
+                p, o, key, x, y, time_w, sample_w, feat_mask, lr_scale)
+
+            is_eval = ((r % freq) == 0) | (r == R - 1)
+            slot = jnp.where(r == R - 1, E - 1, r // freq)
+
+            def do_eval(_):
+                ctr, ltr, _tot = self._acc_matrix_body(p, xt, yt, feat_mask)
+                cte, lte, _ = self._acc_matrix_body(p, xe, ye, feat_mask)
+                return ctr, ltr, cte, lte
+
+            mats = jax.lax.cond(is_eval, do_eval, lambda _: zero_mats, None)
+            bufs = tuple(
+                jnp.where(is_eval,
+                          jax.lax.dynamic_update_index_in_dim(b, m, slot, 0),
+                          b)
+                for b, m in zip(bufs, mats))
+            return (p, o, bufs), (n, losses)
+
+        bufs0 = tuple(jnp.zeros((E, M, C), d) for d in
+                      (jnp.int32, jnp.float32, jnp.int32, jnp.float32))
+        (params, opt_states, bufs), (ns, ls) = jax.lax.scan(
+            one, (params, opt_states, bufs0), jnp.arange(R, dtype=jnp.int32))
+        total = jnp.full((C,), x.shape[2], dtype=jnp.int32)
+        return params, opt_states, ns[-1], ls[-1], bufs, total
 
     # ------------------------------------------------------------------
     @partial(jax.jit, static_argnums=0)

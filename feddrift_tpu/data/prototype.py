@@ -35,6 +35,7 @@ SPECS = {
     "cifar10": ((32, 32, 3), 10),
     "cifar100": ((32, 32, 3), 100),
     "cinic10": ((32, 32, 3), 10),
+    "fed_cifar100": ((32, 32, 3), 100),
 }
 
 

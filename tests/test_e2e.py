@@ -50,6 +50,15 @@ class TestEndToEnd:
         b = run_experiment(_cfg(chunk_rounds=False, **kw)).logger.series("Test/Acc")
         assert a == b, (a, b)
 
+    def test_fused_iteration_eval_cadence(self):
+        # the fully-fused iteration program must log evals at the reference
+        # cadence — every frequency_of_the_test rounds plus the final round
+        # (AggregatorSoftCluster.py:211) — with correct global round numbers
+        exp = run_experiment(_cfg(chunk_rounds=True, train_iterations=2,
+                                  comm_round=13, frequency_of_the_test=5))
+        rounds = [r for r, _ in exp.logger.series("Test/Acc")]
+        assert rounds == [0, 5, 10, 12, 13, 18, 23, 25], rounds
+
     def test_determinism(self):
         a = run_experiment(_cfg()).logger.series("Test/Acc")
         b = run_experiment(_cfg()).logger.series("Test/Acc")
