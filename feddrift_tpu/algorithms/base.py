@@ -137,7 +137,7 @@ class DriftAlgorithm:
 
     def chunkable(self, t: int) -> bool:
         """True if rounds of time step t may run as one device program
-        (TrainStep.train_rounds_eval): round_inputs must be round-invariant and
+        (TrainStep.train_iteration_eval): round_inputs must be round-invariant and
         after_round must not need per-round host work. Default conservative."""
         return False
 

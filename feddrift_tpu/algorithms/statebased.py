@@ -416,12 +416,18 @@ class LegacyClusterFL(DriftAlgorithm):
             return self.pool.params
 
         # Weight updates of the (single) cluster-0 model across clients.
+        # Restrict to participating clients (n > 0): under client
+        # subsampling, unsampled clients' deltas are all-zero and would
+        # dilute the norm gate / feed zero rows into the similarity matrix.
+        part = np.where(np.asarray(n)[0, : self.C] > 0)[0]
+        if len(part) < 2:
+            return self.pool.params
         rows = []
         for cp_leaf, pv_leaf in zip(jax.tree_util.tree_leaves(client_params),
                                     jax.tree_util.tree_leaves(prev_params)):
             delta = cp_leaf[0] - pv_leaf[0][None]
             rows.append(np.asarray(delta.reshape(delta.shape[0], -1)))
-        dW = np.concatenate(rows, axis=1)[: self.C]       # [C, P]
+        dW = np.concatenate(rows, axis=1)[: self.C][part]   # [P_c, P]
         norms = np.linalg.norm(dW, axis=1)
         max_norm = float(norms.max())
         mean_norm = float(np.linalg.norm(dW.mean(axis=0)))
@@ -442,8 +448,8 @@ class LegacyClusterFL(DriftAlgorithm):
             labels = AgglomerativeClustering(
                 metric="precomputed", linkage="complete",
                 n_clusters=2).fit(-S).labels_             # (:105-112)
-            c1 = np.where(labels == 0)[0]
-            c2 = np.where(labels == 1)[0]
+            c1 = part[labels == 0]
+            c2 = part[labels == 1]
             self.assignment[c1] = 0
             self.assignment[c2] = 1
             self.is_split = True

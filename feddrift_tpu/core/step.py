@@ -146,9 +146,17 @@ class TrainStep:
 
     # ------------------------------------------------------------------
     def _round_body(self, params, opt_states, key, x, y, time_w, sample_w,
-                    feat_mask, lr_scale):
+                    feat_mask, lr_scale, client_mask=None):
         """One communication round (untraced body shared by train_round and
-        the chunked train_rounds_eval scan)."""
+        the fused train_iteration_eval scan).
+
+        client_mask [C] 0/1: per-round client sampling (reference
+        client_sampling, AggregatorSoftCluster.py:197-205). Non-sampled
+        clients train masked (total weight 0 -> params/opt untouched, n=0)
+        and drop out of the aggregation, like the reference's absent ranks.
+        """
+        if client_mask is not None:
+            time_w = time_w * client_mask[None, :, None]
         M = time_w.shape[0]
         C = x.shape[0]
         keys = jax.random.split(key, M * C).reshape(M, C, 2)
@@ -178,53 +186,12 @@ class TrainStep:
 
     @partial(jax.jit, static_argnums=0)
     def train_round(self, params, opt_states, key, x, y, time_w, sample_w,
-                    feat_mask, lr_scale):
+                    feat_mask, lr_scale, client_mask=None):
         """One communication round. Returns (new_params [M, ...],
         new_opt_states, client_params [M, C, ...], n [M, C], mean_loss [M, C]).
         """
         return self._round_body(params, opt_states, key, x, y, time_w,
-                                sample_w, feat_mask, lr_scale)
-
-    @partial(jax.jit, static_argnums=0, donate_argnums=(1, 2))
-    def train_rounds_eval(self, params, opt_states, iter_key, x, y, time_w,
-                          sample_w, feat_mask, lr_scale, round_idxs, t):
-        """K communication rounds + fused end-of-chunk evaluation as ONE
-        device program.
-
-        Valid when the steering inputs are round-invariant and no host-side
-        after_round work happens between the rounds (DriftAlgorithm.chunkable)
-        — the steady-state round loop of most algorithms. The per-round PRNG
-        key is fold_in(iter_key, r), identical to what the per-round path
-        receives from utils.prng.round_key, so chunked and unchunked
-        trajectories are bitwise-identical.
-
-        After the lax.scan over round_idxs ([K] int32, traced so one compile
-        serves every chunk of the same length), the [M, C] train (step t) and
-        test (step t+1, the temporal holdout of retrain.py:78-83)
-        accuracy/loss matrices are computed on the final params inside the
-        same program, so an eval costs zero extra host round-trips over the
-        TPU link. ``t`` is traced. x: [C, T1, N, ...]. Returns (params,
-        opt_states, n, losses, (corr_tr, loss_tr, corr_te, loss_te) all
-        [M, C], total [C]).
-        """
-        def one(carry, r):
-            p, o = carry
-            key = jax.random.fold_in(iter_key, r)
-            p, o, _cp, n, losses = self._round_body(
-                p, o, key, x, y, time_w, sample_w, feat_mask, lr_scale)
-            return (p, o), (n, losses)
-
-        (params, opt_states), (ns, ls) = jax.lax.scan(
-            one, (params, opt_states), round_idxs)
-
-        xt = jnp.take(x, t, axis=1)
-        yt = jnp.take(y, t, axis=1)
-        xe = jnp.take(x, t + 1, axis=1)
-        ye = jnp.take(y, t + 1, axis=1)
-        corr_tr, loss_tr, total = self._acc_matrix_body(params, xt, yt, feat_mask)
-        corr_te, loss_te, _ = self._acc_matrix_body(params, xe, ye, feat_mask)
-        return (params, opt_states, ns[-1], ls[-1],
-                (corr_tr, loss_tr, corr_te, loss_te), total)
+                                sample_w, feat_mask, lr_scale, client_mask)
 
     @staticmethod
     def eval_rounds(R: int, freq: int) -> list[int]:
@@ -238,7 +205,7 @@ class TrainStep:
     @partial(jax.jit, static_argnums=(0, 10, 11), donate_argnums=(1, 2))
     def train_iteration_eval(self, params, opt_states, iter_key, x, y, time_w,
                              sample_w, feat_mask, lr_scale, R: int, freq: int,
-                             t):
+                             t, client_masks=None):
         """ALL R communication rounds of a time step + every scheduled eval
         as ONE device program.
 
@@ -270,11 +237,12 @@ class TrainStep:
         zero_mats = (jnp.zeros((M, C), jnp.int32), jnp.zeros((M, C), jnp.float32),
                      jnp.zeros((M, C), jnp.int32), jnp.zeros((M, C), jnp.float32))
 
-        def one(carry, r):
+        def one(carry, rx):
+            r, cm = rx
             p, o, bufs = carry
             key = jax.random.fold_in(iter_key, r)
             p, o, _cp, n, losses = self._round_body(
-                p, o, key, x, y, time_w, sample_w, feat_mask, lr_scale)
+                p, o, key, x, y, time_w, sample_w, feat_mask, lr_scale, cm)
 
             is_eval = ((r % freq) == 0) | (r == R - 1)
             slot = jnp.where(r == R - 1, E - 1, r // freq)
@@ -295,7 +263,8 @@ class TrainStep:
         bufs0 = tuple(jnp.zeros((E, M, C), d) for d in
                       (jnp.int32, jnp.float32, jnp.int32, jnp.float32))
         (params, opt_states, bufs), (ns, ls) = jax.lax.scan(
-            one, (params, opt_states, bufs0), jnp.arange(R, dtype=jnp.int32))
+            one, (params, opt_states, bufs0),
+            (jnp.arange(R, dtype=jnp.int32), client_masks))
         total = jnp.full((C,), x.shape[2], dtype=jnp.int32)
         return params, opt_states, ns[-1], ls[-1], bufs, total
 

@@ -83,10 +83,11 @@ def generate_uci_drift(
     """SUSY / Room-Occupancy as a drifting binary-classification stream.
 
     With real CSVs the stream is sliced per (client, step) in file order —
-    the reference's streaming semantics. Concept drift relabels via a
-    concept-specific rotated hyperplane on standardized features (synthetic
-    path) or flips labels of the concept's boundary region (real path), so
-    each concept is a genuinely different classification function.
+    the reference's streaming semantics — keeping the true labels for
+    concept 0; a drifted concept k flips the labels of the half-space
+    ``x @ plane_k > 0``, so each concept is a genuinely different
+    classification function grounded in the real task. On the synthetic
+    path concept k labels by its own rotated hyperplane directly.
     """
     feature_dim, fname = UCI_SPECS[name]
     T = train_iterations
@@ -105,7 +106,7 @@ def generate_uci_drift(
     x = np.zeros((num_clients, T + 1, sample_num, feature_dim), np.float32)
     y = np.zeros((num_clients, T + 1, sample_num), np.int32)
     if real is not None:
-        rx, _ = real
+        rx, ry = real
         mu, sd = rx.mean(0), rx.std(0) + 1e-6
         rx = (rx - mu) / sd
         idx = 0
@@ -116,7 +117,11 @@ def generate_uci_drift(
                 xi = rx[take]
                 k = int(concepts[t, c]) % n_concepts
                 x[c, t] = xi
-                y[c, t] = (xi @ planes[k] > 0).astype(np.int32)
+                yi = ry[take].copy()
+                if k > 0:       # drift: flip labels of the k-th half-space
+                    flip = xi @ planes[k] > 0
+                    yi = np.where(flip, 1 - yi, yi)
+                y[c, t] = yi.astype(np.int32)
     else:
         for t in range(T + 1):
             for c in range(num_clients):

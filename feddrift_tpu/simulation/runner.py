@@ -59,7 +59,7 @@ class Experiment:
         self.pool = ModelPool.create(self.module, _sample_input(self.ds),
                                      cfg.num_models, seed=cfg.seed + 42)
         self.step = TrainStep(
-            apply_fn=lambda p, x: self.module.apply({"params": p}, x),
+            apply_fn=self._make_apply(),
             optimizer=make_optimizer(cfg.client_optimizer, cfg.lr, cfg.wd),
             batch_size=cfg.batch_size,
             num_steps=cfg.epochs,
@@ -93,6 +93,29 @@ class Experiment:
         self.out_dir = out_dir
         self.tracer = PhaseTracer()
 
+    def _make_apply(self):
+        """Forward fn honoring cfg.compute_dtype.
+
+        'bfloat16' = mixed precision ON TPU ONLY: params and float inputs are
+        cast to bf16 at the call boundary so matmuls/convs hit the MXU at
+        full rate, logits are cast back to f32 for the loss, and gradients
+        arrive f32 through the cast ops (params themselves stay f32 — the
+        standard TPU recipe). On CPU/GPU backends bf16 is emulated and slow,
+        so the cast is skipped there; 'float32' disables it everywhere.
+        """
+        module = self.module
+        if (self.cfg.compute_dtype == "bfloat16"
+                and jax.default_backend() == "tpu"):
+            def apply_fn(p, x):
+                p16 = jax.tree_util.tree_map(
+                    lambda l: l.astype(jnp.bfloat16)
+                    if l.dtype == jnp.float32 else l, p)
+                if x.dtype == jnp.float32:
+                    x = x.astype(jnp.bfloat16)
+                return module.apply({"params": p16}, x).astype(jnp.float32)
+            return apply_fn
+        return lambda p, x: module.apply({"params": p}, x)
+
     # ------------------------------------------------------------------
     def evaluate(self, t: int, round_idx: int, precomputed=None) -> dict:
         """Reference ``test_on_all_clients`` (AggregatorSoftCluster.py:210-285):
@@ -102,7 +125,7 @@ class Experiment:
 
         ``precomputed``: optional ((corr_tr, loss_tr, corr_te, loss_te),
         total) matrices already computed on device inside the chunked train
-        program (TrainStep.train_rounds_eval) — skips both acc_matrix calls.
+        program (TrainStep.train_iteration_eval) — skips both acc_matrix calls.
         """
         cfg = self.cfg
         C = self.C_
@@ -148,25 +171,8 @@ class Experiment:
             else jnp.asarray(spec.model_mask, jnp.float32),
             fm)
         ec, et, el = jax.device_get((ec, et, el))
-        tcorrect = ec[:C]
-        ttotal = et[:C]
-        tloss = el[:C]
-
-        metrics = {
-            "round": self.global_round,
-            "iteration": t,
-            "Train/Acc": float(train_correct.sum() / total.sum()),
-            "Train/Loss": float(train_loss.sum() / total.sum()),
-            "Test/Acc": float(tcorrect.sum() / ttotal.sum()),
-            "Test/Loss": float(tloss.sum() / ttotal.sum()),
-        }
-        if cfg.report_client:
-            for c in range(self.C_):
-                metrics[f"Train/Acc-CL-{c}"] = float(train_correct[c] / total[c])
-                metrics[f"Test/Acc-CL-{c}"] = float(tcorrect[c] / ttotal[c])
-                metrics[f"Plurality/CL-{c}"] = int(idx[c])
-        self.logger.log(metrics)
-        return metrics
+        return self._log_metrics(t, idx, train_correct, train_loss, total,
+                                 ec[:C], el[:C], et[:C])
 
     def _log_eval(self, t: int, correct, loss_sum, corr_te, loss_te,
                   total) -> dict:
@@ -175,22 +181,26 @@ class Experiment:
         tidx = self.algo.train_model_idx(t)                    # [C]
         idx = self.algo.test_model_idx(t)                      # [C]
         cr = np.arange(self.C_)
-        train_correct = correct[tidx, cr]
-        train_loss = loss_sum[tidx, cr]
-        tcorrect = corr_te[idx, cr]
-        tloss = loss_te[idx, cr]
+        return self._log_metrics(t, idx, correct[tidx, cr], loss_sum[tidx, cr],
+                                 total, corr_te[idx, cr], loss_te[idx, cr],
+                                 total)
+
+    def _log_metrics(self, t: int, idx, train_correct, train_loss, total,
+                     tcorrect, tloss, ttotal) -> dict:
+        """Assemble + log the reference's metric schema from per-client
+        vectors (Train/Test Acc+Loss, per-client series, Plurality)."""
         metrics = {
             "round": self.global_round,
             "iteration": t,
             "Train/Acc": float(train_correct.sum() / total.sum()),
             "Train/Loss": float(train_loss.sum() / total.sum()),
-            "Test/Acc": float(tcorrect.sum() / total.sum()),
-            "Test/Loss": float(tloss.sum() / total.sum()),
+            "Test/Acc": float(tcorrect.sum() / ttotal.sum()),
+            "Test/Loss": float(tloss.sum() / ttotal.sum()),
         }
         if self.cfg.report_client:
             for c in range(self.C_):
                 metrics[f"Train/Acc-CL-{c}"] = float(train_correct[c] / total[c])
-                metrics[f"Test/Acc-CL-{c}"] = float(tcorrect[c] / total[c])
+                metrics[f"Test/Acc-CL-{c}"] = float(tcorrect[c] / ttotal[c])
                 metrics[f"Plurality/CL-{c}"] = int(idx[c])
         self.logger.log(metrics)
         return metrics
@@ -221,8 +231,6 @@ class Experiment:
         if (cfg.chunk_rounds and self.algo.chunkable(t)
                 and self.algo.ensemble_spec(t) is None):
             self._run_iteration_fused(t, opt_states)
-        elif cfg.chunk_rounds and self.algo.chunkable(t):
-            self._run_rounds_chunked(t, opt_states)
         else:
             self._run_rounds(t, opt_states)
 
@@ -236,6 +244,22 @@ class Experiment:
         self.last_phase_summary = self.tracer.summary()
         self.tracer.reset()   # per-iteration deltas, not cumulative totals
 
+    def _client_masks(self, rounds) -> "np.ndarray | None":
+        """[len(rounds), C_pad] 0/1 participation masks, or None when every
+        client participates. Mirrors the reference's round-seeded sampling
+        without replacement (client_sampling,
+        AggregatorSoftCluster.py:197-205: np.random.seed(round_idx) +
+        choice) so runs are comparable round-for-round."""
+        cfg = self.cfg
+        if cfg.client_num_per_round >= self.C_:
+            return None
+        masks = np.zeros((len(rounds), self.C_pad), dtype=np.float32)
+        for i, r in enumerate(rounds):
+            sel = np.random.RandomState(int(r)).choice(
+                self.C_, cfg.client_num_per_round, replace=False)
+            masks[i, sel] = 1.0
+        return masks
+
     def _run_rounds(self, t: int, opt_states) -> None:
         """Per-round host loop: algorithms that steer every round."""
         cfg = self.cfg
@@ -243,11 +267,13 @@ class Experiment:
             tw, sw, fm, lr_scale = self.algo.round_inputs(t, r)
             tw = self._pad_clients(tw)                  # phantom clients: w=0
             sw = self._pad_clients(sw, value=1.0)
+            cm = self._client_masks([r])
             prev_params = self.pool.params
             with self.tracer.phase("train_round"):
                 new_params, opt_states, client_params, n, losses = self.step.train_round(
                     prev_params, opt_states, round_key(self.key, t, r),
-                    self.x, self.y, tw, sw, fm, lr_scale)
+                    self.x, self.y, tw, sw, fm, lr_scale,
+                    None if cm is None else jnp.asarray(cm[0]))
                 if cfg.trace_sync:
                     # attribute device time to this phase instead of letting
                     # async dispatch spill it into whichever phase blocks next
@@ -258,45 +284,6 @@ class Experiment:
                 with self.tracer.phase("eval"):
                     self.evaluate(t, r)
             self.global_round += 1
-
-    def _run_rounds_chunked(self, t: int, opt_states) -> None:
-        """Scan consecutive rounds between eval points as ONE device program
-        (TrainStep.train_rounds_eval) — removes per-round dispatch overhead, which
-        dominates wall-clock for small models exactly as the reference's
-        0.3 s comm polls did (SURVEY.md §7 'Wall-clock target'). Bitwise-
-        identical trajectories: the scan folds the same per-round keys.
-
-        Only entered when the algorithm declared chunkable(t): round_inputs
-        round-invariant and no per-round after_round work, so after_round is
-        called once per chunk with prev_params/client_params None.
-        """
-        cfg = self.cfg
-        R, freq = cfg.comm_round, cfg.frequency_of_the_test
-        it_key = iteration_key(self.key, t)
-        tw, sw, fm, lr_scale = self.algo.round_inputs(t, 0)
-        tw = self._pad_clients(tw)
-        sw = self._pad_clients(sw, value=1.0)
-        g0 = self.global_round
-        r = 0
-        while r < R:
-            # this chunk ends at the next eval round (inclusive):
-            # evals land on r % freq == 0 and on the final round
-            end = r if r % freq == 0 else min((r // freq + 1) * freq, R - 1)
-            idxs = jnp.arange(r, end + 1, dtype=jnp.int32)
-            with self.tracer.phase("train_round"):
-                new_params, opt_states, n, losses, acc_mats, total = \
-                    self.step.train_rounds_eval(
-                        self.pool.params, opt_states, it_key, self.x, self.y,
-                        tw, sw, fm, lr_scale, idxs, jnp.int32(t))
-                if cfg.trace_sync:
-                    jax.block_until_ready(new_params)
-                self.pool.params = self.algo.after_round(
-                    t, end, None, new_params, None, n)
-            self.global_round = g0 + end
-            with self.tracer.phase("eval"):
-                self.evaluate(t, end, precomputed=(acc_mats, total))
-            r = end + 1
-        self.global_round = g0 + R
 
     def _run_iteration_fused(self, t: int, opt_states) -> None:
         """ALL rounds of the time step + every scheduled eval as ONE device
@@ -313,11 +300,13 @@ class Experiment:
         tw = self._pad_clients(tw)
         sw = self._pad_clients(sw, value=1.0)
         g0 = self.global_round
+        cms = self._client_masks(range(R))
         with self.tracer.phase("train_round"):
             new_params, opt_states, n, losses, bufs, total = \
                 self.step.train_iteration_eval(
                     self.pool.params, opt_states, it_key, self.x, self.y,
-                    tw, sw, fm, lr_scale, R, freq, jnp.int32(t))
+                    tw, sw, fm, lr_scale, R, freq, jnp.int32(t),
+                    None if cms is None else jnp.asarray(cms))
             if cfg.trace_sync:
                 jax.block_until_ready(new_params)
             self.pool.params = self.algo.after_round(

@@ -21,7 +21,7 @@ def experiment_key(seed: int) -> jax.Array:
 
 def iteration_key(seed_key: jax.Array, t: int, purpose: str = "train") -> jax.Array:
     """Key for one (purpose, time step); fold_in(r) yields the round key —
-    the device-side chunked round loop (TrainStep.train_rounds_eval) does exactly
+    the device-side fused round loop (TrainStep.train_iteration_eval) does exactly
     that, keeping chunked and per-round execution bitwise-identical."""
     k = jax.random.fold_in(seed_key, PURPOSES[purpose])
     return jax.random.fold_in(k, t)

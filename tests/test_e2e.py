@@ -59,6 +59,21 @@ class TestEndToEnd:
         rounds = [r for r, _ in exp.logger.series("Test/Acc")]
         assert rounds == [0, 5, 10, 12, 13, 18, 23, 25], rounds
 
+    def test_client_subsampling_paths_agree(self):
+        # client_num_per_round < C: round-seeded sampling masks
+        # (client_sampling, AggregatorSoftCluster.py:197-205) must give
+        # identical trajectories on the fused and per-round paths
+        kw = dict(client_num_per_round=4, train_iterations=2, comm_round=9,
+                  frequency_of_the_test=4)
+        a = run_experiment(_cfg(chunk_rounds=True, **kw)).logger.series("Test/Acc")
+        b = run_experiment(_cfg(chunk_rounds=False, **kw)).logger.series("Test/Acc")
+        assert a == b, (a, b)
+        # and subsampling must actually change the trajectory vs full clients
+        c = run_experiment(_cfg(chunk_rounds=True, train_iterations=2,
+                                comm_round=9,
+                                frequency_of_the_test=4)).logger.series("Test/Acc")
+        assert a != c
+
     def test_determinism(self):
         a = run_experiment(_cfg()).logger.series("Test/Acc")
         b = run_experiment(_cfg()).logger.series("Test/Acc")
