@@ -43,6 +43,10 @@ def _add_run_args(p: argparse.ArgumentParser) -> None:
         else:
             p.add_argument(f"--{f.name}", type=str, default=default)
     p.add_argument("--wandb", action="store_true", help="attach wandb if available")
+    p.add_argument("--platform", type=str, default="",
+                   help="force a JAX platform (e.g. 'cpu'); must be applied "
+                        "before backend init, which env vars can't do when "
+                        "jax was pre-imported (tests/conftest.py note)")
 
 
 def _cfg_from_args(args: argparse.Namespace):
@@ -67,10 +71,16 @@ def main(argv: list[str] | None = None) -> int:
     res_p = sub.add_parser("resume", help="resume from a checkpoint")
     res_p.add_argument("--out_dir", type=str, required=True)
     res_p.add_argument("--wandb", action="store_true")
+    res_p.add_argument("--platform", type=str, default="",
+                       help="force a JAX platform (e.g. 'cpu')")
 
     sub.add_parser("list", help="list algorithms / datasets / models")
 
     args = parser.parse_args(argv)
+
+    if getattr(args, "platform", ""):
+        import jax
+        jax.config.update("jax_platforms", args.platform)
 
     if args.cmd == "list":
         from feddrift_tpu.algorithms import available_algorithms

@@ -18,6 +18,27 @@ from __future__ import annotations
 import jax
 
 
+def initialize(coordinator_address: str | None = None,
+               num_processes: int | None = None,
+               process_id: int | None = None) -> None:
+    """Join the multi-controller runtime (jax.distributed.initialize).
+
+    On TPU pods all arguments auto-detect from the environment; on CPU/GPU
+    clusters pass them explicitly. This replaces the reference's
+    mpirun-launched process bootstrap (FedAvgEnsAPI.py:25-29: MPI rank/size);
+    afterwards jax.devices() spans every host and the client mesh axis can be
+    laid out across DCN.
+    """
+    kwargs = {}
+    if coordinator_address is not None:
+        kwargs["coordinator_address"] = coordinator_address
+    if num_processes is not None:
+        kwargs["num_processes"] = num_processes
+    if process_id is not None:
+        kwargs["process_id"] = process_id
+    jax.distributed.initialize(**kwargs)
+
+
 def process_count() -> int:
     return jax.process_count()
 

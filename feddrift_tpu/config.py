@@ -79,12 +79,19 @@ class ExperimentConfig:
     # --- reproducibility & numerics -------------------------------------
     seed: int = 0                      # reference --dummy_arg (main_fedavg.py:292-298)
     dtype: str = "float32"             # param dtype; compute can be bfloat16
-    compute_dtype: str = "bfloat16"
+    compute_dtype: str = "bfloat16"    # bf16 matmuls/convs on TPU (runner._make_apply)
+    remat: bool = False                # jax.checkpoint the forward (HBM <-> FLOPs)
 
     # --- TPU execution ---------------------------------------------------
     mesh_shape: dict[str, int] = field(default_factory=dict)  # e.g. {"clients": 8}
     out_dir: str = "./runs"
     checkpoint_every_iteration: bool = True
+
+    # --- fault injection / failure detection (platform/faults.py; the
+    # reference has neither — a dead client hangs its barrier, SURVEY.md §5)
+    fault_dropout_prob: float = 0.0    # per-round transient client failure
+    fault_seed: int = 0
+    failure_patience: int = 3          # rounds absent before a client is suspected
 
     def __post_init__(self) -> None:
         if self.client_num_per_round > self.client_num_in_total:

@@ -62,6 +62,12 @@ def _resnet20(ds: DriftDataset, cfg) -> nn.Module:
     return ResNetCifar(num_classes=ds.num_classes, depth=20)
 
 
+@register_model("resnet8")
+def _resnet8(ds, cfg):
+    # GKT client-side extractor size (reference fedgkt resnet_client ResNet-8)
+    return ResNetCifar(num_classes=ds.num_classes, depth=8)
+
+
 @register_model("resnet56")
 def _resnet56(ds: DriftDataset, cfg) -> nn.Module:
     return ResNetCifar(num_classes=ds.num_classes, depth=56)

@@ -1,0 +1,120 @@
+"""Fault injection and failure detection for federated rounds.
+
+The reference has NO failure story (SURVEY.md §5): a crashed client hangs the
+server's receive barrier forever (check_whether_all_receive,
+FedAvgEnsAggregatorSoftCluster.py:129-135) and normal termination is
+MPI_Abort. Here client participation is a mask over an array axis, so
+failures degrade gracefully by construction: a dead client contributes
+``n = 0`` and simply drops out of the weighted aggregation, like a
+non-sampled client.
+
+This module makes that story testable and observable:
+
+- ``FaultInjector`` produces deterministic per-round dropout masks
+  (transient crash/straggler simulation: each client independently fails a
+  round with probability ``dropout_prob``) and supports permanently killing
+  clients (``kill``), for elastic-membership experiments.
+- ``FailureDetector`` watches the observed per-round participation and flags
+  clients absent ``patience`` consecutive rounds — the analog of a heartbeat
+  timeout detector for the reference's hanging barrier, but non-blocking.
+
+Both are host-side and O(C) per round; the device program is untouched — the
+injector's mask multiplies into the same participation mask used by client
+subsampling (simulation/runner.py::_client_masks).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+class FaultInjector:
+    """Deterministic per-round client dropout masks.
+
+    seed/round-indexed so runs are reproducible and the fused multi-round
+    device program can precompute the whole iteration's masks up front.
+    """
+
+    def __init__(self, num_clients: int, dropout_prob: float = 0.0,
+                 seed: int = 0) -> None:
+        if not 0.0 <= dropout_prob < 1.0:
+            raise ValueError(f"dropout_prob must be in [0, 1), got {dropout_prob}")
+        self.C = num_clients
+        self.p = dropout_prob
+        self.seed = seed
+        self.dead = np.zeros(num_clients, dtype=bool)   # permanent failures
+
+    def kill(self, client: int) -> None:
+        """Permanently fail a client (process gone, not coming back)."""
+        self.dead[client] = True
+
+    def revive(self, client: int) -> None:
+        self.dead[client] = False
+
+    def mask(self, round_idx: int) -> np.ndarray:
+        """[C] float32 0/1 participation mask for one global round."""
+        up = ~self.dead
+        if self.p > 0.0:
+            rng = np.random.RandomState((self.seed * 1_000_003 + round_idx)
+                                        % (2 ** 31 - 1))
+            up = up & (rng.random_sample(self.C) >= self.p)
+        # Never fail every client at once: if all drop, the round would be a
+        # no-op that still advances RNG state; keep the lowest-index live
+        # client up (a quorum-of-one floor).
+        if not up.any() and (~self.dead).any():
+            up[np.argmax(~self.dead)] = True
+        return up.astype(np.float32)
+
+    def masks(self, rounds) -> np.ndarray:
+        return np.stack([self.mask(int(r)) for r in rounds])
+
+
+class FailureDetector:
+    """Flags clients absent ``patience`` consecutive observed rounds.
+
+    Feed it the realized participation (the mask actually used, or
+    ``n[:, c] > 0`` from the aggregation) after each round; read
+    ``suspected`` for the current suspect set. Non-blocking by design —
+    aggregation over masks never waits on a dead client, unlike the
+    reference's flag barrier.
+    """
+
+    def __init__(self, num_clients: int, patience: int = 3) -> None:
+        if patience < 1:
+            raise ValueError("patience must be >= 1")
+        self.C = num_clients
+        self.patience = patience
+        self.absent_streak = np.zeros(num_clients, dtype=np.int64)
+        self.rounds_seen = 0
+
+    def observe(self, participation: np.ndarray,
+                observed: np.ndarray | None = None) -> None:
+        """participation: [C] bool/0-1 for one round.
+
+        ``observed`` ([C] bool) marks clients with a liveness signal this
+        round; unobserved clients (e.g. not subsampled) keep their current
+        streak — non-selection is not evidence of either health or failure.
+        """
+        part = np.asarray(participation).astype(bool)[: self.C]
+        new_streak = np.where(part, 0, self.absent_streak + 1)
+        if observed is not None:
+            obs = np.asarray(observed).astype(bool)[: self.C]
+            new_streak = np.where(obs, new_streak, self.absent_streak)
+        self.absent_streak = new_streak
+        self.rounds_seen += 1
+
+    def observe_many(self, masks: np.ndarray) -> None:
+        for row in np.asarray(masks):
+            self.observe(row)
+
+    @property
+    def suspected(self) -> np.ndarray:
+        """[S] client indices currently past the patience threshold."""
+        return np.where(self.absent_streak >= self.patience)[0]
+
+    def summary(self) -> dict:
+        return {
+            "rounds_seen": self.rounds_seen,
+            "suspected": self.suspected.tolist(),
+            "max_absent_streak": int(self.absent_streak.max(initial=0)),
+        }

@@ -87,6 +87,13 @@ class Experiment:
         self.algo = make_algorithm(cfg, self.ds, self.pool, self.step)
         self.logger = MetricsLogger(out_dir, use_wandb)
         self.algo.bind(self.x, self.y, self.logger, self.C_pad)
+        from feddrift_tpu.platform.faults import FailureDetector, FaultInjector
+        self.fault_injector = (
+            FaultInjector(self.C_, cfg.fault_dropout_prob, cfg.fault_seed)
+            if cfg.fault_dropout_prob > 0 else None)
+        self.failure_detector = (
+            FailureDetector(self.C_, cfg.failure_patience)
+            if self.fault_injector is not None else None)
         self.key = experiment_key(cfg.seed)
         self.global_round = 0
         self.start_iteration = 0
@@ -102,6 +109,11 @@ class Experiment:
         arrive f32 through the cast ops (params themselves stay f32 — the
         standard TPU recipe). On CPU/GPU backends bf16 is emulated and slow,
         so the cast is skipped there; 'float32' disables it everywhere.
+
+        cfg.remat additionally wraps the forward in jax.checkpoint so
+        activations are rematerialized in the backward pass — trades FLOPs
+        for HBM, which is what lets deep models (resnet56/110, densenet)
+        keep the [M, C] pool axes resident on one chip.
         """
         module = self.module
         if (self.cfg.compute_dtype == "bfloat16"
@@ -113,8 +125,12 @@ class Experiment:
                 if x.dtype == jnp.float32:
                     x = x.astype(jnp.bfloat16)
                 return module.apply({"params": p16}, x).astype(jnp.float32)
-            return apply_fn
-        return lambda p, x: module.apply({"params": p}, x)
+        else:
+            def apply_fn(p, x):
+                return module.apply({"params": p}, x)
+        if self.cfg.remat:
+            apply_fn = jax.checkpoint(apply_fn)
+        return apply_fn
 
     # ------------------------------------------------------------------
     def evaluate(self, t: int, round_idx: int, precomputed=None) -> dict:
@@ -244,20 +260,51 @@ class Experiment:
         self.last_phase_summary = self.tracer.summary()
         self.tracer.reset()   # per-iteration deltas, not cumulative totals
 
-    def _client_masks(self, rounds) -> "np.ndarray | None":
+    def _client_masks(self, t: int, rounds) -> "np.ndarray | None":
         """[len(rounds), C_pad] 0/1 participation masks, or None when every
-        client participates. Mirrors the reference's round-seeded sampling
-        without replacement (client_sampling,
-        AggregatorSoftCluster.py:197-205: np.random.seed(round_idx) +
-        choice) so runs are comparable round-for-round."""
+        client participates every round.
+
+        Combines (a) the reference's round-seeded client sampling without
+        replacement (client_sampling, AggregatorSoftCluster.py:197-205:
+        np.random.seed(round_idx) + choice) and (b) injected faults
+        (platform/faults.py), whose stream is indexed by the global
+        (t, round) pair. Realized participation feeds the failure detector.
+        """
         cfg = self.cfg
-        if cfg.client_num_per_round >= self.C_:
+        sampling = cfg.client_num_per_round < self.C_
+        if not sampling and self.fault_injector is None:
             return None
         masks = np.zeros((len(rounds), self.C_pad), dtype=np.float32)
         for i, r in enumerate(rounds):
-            sel = np.random.RandomState(int(r)).choice(
-                self.C_, cfg.client_num_per_round, replace=False)
-            masks[i, sel] = 1.0
+            if sampling:
+                sel = np.random.RandomState(int(r)).choice(
+                    self.C_, cfg.client_num_per_round, replace=False)
+                masks[i, sel] = 1.0
+            else:
+                sel = np.arange(self.C_)
+                masks[i, : self.C_] = 1.0
+            if self.fault_injector is not None:
+                fault_mask = self.fault_injector.mask(
+                    t * cfg.comm_round + int(r))
+                masks[i, : self.C_] *= fault_mask
+                # The detector must see only *failures*, not non-selection:
+                # fault status of sampled clients is a liveness signal,
+                # unsampled clients keep their streak unchanged.
+                if self.failure_detector is not None:
+                    observed = np.zeros(self.C_, dtype=bool)
+                    observed[sel] = True
+                    self.failure_detector.observe(fault_mask > 0, observed)
+                # Quorum floor on the COMPOSED mask (faults.py kills are
+                # exempt): if every sampled client dropped, revive the
+                # lowest-index sampled live client so the round is not a
+                # silent no-op that still advances the RNG/eval cadence.
+                if masks[i].sum() == 0:
+                    alive = sel[~self.fault_injector.dead[sel]]
+                    if len(alive):
+                        masks[i, alive[0]] = 1.0
+        if self.failure_detector is not None:
+            self.logger.set_summary("Failures/suspected",
+                                    self.failure_detector.suspected.tolist())
         return masks
 
     def _run_rounds(self, t: int, opt_states) -> None:
@@ -267,7 +314,7 @@ class Experiment:
             tw, sw, fm, lr_scale = self.algo.round_inputs(t, r)
             tw = self._pad_clients(tw)                  # phantom clients: w=0
             sw = self._pad_clients(sw, value=1.0)
-            cm = self._client_masks([r])
+            cm = self._client_masks(t, [r])
             prev_params = self.pool.params
             with self.tracer.phase("train_round"):
                 new_params, opt_states, client_params, n, losses = self.step.train_round(
@@ -300,7 +347,7 @@ class Experiment:
         tw = self._pad_clients(tw)
         sw = self._pad_clients(sw, value=1.0)
         g0 = self.global_round
-        cms = self._client_masks(range(R))
+        cms = self._client_masks(t, range(R))
         with self.tracer.phase("train_round"):
             new_params, opt_states, n, losses, bufs, total = \
                 self.step.train_iteration_eval(

@@ -74,6 +74,12 @@ class TestEndToEnd:
                                 frequency_of_the_test=4)).logger.series("Test/Acc")
         assert a != c
 
+    def test_remat_identical_numerics(self):
+        # jax.checkpoint rematerialization must not change trajectories
+        a = run_experiment(_cfg(comm_round=6)).logger.series("Test/Acc")
+        b = run_experiment(_cfg(comm_round=6, remat=True)).logger.series("Test/Acc")
+        assert a == b
+
     def test_determinism(self):
         a = run_experiment(_cfg()).logger.series("Test/Acc")
         b = run_experiment(_cfg()).logger.series("Test/Acc")

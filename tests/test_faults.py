@@ -1,0 +1,107 @@
+"""Fault injection + failure detection (platform/faults.py).
+
+The reference hangs forever on a dead client (SURVEY.md §5); here failures
+must degrade gracefully: masked training, non-blocking detection.
+"""
+
+import numpy as np
+import pytest
+
+from feddrift_tpu.config import ExperimentConfig
+from feddrift_tpu.platform.faults import FailureDetector, FaultInjector
+from feddrift_tpu.simulation.runner import run_experiment
+
+
+class TestFaultInjector:
+    def test_deterministic_masks(self):
+        a = FaultInjector(8, 0.3, seed=1).masks(range(20))
+        b = FaultInjector(8, 0.3, seed=1).masks(range(20))
+        np.testing.assert_array_equal(a, b)
+        assert 0 < a.mean() < 1   # some dropouts, not all
+
+    def test_kill_is_permanent_and_revivable(self):
+        inj = FaultInjector(4, 0.0)
+        inj.kill(2)
+        m = inj.masks(range(5))
+        assert (m[:, 2] == 0).all() and (m[:, [0, 1, 3]] == 1).all()
+        inj.revive(2)
+        assert inj.mask(9)[2] == 1
+
+    def test_quorum_of_one_floor(self):
+        inj = FaultInjector(3, 0.99, seed=0)
+        m = inj.masks(range(50))
+        assert (m.sum(axis=1) >= 1).all()
+
+    def test_rejects_bad_prob(self):
+        with pytest.raises(ValueError):
+            FaultInjector(4, 1.0)
+
+
+class TestFailureDetector:
+    def test_flags_after_patience(self):
+        det = FailureDetector(4, patience=3)
+        alive = np.ones(4)
+        dead2 = alive.copy()
+        dead2[2] = 0
+        det.observe(dead2)
+        det.observe(dead2)
+        assert det.suspected.tolist() == []
+        det.observe(dead2)
+        assert det.suspected.tolist() == [2]
+        det.observe(alive)   # client comes back -> cleared
+        assert det.suspected.tolist() == []
+        assert det.summary()["rounds_seen"] == 4
+
+
+class TestEndToEndWithFaults:
+    def _cfg(self, **kw):
+        base = dict(dataset="sine", model="fnn", concept_drift_algo="win-1",
+                    train_iterations=2, comm_round=10, epochs=3, sample_num=80,
+                    batch_size=40, frequency_of_the_test=5, lr=0.05,
+                    client_num_in_total=8, client_num_per_round=8, seed=0)
+        base.update(kw)
+        return ExperimentConfig(**base)
+
+    def test_training_survives_dropout(self):
+        exp = run_experiment(self._cfg(fault_dropout_prob=0.4))
+        assert exp.logger.last("Test/Acc") > 0.6
+        # detector observed every round of both iterations
+        assert exp.failure_detector.rounds_seen == 20
+
+    def test_dropout_changes_trajectory_deterministically(self):
+        a = run_experiment(self._cfg(fault_dropout_prob=0.4)).logger.series("Test/Acc")
+        b = run_experiment(self._cfg(fault_dropout_prob=0.4)).logger.series("Test/Acc")
+        c = run_experiment(self._cfg()).logger.series("Test/Acc")
+        assert a == b
+        assert a != c
+
+    def test_composes_with_client_sampling(self):
+        exp = run_experiment(self._cfg(client_num_per_round=4,
+                                       fault_dropout_prob=0.3))
+        assert exp.logger.last("Test/Acc") > 0.55
+
+    def test_nonselection_is_not_failure(self):
+        # heavy subsampling with zero faults: detector must suspect no one
+        # (non-selection carries no liveness signal)
+        exp = run_experiment(self._cfg(client_num_per_round=2,
+                                       fault_dropout_prob=1e-9))
+        assert exp.failure_detector.suspected.tolist() == []
+
+    def test_dead_client_detected_under_subsampling(self):
+        from feddrift_tpu.config import ExperimentConfig
+        from feddrift_tpu.simulation.runner import Experiment
+        exp = Experiment(self._cfg(client_num_per_round=4,
+                                   fault_dropout_prob=1e-9,
+                                   failure_patience=2))
+        exp.fault_injector.kill(3)
+        exp.run()
+        assert 3 in exp.failure_detector.suspected.tolist()
+
+    def test_observed_mask_freezes_streak(self):
+        det = FailureDetector(3, patience=2)
+        det.observe([0, 1, 1], observed=[True, True, False])
+        det.observe([0, 1, 1], observed=[False, True, True])
+        # client 0: absent once then unobserved -> streak stays 1, no suspect
+        assert det.suspected.tolist() == []
+        det.observe([0, 1, 1], observed=[True, True, True])
+        assert det.suspected.tolist() == [0]

@@ -43,12 +43,22 @@ class TestPhaseTracer:
         exp = Experiment(cfg)
         exp.run_iteration(0)
         s = exp.last_phase_summary
-        assert s["train_round"]["count"] == 2
-        assert s["eval"]["count"] == 2
+        # fused path: the whole iteration is ONE device program, evals fetched
+        # in one bulk transfer
+        assert s["train_round"]["count"] == 1
+        assert s["eval"]["count"] == 1
         assert s["cluster"]["count"] == 2   # begin + end
         assert all(np.isfinite(v["total_s"]) for v in s.values())
         # per-iteration deltas: tracer resets between iterations
         assert exp.tracer.summary() == {}
+
+        # per-round path: one train_round/eval phase per round
+        from dataclasses import replace
+        exp2 = Experiment(replace(cfg, chunk_rounds=False))
+        exp2.run_iteration(0)
+        s2 = exp2.last_phase_summary
+        assert s2["train_round"]["count"] == 2
+        assert s2["eval"]["count"] == 2
 
 
 class TestAnnotate:
