@@ -92,6 +92,9 @@ class ExperimentConfig:
     fault_dropout_prob: float = 0.0    # per-round transient client failure
     fault_seed: int = 0
     failure_patience: int = 3          # rounds absent before a client is suspected
+    # Enable the injector/detector even with zero transient dropout — for
+    # kill()-based permanent-failure / elastic-membership experiments.
+    fault_enabled: bool = False
 
     def __post_init__(self) -> None:
         if self.client_num_per_round > self.client_num_in_total:

@@ -76,6 +76,63 @@ class ResNetCifar(nn.Module):
         return nn.Dense(self.num_classes)(x)
 
 
+class ResNetFeatures(nn.Module):
+    """Client-side GKT trunk: stem + the 16-filter stage, emitting SPATIAL
+    feature maps ``[B, 32, 32, 16]``.
+
+    Mirrors the reference's split (fedml_api/distributed/fedgkt/: the phone
+    client runs a ResNet-8-sized extractor and uploads feature maps, not
+    pooled vectors, to the server CNN). ``depth`` follows the 6n+2 rule of
+    ResNetCifar with only the first stage kept (depth 8 -> n = 1 block).
+    """
+
+    depth: int = 8
+    norm: str = "batch"
+
+    @nn.compact
+    def __call__(self, x):
+        if x.ndim == 2:
+            x = x.reshape((x.shape[0], 32, 32, 3))
+        n = (self.depth - 2) // 6
+        x = nn.Conv(16, (3, 3), padding="SAME", use_bias=False)(x)
+        x = nn.relu(_Norm(self.norm)(x))
+        for _ in range(n):
+            x = BasicBlock(16, 1, self.norm)(x)
+        return x
+
+
+class ResNetHead(nn.Module):
+    """Client-side GKT classifier on pooled trunk features (the small local
+    head the client distills with)."""
+
+    num_classes: int = 10
+
+    @nn.compact
+    def __call__(self, feats):
+        return nn.Dense(self.num_classes)(feats.mean(axis=(1, 2)))
+
+
+class ResNetServerTail(nn.Module):
+    """Server-side GKT CNN: the 32/64-filter stages of a 6n+2 ResNet applied
+    to uploaded client feature maps (the reference's large server model that
+    never sees raw data)."""
+
+    num_classes: int = 10
+    depth: int = 56
+    norm: str = "batch"
+
+    @nn.compact
+    def __call__(self, feats):
+        n = (self.depth - 2) // 6
+        x = feats
+        for filters in (32, 64):
+            for block in range(n):
+                strides = 2 if block == 0 else 1
+                x = BasicBlock(filters, strides, self.norm)(x)
+        x = x.mean(axis=(1, 2))
+        return nn.Dense(self.num_classes)(x)
+
+
 class ResNet18(nn.Module):
     """Compact ImageNet-style ResNet-18 (torchvision flavor, 2-2-2-2 blocks)."""
 

@@ -23,6 +23,23 @@ import optax
 from feddrift_tpu.core.functional import cross_entropy
 
 
+def make_gkt_split(num_classes: int = 10, client_depth: int = 8,
+                   server_depth: int = 56, norm: str = "batch"):
+    """The reference's GKT model pair: a ResNet-8-sized client trunk + local
+    head, and a large server ResNet tail consuming uploaded feature maps
+    (fedml_api/distributed/fedgkt/ — client resnet8, server resnet49/55).
+
+    Returns ``(extractor, head, server)`` flax modules whose ``apply``
+    closures plug directly into :class:`GktTrainer`.
+    """
+    from feddrift_tpu.models.resnet import (ResNetFeatures, ResNetHead,
+                                            ResNetServerTail)
+    return (ResNetFeatures(depth=client_depth, norm=norm),
+            ResNetHead(num_classes=num_classes),
+            ResNetServerTail(num_classes=num_classes, depth=server_depth,
+                             norm=norm))
+
+
 def kl_divergence(student_logits, teacher_logits, temperature: float = 1.0):
     """KL(teacher || student) on temperature-softened distributions
     (fedgkt/utils KL_Loss)."""

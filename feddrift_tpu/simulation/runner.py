@@ -90,7 +90,7 @@ class Experiment:
         from feddrift_tpu.platform.faults import FailureDetector, FaultInjector
         self.fault_injector = (
             FaultInjector(self.C_, cfg.fault_dropout_prob, cfg.fault_seed)
-            if cfg.fault_dropout_prob > 0 else None)
+            if (cfg.fault_dropout_prob > 0 or cfg.fault_enabled) else None)
         self.failure_detector = (
             FailureDetector(self.C_, cfg.failure_patience)
             if self.fault_injector is not None else None)
@@ -287,13 +287,6 @@ class Experiment:
                 fault_mask = self.fault_injector.mask(
                     t * cfg.comm_round + int(r))
                 masks[i, : self.C_] *= fault_mask
-                # The detector must see only *failures*, not non-selection:
-                # fault status of sampled clients is a liveness signal,
-                # unsampled clients keep their streak unchanged.
-                if self.failure_detector is not None:
-                    observed = np.zeros(self.C_, dtype=bool)
-                    observed[sel] = True
-                    self.failure_detector.observe(fault_mask > 0, observed)
                 # Quorum floor on the COMPOSED mask (faults.py kills are
                 # exempt): if every sampled client dropped, revive the
                 # lowest-index sampled live client so the round is not a
@@ -302,6 +295,15 @@ class Experiment:
                     alive = sel[~self.fault_injector.dead[sel]]
                     if len(alive):
                         masks[i, alive[0]] = 1.0
+                # The detector sees REALIZED participation (post-floor: a
+                # quorum-revived client did train) and only *failures*, not
+                # non-selection: sampled clients give a liveness signal,
+                # unsampled clients keep their streak unchanged.
+                if self.failure_detector is not None:
+                    observed = np.zeros(self.C_, dtype=bool)
+                    observed[sel] = True
+                    self.failure_detector.observe(
+                        masks[i, : self.C_] > 0, observed)
         if self.failure_detector is not None:
             self.logger.set_summary("Failures/suspected",
                                     self.failure_detector.suspected.tolist())

@@ -84,14 +84,14 @@ class TestEndToEndWithFaults:
         # heavy subsampling with zero faults: detector must suspect no one
         # (non-selection carries no liveness signal)
         exp = run_experiment(self._cfg(client_num_per_round=2,
-                                       fault_dropout_prob=1e-9))
+                                       fault_enabled=True))
         assert exp.failure_detector.suspected.tolist() == []
 
     def test_dead_client_detected_under_subsampling(self):
         from feddrift_tpu.config import ExperimentConfig
         from feddrift_tpu.simulation.runner import Experiment
         exp = Experiment(self._cfg(client_num_per_round=4,
-                                   fault_dropout_prob=1e-9,
+                                   fault_enabled=True,
                                    failure_patience=2))
         exp.fault_injector.kill(3)
         exp.run()

@@ -22,6 +22,7 @@ class TestModels:
         ("fnn", "sea", (4, 3)),
         ("cnn", "MNIST", (4, 784)),
         ("resnet20", "cifar10", (4, 32, 32, 3)),
+        ("resnet8", "cifar10", (4, 32, 32, 3)),
     ])
     def test_forward_shapes(self, name, dataset, xshape):
         ds, cfg = _ds(dataset)

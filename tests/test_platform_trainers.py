@@ -95,6 +95,31 @@ class TestGkt:
         acc = float((np.asarray(logits).argmax(-1) == y).mean())
         assert acc > 0.85, acc
 
+    def test_resnet8_split_round_runs(self):
+        # the reference-shaped split: resnet8 trunk -> feature maps -> server
+        # tail (tiny server_depth to keep single-core compile cheap)
+        from feddrift_tpu.platform.gkt import GktTrainer, make_gkt_split
+        ext, head, srv = make_gkt_split(num_classes=2, client_depth=8,
+                                        server_depth=8, norm="group")
+        rng = np.random.default_rng(0)
+        x = jnp.asarray(rng.normal(size=(4, 32, 32, 3)).astype(np.float32))
+        y = jnp.asarray((rng.random(4) > 0.5).astype(np.int32))
+        pe = ext.init(jax.random.PRNGKey(0), x)["params"]
+        feats = ext.apply({"params": pe}, x)
+        assert feats.shape == (4, 32, 32, 16)
+        ph = head.init(jax.random.PRNGKey(1), feats)["params"]
+        ps = srv.init(jax.random.PRNGKey(2), feats)["params"]
+        tr = GktTrainer(
+            client_extractor=lambda p, xx: ext.apply({"params": p}, xx),
+            client_head=lambda p, f: head.apply({"params": p}, f),
+            server_apply=lambda p, f: srv.apply({"params": p}, f),
+            client_opt=optax.sgd(0.1), server_opt=optax.sgd(0.1))
+        c_opt = tr.client_opt.init((pe, ph))
+        s_opt = tr.server_opt.init(ps)
+        pe, ph, c_opt, ps, s_opt, cl, sl = tr.alternating_round(
+            pe, ph, c_opt, ps, s_opt, x, y)
+        assert np.isfinite(cl) and np.isfinite(sl)
+
     def test_kl_zero_for_identical(self):
         from feddrift_tpu.platform.gkt import kl_divergence
         logits = jnp.asarray(np.random.default_rng(0).normal(size=(8, 5)),
