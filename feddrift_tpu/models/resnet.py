@@ -54,36 +54,14 @@ class BasicBlock(nn.Module):
         return nn.relu(y + residual)
 
 
-class ResNetCifar(nn.Module):
-    """6n+2 CIFAR ResNet (resnet.py:113: depth in {20, 56, 110})."""
-
-    num_classes: int = 10
-    depth: int = 20
-    norm: str = "batch"
-
-    @nn.compact
-    def __call__(self, x):
-        if x.ndim == 2:
-            x = x.reshape((x.shape[0], 32, 32, 3))
-        n = (self.depth - 2) // 6
-        x = nn.Conv(16, (3, 3), padding="SAME", use_bias=False)(x)
-        x = nn.relu(_Norm(self.norm)(x))
-        for stage, filters in enumerate((16, 32, 64)):
-            for block in range(n):
-                strides = 2 if stage > 0 and block == 0 else 1
-                x = BasicBlock(filters, strides, self.norm)(x)
-        x = x.mean(axis=(1, 2))
-        return nn.Dense(self.num_classes)(x)
-
-
 class ResNetFeatures(nn.Module):
-    """Client-side GKT trunk: stem + the 16-filter stage, emitting SPATIAL
+    """The stem + 16-filter stage of a 6n+2 CIFAR ResNet, emitting SPATIAL
     feature maps ``[B, 32, 32, 16]``.
 
-    Mirrors the reference's split (fedml_api/distributed/fedgkt/: the phone
-    client runs a ResNet-8-sized extractor and uploads feature maps, not
-    pooled vectors, to the server CNN). ``depth`` follows the 6n+2 rule of
-    ResNetCifar with only the first stage kept (depth 8 -> n = 1 block).
+    Doubles as the client-side GKT trunk (fedml_api/distributed/fedgkt/: the
+    phone client runs a ResNet-8-sized extractor and uploads feature maps,
+    not pooled vectors, to the server CNN). ``depth`` follows the 6n+2 rule
+    (depth 8 -> n = 1 block).
     """
 
     depth: int = 8
@@ -102,8 +80,8 @@ class ResNetFeatures(nn.Module):
 
 
 class ResNetHead(nn.Module):
-    """Client-side GKT classifier on pooled trunk features (the small local
-    head the client distills with)."""
+    """Classifier on pooled trunk features (the small local head a GKT
+    client distills with)."""
 
     num_classes: int = 10
 
@@ -113,9 +91,11 @@ class ResNetHead(nn.Module):
 
 
 class ResNetServerTail(nn.Module):
-    """Server-side GKT CNN: the 32/64-filter stages of a 6n+2 ResNet applied
-    to uploaded client feature maps (the reference's large server model that
-    never sees raw data)."""
+    """The 32/64-filter stages + pooled classifier of a 6n+2 CIFAR ResNet,
+    applied to 16-filter feature maps.
+
+    Doubles as the server-side GKT CNN (the reference's large server model
+    that never sees raw data, only uploaded client feature maps)."""
 
     num_classes: int = 10
     depth: int = 56
@@ -131,6 +111,22 @@ class ResNetServerTail(nn.Module):
                 x = BasicBlock(filters, strides, self.norm)(x)
         x = x.mean(axis=(1, 2))
         return nn.Dense(self.num_classes)(x)
+
+
+class ResNetCifar(nn.Module):
+    """6n+2 CIFAR ResNet (resnet.py:113: depth in {20, 56, 110}), composed
+    as trunk -> tail so the full model and the GKT split share one
+    definition of the stage logic."""
+
+    num_classes: int = 10
+    depth: int = 20
+    norm: str = "batch"
+
+    @nn.compact
+    def __call__(self, x):
+        feats = ResNetFeatures(depth=self.depth, norm=self.norm)(x)
+        return ResNetServerTail(num_classes=self.num_classes,
+                                depth=self.depth, norm=self.norm)(feats)
 
 
 class ResNet18(nn.Module):
