@@ -28,8 +28,43 @@ import jax.numpy as jnp
 REFERENCE_ROUNDS_PER_SEC = 1.0  # generous estimate; see module docstring
 
 
+def _probe_backend(timeout_s: float = 90.0) -> str:
+    """Return the usable backend name, falling back to CPU if the default
+    backend is unreachable.
+
+    The axon TPU tunnel can hang indefinitely at client creation when the
+    remote side is unhealthy; a hung benchmark records nothing. The probe
+    runs in a SUBPROCESS (an in-process thread would wedge this process:
+    backend creation holds jax's global init lock, so once a thread hangs in
+    it no other thread can create any backend). On timeout the main process
+    — which has not initialized any backend yet — pins the CPU platform.
+    """
+    import subprocess
+
+    why = f"probe timed out after {timeout_s:.0f}s"
+    try:
+        out = subprocess.run(
+            [sys.executable, "-c",
+             "import jax, jax.numpy as jnp;"
+             "jax.block_until_ready(jnp.ones((8, 8)) @ jnp.ones((8, 8)));"
+             "print(jax.default_backend())"],
+            capture_output=True, text=True, timeout=timeout_s)
+        if out.returncode == 0 and out.stdout.strip():
+            return out.stdout.strip().splitlines()[-1]
+        why = (f"probe exited {out.returncode}: "
+               + (out.stderr or "").strip()[-500:])
+    except subprocess.TimeoutExpired:
+        pass
+    jax.config.update("jax_platforms", "cpu")
+    print(json.dumps({"warning": f"default backend unreachable ({why}); "
+                      "benchmarking on CPU fallback"}),
+          file=sys.stderr)
+    return "cpu-fallback"
+
+
 def main() -> None:
     smoke = "--smoke" in sys.argv
+    backend = _probe_backend()
 
     from feddrift_tpu.config import ExperimentConfig
     from feddrift_tpu.simulation.runner import Experiment
@@ -77,6 +112,7 @@ def main() -> None:
         "final_test_acc": round(float(final_acc), 4),
         "wall_s": round(elapsed, 2),
         "rounds": rounds,
+        "backend": backend,
     }))
 
 
