@@ -1,0 +1,97 @@
+"""Weak-scaling benchmark over the clients mesh axis.
+
+BASELINE.md's north star includes 8 -> 64 chip scaling. This script measures
+communication-round throughput of the fused FedDrift time step while growing
+the device mesh and the client population together (weak scaling: fixed
+clients-per-device), reporting one JSON line per mesh size.
+
+On real hardware run it as-is (devices = the pod slice). Without a pod, pass
+``--virtual N`` to simulate N CPU devices in-process — the collectives and
+sharding are real (GSPMD), only the interconnect is host memory, so this
+validates scaling *behavior* (no recompiles, no per-device work growth, flat
+loss curves), not interconnect bandwidth.
+
+Usage:
+    python scripts/scaling_bench.py --virtual 8 --clients_per_device 4
+    python scripts/scaling_bench.py            # real devices, weak scaling
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--virtual", type=int, default=0,
+                    help="simulate N CPU devices (0 = use real devices)")
+    ap.add_argument("--clients_per_device", type=int, default=4)
+    ap.add_argument("--rounds", type=int, default=20)
+    ap.add_argument("--sample_num", type=int, default=200)
+    ap.add_argument("--model", default="fnn")
+    ap.add_argument("--dataset", default="sea")
+    args = ap.parse_args()
+
+    import jax
+
+    if args.virtual:
+        jax.config.update("jax_platforms", "cpu")
+        jax.config.update("jax_num_cpu_devices", args.virtual)
+    import jax.numpy as jnp
+    import numpy as np
+
+    from feddrift_tpu.config import ExperimentConfig
+    from feddrift_tpu.simulation.runner import Experiment
+    from feddrift_tpu.parallel.mesh import make_mesh
+
+    n_total = len(jax.devices())
+    sizes = [n for n in (1, 2, 4, 8, 16, 32, 64) if n <= n_total]
+    results = []
+    for n_dev in sizes:
+        C = n_dev * args.clients_per_device
+        cfg = ExperimentConfig(
+            dataset=args.dataset, model=args.model,
+            concept_drift_algo="softcluster",
+            concept_drift_algo_arg="H_A_C_1_10_0", concept_num=4,
+            change_points="rand", drift_together=1,
+            client_num_in_total=C, client_num_per_round=C,
+            train_iterations=4, comm_round=args.rounds, epochs=5,
+            batch_size=min(500, args.sample_num),
+            sample_num=args.sample_num, lr=0.01,
+            frequency_of_the_test=max(1, args.rounds // 2), seed=7)
+        exp = Experiment(cfg, mesh=make_mesh(n_dev))
+        exp.run_iteration(0)        # compile + cluster_init path
+        exp.run_iteration(1)        # compile the steady-state path
+        t0 = time.time()
+        for t in range(2, cfg.train_iterations):
+            exp.run_iteration(t)
+        jax.block_until_ready(exp.pool.params)
+        dt = time.time() - t0
+        rounds = cfg.comm_round * (cfg.train_iterations - 2)
+        res = {
+            "devices": n_dev,
+            "clients": C,
+            "rounds_per_s": round(rounds / dt, 3),
+            "client_rounds_per_s": round(rounds * C / dt, 1),
+            "final_test_acc": round(float(exp.logger.last("Test/Acc")), 4),
+        }
+        results.append(res)
+        print(json.dumps(res), flush=True)
+
+    if len(results) > 1:
+        base = results[0]["client_rounds_per_s"] / results[0]["devices"]
+        eff = results[-1]["client_rounds_per_s"] / (
+            results[-1]["devices"] * base)
+        print(json.dumps({"weak_scaling_efficiency": round(eff, 3),
+                          "from": results[0]["devices"],
+                          "to": results[-1]["devices"]}), flush=True)
+
+
+if __name__ == "__main__":
+    main()
