@@ -1,0 +1,22 @@
+"""The examples must stay runnable: they are the documented plugin surface."""
+
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent / "examples"))
+
+
+class TestCustomPlugin:
+    def test_custom_dataset_and_model_compose_with_feddrift(self):
+        import custom_plugin
+        acc = custom_plugin.main(smoke=True)
+        # drifting 2-class problem: anything clearly above chance proves the
+        # pipeline trained; exact accuracy is not the example's point
+        assert acc > 0.6, acc
+
+    def test_registries_expose_plugins(self):
+        import custom_plugin  # noqa: F401  (import registers)
+        from feddrift_tpu.data.registry import available_datasets
+        from feddrift_tpu.models import available_models
+        assert "xor-rot" in available_datasets()
+        assert "tiny-mlp" in available_models()
