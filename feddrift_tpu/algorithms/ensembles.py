@@ -26,6 +26,17 @@ import jax.numpy as jnp
 EPS = 1e-20
 
 
+def kappa_from_confusion(A: np.ndarray) -> float:
+    """Cohen's kappa from a summed [K, K] confusion matrix (rows = truth),
+    with the reference's zero-denominator guard
+    (FedAvgEnsAggregatorKue.py:64-70)."""
+    n = A.sum()
+    left = np.trace(A)
+    right = (A.sum(axis=1) * A.sum(axis=0)).sum()
+    denom = n * n - right
+    return float((n * left - right) / denom) if denom != 0 else 0.0
+
+
 class _AueBase(DriftAlgorithm):
     """Shared AUE machinery; subclasses choose global vs per-client weights."""
 
@@ -208,12 +219,7 @@ class Kue(DriftAlgorithm):
             self.pool.params, self.x[:, t], self.y[:, t], self._fm)
         cms = np.asarray(cms, dtype=np.float64)[:, : self.C].sum(axis=1)  # [M, K, K]
         for m in range(self.M):
-            A = cms[m]
-            n = A.sum()
-            left = np.trace(A)
-            right = (A.sum(axis=1) * A.sum(axis=0)).sum()
-            denom = n * n - right
-            self.ens_weights[m] = (n * left - right) / denom if denom != 0 else 0.0
+            self.ens_weights[m] = kappa_from_confusion(cms[m])
 
     def after_round(self, t: int, r: int, prev_params, agg_params,
                     client_params, n):

@@ -6,6 +6,7 @@ through the full jitted round loop on the 8-device CPU mesh, mirroring the
 reference's --ci smoke strategy (SURVEY.md §4).
 """
 
+import jax.numpy as jnp
 import numpy as np
 import pytest
 
@@ -66,6 +67,25 @@ class TestKue:
         assert masks.shape[0] == 4
         assert ((masks == 0) | (masks == 1)).all()
         assert (masks.sum(axis=1) >= 1).all()      # every model >= 1 feature
+
+    def test_kappa_matches_sklearn(self):
+        # golden cross-check of BOTH kappa implementations (host-side
+        # kappa_from_confusion and the jnp cohens_kappa primitive) against
+        # sklearn on random labelings
+        from sklearn.metrics import cohen_kappa_score
+        from feddrift_tpu.algorithms.ensembles import kappa_from_confusion
+        from feddrift_tpu.core.functional import cohens_kappa
+        rng = np.random.default_rng(0)
+        K = 4
+        for trial in range(5):
+            y_true = rng.integers(0, K, size=400)
+            y_pred = np.where(rng.random(400) < 0.6, y_true,
+                              rng.integers(0, K, size=400))
+            A = np.zeros((K, K))
+            np.add.at(A, (y_true, y_pred), 1.0)
+            expected = cohen_kappa_score(y_true, y_pred)
+            assert abs(kappa_from_confusion(A) - expected) < 1e-9
+            assert abs(float(cohens_kappa(jnp.asarray(A))) - expected) < 1e-5
 
     def test_kappa_formula(self):
         # Perfect predictions -> kappa 1; uniform-random-ish -> ~0.
