@@ -74,6 +74,10 @@ class DriftAlgorithm:
     # (KUE's Poisson bootstrap). Compiled statically into TrainStep — an
     # algorithm that sets sample_w without this trait would have it ignored.
     uses_sample_weights = False
+    # True if after_round consumes the per-client [M, C, ...] parameter
+    # output (CFL-family gradient clustering); everyone else lets the round
+    # program drop that buffer (TrainStep.train_round keep_client_params).
+    needs_client_params = False
 
     def __init__(self, cfg, ds, pool, step) -> None:
         self.cfg = cfg

@@ -76,6 +76,8 @@ class SoftCluster(DriftAlgorithm):
             self.geni_concepts = ds.concepts[:, : self.C]
         self.rng = np.random.default_rng(cfg.seed + 1009)
         self._tw = None
+        # only the CFL variant reads per-client deltas in after_round
+        self.needs_client_params = self.kind == "cfl"
 
     # ------------------------------------------------------------------
     # plumbing

@@ -377,6 +377,7 @@ class LegacyClusterFL(DriftAlgorithm):
     """
 
     name = "clusterfl"
+    needs_client_params = True
 
     def __init__(self, cfg, ds, pool, step) -> None:
         super().__init__(cfg, ds, pool, step)

@@ -22,15 +22,6 @@ def confusion_matrix(logits: jnp.ndarray, labels: jnp.ndarray,
         (num_classes, num_classes)).astype(jnp.float32)
 
 
-def cohens_kappa(conf: jnp.ndarray) -> jnp.ndarray:
-    """Cohen's kappa from a summed confusion matrix
-    (FedAvgEnsAggregatorKue.py:64-70)."""
-    n = conf.sum()
-    diag = jnp.trace(conf)
-    marg = (conf.sum(axis=1) * conf.sum(axis=0)).sum()
-    return (n * diag - marg) / (n * n - marg)
-
-
 def tree_select(cond_scalar, a, b):
     """Select an entire pytree by a traced scalar boolean."""
     return jax.tree_util.tree_map(

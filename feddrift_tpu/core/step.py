@@ -184,14 +184,26 @@ class TrainStep:
         new_params = jax.tree_util.tree_map(avg, client_params, params)
         return new_params, new_opt, client_params, n, losses
 
-    @partial(jax.jit, static_argnums=0)
+    @partial(jax.jit, static_argnums=0,
+             static_argnames=("keep_client_params",))
     def train_round(self, params, opt_states, key, x, y, time_w, sample_w,
-                    feat_mask, lr_scale, client_mask=None):
+                    feat_mask, lr_scale, client_mask=None, *,
+                    keep_client_params: bool = True):
         """One communication round. Returns (new_params [M, ...],
         new_opt_states, client_params [M, C, ...], n [M, C], mean_loss [M, C]).
+
+        ``keep_client_params=False`` drops the per-client parameter output
+        (returned as None): only CFL-family algorithms need the [M, C, ...]
+        deltas (SURVEY.md §7 hard parts), and for deep models that output
+        buffer is M x C full model copies of HBM the weighted-mean reduction
+        can otherwise stream through.
         """
-        return self._round_body(params, opt_states, key, x, y, time_w,
-                                sample_w, feat_mask, lr_scale, client_mask)
+        out = self._round_body(params, opt_states, key, x, y, time_w,
+                               sample_w, feat_mask, lr_scale, client_mask)
+        if keep_client_params:
+            return out
+        new_params, new_opt, _client_params, n, losses = out
+        return new_params, new_opt, None, n, losses
 
     @staticmethod
     def eval_rounds(R: int, freq: int) -> list[int]:

@@ -322,7 +322,8 @@ class Experiment:
                 new_params, opt_states, client_params, n, losses = self.step.train_round(
                     prev_params, opt_states, round_key(self.key, t, r),
                     self.x, self.y, tw, sw, fm, lr_scale,
-                    None if cm is None else jnp.asarray(cm[0]))
+                    None if cm is None else jnp.asarray(cm[0]),
+                    keep_client_params=self.algo.needs_client_params)
                 if cfg.trace_sync:
                     # attribute device time to this phase instead of letting
                     # async dispatch spill it into whichever phase blocks next
