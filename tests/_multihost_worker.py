@@ -1,0 +1,96 @@
+"""Worker for the 2-process multi-controller integration test.
+
+Each process owns 2 virtual CPU devices; together they form a 4-device
+global mesh over which the REAL federated round program runs SPMD — the
+closest a single box gets to the reference's mpirun-launched multi-host
+deployment (FedAvgEnsAPI.py:25-29), with the client mesh axis spanning the
+process (DCN) boundary exactly as it would on a multi-host pod.
+
+Usage: python tests/_multihost_worker.py <process_id> <num_processes> <addr>
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 2)
+
+
+def main() -> None:
+    pid, n, addr = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
+
+    from feddrift_tpu.comm import multihost
+
+    multihost.initialize(coordinator_address=addr, num_processes=n,
+                         process_id=pid)
+    assert jax.process_count() == n, jax.process_count()
+    assert multihost.process_count() == n
+    assert multihost.is_coordinator() == (pid == 0)
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    # control-plane helpers across real process boundaries
+    val = multihost.broadcast_from_coordinator(jnp.float32(41.0 + pid))
+    assert float(val) == 41.0, val
+    s = multihost.broadcast_sum(np.float32(pid + 1))
+    assert float(s) == n * (n + 1) / 2, s
+
+    # the actual round program, client axis spanning both processes
+    from jax.sharding import Mesh
+
+    from feddrift_tpu.config import ExperimentConfig
+    from feddrift_tpu.core.pool import ModelPool
+    from feddrift_tpu.core.step import TrainStep, make_optimizer
+    from feddrift_tpu.data.registry import make_dataset
+    from feddrift_tpu.models import create_model
+    from feddrift_tpu.parallel.mesh import shard_client_arrays
+
+    C = len(jax.devices())            # one client per global device
+    cfg = ExperimentConfig(dataset="sea", model="fnn", train_iterations=2,
+                           sample_num=32, batch_size=16, epochs=2,
+                           client_num_in_total=C, client_num_per_round=C,
+                           concept_num=2, seed=0)
+    ds = make_dataset(cfg)            # same seed -> identical on every process
+    module = create_model(cfg.model, ds, cfg)
+    pool = ModelPool.create(module, jnp.asarray(ds.x[0, 0, :2]),
+                            cfg.num_models, seed=0)
+    step = TrainStep(pool.apply, make_optimizer("adam", cfg.lr, cfg.wd),
+                     cfg.batch_size, cfg.epochs, ds.num_classes)
+
+    mesh = Mesh(np.asarray(jax.devices()), ("clients",))
+    x = shard_client_arrays(mesh, jnp.asarray(ds.x))
+    y = shard_client_arrays(mesh, jnp.asarray(ds.y))
+    M, T1, N = cfg.num_models, ds.num_steps + 1, ds.samples_per_step
+    tw = shard_client_arrays(mesh, jnp.ones((M, C, T1), jnp.float32),
+                             client_axis=1)
+    sw = shard_client_arrays(mesh, jnp.ones((M, C, N), jnp.float32),
+                             client_axis=1)
+    fm = jnp.ones((M, *ds.feature_shape), jnp.float32)
+    opt = step.init_opt_states(pool.params, M, C)
+
+    new_params, _, _, n_arr, losses = step.train_round(
+        pool.params, opt, jax.random.PRNGKey(0), x, y, tw, sw, fm,
+        jnp.float32(1.0))
+    jax.block_until_ready(new_params)
+
+    # aggregated params are replicated: every process sees identical values
+    leaf0 = np.asarray(jax.tree_util.tree_leaves(new_params)[0])
+    digest = float(np.abs(leaf0).sum())
+    digests = multihost.broadcast_sum(np.float32(digest))
+    assert abs(float(digests) - n * digest) < 1e-3 * max(1.0, abs(digest)), (
+        digest, float(digests))
+
+    correct, _, total = step.acc_matrix(new_params, x[:, 0], y[:, 0], fm)
+    jax.block_until_ready(correct)
+    print(f"WORKER_OK {pid} digest={digest:.4f}", flush=True)
+
+
+if __name__ == "__main__":
+    main()
