@@ -1,0 +1,105 @@
+"""Host->device prefetching for datasets that exceed device memory.
+
+The drift pipeline keeps the whole ``[C, T1, N, ...]`` simulation on device
+(data/drift_dataset.py) — the right call for the reference's scales (500
+samples x 10 clients x 10 steps). Real FMoW-sized image sets outgrow HBM;
+the reference answers that with per-process torch DataLoaders re-reading CSV
+partitions from disk every iteration (fmow/data_loader.py:63-103,
+SURVEY.md §7: "host data loading is the bottleneck"). The TPU-native answer
+is a grain/tf.data-style background prefetcher: while the device trains on
+time step t, the host stages step t+1 into device memory, so the transfer
+hides behind compute instead of serializing with it.
+
+``prefetch_to_device`` is the generic primitive; ``TimeStepStream`` applies
+it to a host-resident DriftDataset, yielding client-sharded (x_t, y_t)
+slices one step ahead of consumption.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Any, Callable, Iterable, Iterator, Optional
+
+import jax
+
+
+class _End:
+    pass
+
+
+_END = _End()
+
+
+def prefetch_to_device(it: Iterable[Any], size: int = 2,
+                       place: Optional[Callable[[Any], Any]] = None
+                       ) -> Iterator[Any]:
+    """Iterate ``it`` with up to ``size`` elements staged onto device ahead
+    of the consumer.
+
+    ``place`` maps a host element to its device placement (default:
+    ``jax.device_put``); it runs on the background thread, so the consumer
+    overlaps device transfer with whatever it is doing — jax device puts are
+    async, the consumer only blocks when it actually uses the array.
+    Exceptions in the source iterator or placement propagate to the consumer
+    at the point of the failing element; the background thread is a daemon
+    and dies with the process on early abandonment.
+    """
+    if size < 1:
+        raise ValueError(f"size must be >= 1, got {size}")
+    put = place if place is not None else jax.device_put
+    buf: queue.Queue = queue.Queue(maxsize=size)
+
+    def producer() -> None:
+        try:
+            for item in it:
+                buf.put(put(item))
+        except BaseException as e:           # noqa: BLE001 — re-raised below
+            buf.put(e)
+            return
+        buf.put(_END)
+
+    threading.Thread(target=producer, daemon=True).start()
+
+    while True:
+        item = buf.get()
+        if isinstance(item, _End):
+            return
+        if isinstance(item, BaseException):
+            raise item
+        yield item
+
+
+class TimeStepStream:
+    """Client-sharded (x_t, y_t) device slices of a HOST-resident dataset,
+    prefetched one time step ahead.
+
+    For experiments whose data cannot live on device whole. Composes with
+    window-style algorithms (win-N with small N); horizon-weighted algorithms
+    (softcluster 'all', exp/lin) need the full past on device and should keep
+    the resident layout.
+    """
+
+    def __init__(self, ds, mesh, size: int = 2) -> None:
+        from feddrift_tpu.parallel.mesh import client_sharding
+
+        self.ds = ds
+        self._shx = client_sharding(mesh, ds.x[:, 0].ndim)
+        self._shy = client_sharding(mesh, ds.y[:, 0].ndim)
+        self.size = size
+
+    def _place(self, step_arrays):
+        x_t, y_t = step_arrays
+        return (jax.device_put(x_t, self._shx), jax.device_put(y_t, self._shy))
+
+    def steps(self, start: int = 0, stop: Optional[int] = None
+              ) -> Iterator[tuple]:
+        """Yield device-placed (x_t, y_t) for t in [start, stop)."""
+        stop = self.ds.x.shape[1] if stop is None else stop
+
+        def host_slices():
+            for t in range(start, stop):
+                yield (self.ds.x[:, t], self.ds.y[:, t])
+
+        return prefetch_to_device(host_slices(), size=self.size,
+                                  place=self._place)

@@ -1,0 +1,81 @@
+"""Host->device prefetcher (data/prefetch.py)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from feddrift_tpu.data.prefetch import TimeStepStream, prefetch_to_device
+
+
+class TestPrefetchToDevice:
+    def test_order_and_values(self):
+        items = [np.full((4,), i, dtype=np.float32) for i in range(7)]
+        out = list(prefetch_to_device(iter(items), size=2))
+        assert len(out) == 7
+        for i, arr in enumerate(out):
+            assert isinstance(arr, jax.Array)
+            np.testing.assert_array_equal(np.asarray(arr), items[i])
+
+    def test_source_exception_propagates(self):
+        def gen():
+            yield np.zeros(2)
+            raise RuntimeError("boom")
+        it = prefetch_to_device(gen(), size=2)
+        next(it)
+        with pytest.raises(RuntimeError, match="boom"):
+            next(it)
+
+    def test_placement_exception_propagates(self):
+        def bad_place(_):
+            raise ValueError("cannot place")
+        with pytest.raises(ValueError, match="cannot place"):
+            list(prefetch_to_device(iter([np.zeros(2)]), place=bad_place))
+
+    def test_invalid_size(self):
+        with pytest.raises(ValueError):
+            list(prefetch_to_device(iter([]), size=0))
+
+    def test_custom_placement_sharding(self):
+        from feddrift_tpu.parallel.mesh import client_sharding, make_mesh
+        mesh = make_mesh(8)
+        sh = client_sharding(mesh, 2)
+        out = list(prefetch_to_device(
+            (np.ones((8, 3), np.float32) * i for i in range(3)),
+            place=lambda a: jax.device_put(a, sh)))
+        assert all(o.sharding == sh for o in out)
+
+
+class TestTimeStepStream:
+    def test_streams_dataset_slices_sharded(self):
+        from feddrift_tpu.config import ExperimentConfig
+        from feddrift_tpu.data.registry import make_dataset
+        from feddrift_tpu.parallel.mesh import make_mesh
+
+        cfg = ExperimentConfig(dataset="sea", train_iterations=3,
+                               client_num_in_total=8, client_num_per_round=8,
+                               sample_num=16)
+        ds = make_dataset(cfg)
+        mesh = make_mesh(8)
+        stream = TimeStepStream(ds, mesh)
+        steps = list(stream.steps())
+        assert len(steps) == ds.num_steps + 1
+        for t, (x_t, y_t) in enumerate(steps):
+            assert x_t.shape == (8, 16, *ds.feature_shape)
+            np.testing.assert_array_equal(np.asarray(y_t), ds.y[:, t])
+            # one client shard per device
+            assert len(x_t.sharding.device_set) == 8
+
+        # a consumer can run the eval program directly on streamed slices
+        from feddrift_tpu.core.pool import ModelPool
+        from feddrift_tpu.core.step import TrainStep, make_optimizer
+        from feddrift_tpu.models import create_model
+        module = create_model("fnn", ds, cfg)
+        pool = ModelPool.create(module, jnp.asarray(ds.x[0, 0, :2]), 2, seed=0)
+        step = TrainStep(pool.apply, make_optimizer("adam", 0.01, 0.0),
+                         8, 1, ds.num_classes)
+        fm = jnp.ones((2, *ds.feature_shape), jnp.float32)
+        for x_t, y_t in stream.steps(stop=2):
+            correct, _, total = step.acc_matrix(pool.params, x_t, y_t, fm)
+            assert correct.shape == (2, 8)
