@@ -78,6 +78,11 @@ class DriftAlgorithm:
     # output (CFL-family gradient clustering); everyone else lets the round
     # program drop that buffer (TrainStep.train_round keep_client_params).
     needs_client_params = False
+    # True if the algorithm's training window is exactly the current time
+    # step (time_w zero elsewhere) and it never reads the bound full dataset
+    # (acc_matrix_at / acc_cells_upto) — the precondition for cfg.stream_data
+    # host-streaming execution. Instance attribute where spec-dependent.
+    supports_streaming = False
 
     def __init__(self, cfg, ds, pool, step) -> None:
         self.cfg = cfg
@@ -106,6 +111,8 @@ class DriftAlgorithm:
     def acc_matrix_at(self, t: int, feat_mask=None) -> np.ndarray:
         """[M, C] accuracy of every model on every client's step-t data
         (reference train_acc_matrix, FedAvgEnsDataLoader.py:1074-1085)."""
+        assert self.x is not None, \
+            "full-dataset eval is unavailable under cfg.stream_data"
         fm = feat_mask if feat_mask is not None else self._ones_feat_mask
         correct, _, total = self.step.acc_matrix(
             self.pool.params, self.x[:, t], self.y[:, t], fm)
@@ -117,6 +124,8 @@ class DriftAlgorithm:
         Evaluates the full [T1] axis (static shape -> one compile) and slices
         on host; the extra cells are cheap relative to a recompilation per t.
         """
+        assert self.x is not None, \
+            "full-dataset eval is unavailable under cfg.stream_data"
         fm = feat_mask if feat_mask is not None else self._ones_feat_mask
         correct = self.step.acc_cells(self.pool.params, self.x, self.y, fm)
         return np.asarray(correct)[:, :self.C, : t + 1]

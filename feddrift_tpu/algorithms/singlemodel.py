@@ -31,6 +31,8 @@ class WindowBaseline(DriftAlgorithm):
             spec = cfg.concept_drift_algo
         self.spec = spec
         self._tw = None
+        # win-1 trains on the current step only -> streamable
+        self.supports_streaming = spec == "win-1"
 
     def begin_iteration(self, t: int) -> None:
         w = time_weights(self.spec, self.C, t, self.T1)      # [C, T1]

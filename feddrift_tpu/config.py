@@ -84,6 +84,13 @@ class ExperimentConfig:
 
     # --- TPU execution ---------------------------------------------------
     mesh_shape: dict[str, int] = field(default_factory=dict)  # e.g. {"clients": 8}
+    # Stream data from host instead of keeping the full [C, T1, N, ...]
+    # simulation device-resident: a [C, 2, N, ...] window (current + next
+    # step) is consumed per iteration, prefetched one iteration ahead — at
+    # most ~3 such windows exist transiently in HBM (held / staged / in
+    # flight; data/prefetch.py). Requires an algorithm whose training window
+    # is the current step only (win-1 family, supports_streaming trait).
+    stream_data: bool = False
     out_dir: str = "./runs"
     checkpoint_every_iteration: bool = True
 

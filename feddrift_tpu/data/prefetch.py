@@ -49,25 +49,43 @@ def prefetch_to_device(it: Iterable[Any], size: int = 2,
         raise ValueError(f"size must be >= 1, got {size}")
     put = place if place is not None else jax.device_put
     buf: queue.Queue = queue.Queue(maxsize=size)
+    stop = threading.Event()
+
+    def bounded_put(item) -> bool:
+        """Put unless the consumer closed the iterator; True if delivered."""
+        while not stop.is_set():
+            try:
+                buf.put(item, timeout=0.2)
+                return True
+            except queue.Full:
+                continue
+        return False
 
     def producer() -> None:
         try:
             for item in it:
-                buf.put(put(item))
+                if stop.is_set() or not bounded_put(put(item)):
+                    return
         except BaseException as e:           # noqa: BLE001 — re-raised below
-            buf.put(e)
+            bounded_put(e)
             return
-        buf.put(_END)
+        bounded_put(_END)
 
     threading.Thread(target=producer, daemon=True).start()
 
-    while True:
-        item = buf.get()
-        if isinstance(item, _End):
-            return
-        if isinstance(item, BaseException):
-            raise item
-        yield item
+    # Generator close() (or abandonment) sets the stop event via the finally
+    # below, so the producer exits instead of blocking forever with staged
+    # device buffers pinned.
+    try:
+        while True:
+            item = buf.get()
+            if isinstance(item, _End):
+                return
+            if isinstance(item, BaseException):
+                raise item
+            yield item
+    finally:
+        stop.set()
 
 
 class TimeStepStream:

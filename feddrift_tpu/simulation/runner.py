@@ -82,9 +82,22 @@ class Experiment:
         if pad:
             x_np = np.concatenate([x_np, np.repeat(x_np[:1], pad, 0)], axis=0)
             y_np = np.concatenate([y_np, np.repeat(y_np[:1], pad, 0)], axis=0)
-        self.x = shard_client_arrays(self.mesh, jnp.asarray(x_np))
-        self.y = shard_client_arrays(self.mesh, jnp.asarray(y_np))
+        if cfg.stream_data:
+            # host-resident: only a [C, 2, N, ...] window (current + next
+            # step) is staged into HBM per iteration (data/prefetch.py)
+            self._x_host, self._y_host = x_np, y_np
+            self.x = self.y = None
+            self._view_iter = None
+            self._view_next_t = -1
+        else:
+            self.x = shard_client_arrays(self.mesh, jnp.asarray(x_np))
+            self.y = shard_client_arrays(self.mesh, jnp.asarray(y_np))
         self.algo = make_algorithm(cfg, self.ds, self.pool, self.step)
+        if cfg.stream_data and not self.algo.supports_streaming:
+            raise ValueError(
+                f"stream_data requires a current-step-window algorithm "
+                f"(supports_streaming); {cfg.concept_drift_algo!r} trains on "
+                f"past steps or reads the full dataset")
         self.logger = MetricsLogger(out_dir, use_wandb)
         self.algo.bind(self.x, self.y, self.logger, self.C_pad)
         from feddrift_tpu.platform.faults import FailureDetector, FaultInjector
@@ -244,7 +257,13 @@ class Experiment:
         opt_states = self.step.init_opt_states(
             self.pool.params, self.pool.num_models, self.C_pad)
 
-        if (cfg.chunk_rounds and self.algo.chunkable(t)
+        if cfg.stream_data:
+            if not (self.algo.chunkable(t)
+                    and self.algo.ensemble_spec(t) is None):
+                raise ValueError("stream_data requires a chunkable algorithm "
+                                 "with a non-ensemble test path")
+            self._run_iteration_fused(t, opt_states, stream=True)
+        elif (cfg.chunk_rounds and self.algo.chunkable(t)
                 and self.algo.ensemble_spec(t) is None):
             self._run_iteration_fused(t, opt_states)
         else:
@@ -335,27 +354,76 @@ class Experiment:
                     self.evaluate(t, r)
             self.global_round += 1
 
-    def _run_iteration_fused(self, t: int, opt_states) -> None:
+    def _stream_view(self, t: int):
+        """Device view [C_pad, 2, N, ...] of steps (t, t+1), prefetched one
+        iteration ahead by a background thread while the device trains t-1."""
+        from feddrift_tpu.data.prefetch import prefetch_to_device
+
+        if self._view_iter is None or self._view_next_t != t:
+            if self._view_iter is not None:
+                self._view_iter.close()   # release the old producer's buffers
+
+            def host_views(t0=t):
+                for tt in range(t0, self.cfg.train_iterations):
+                    # contiguous zero-copy host views; the device put copies
+                    yield (self._x_host[:, tt:tt + 2],
+                           self._y_host[:, tt:tt + 2])
+
+            def place(xy):
+                return (shard_client_arrays(self.mesh, jnp.asarray(xy[0])),
+                        shard_client_arrays(self.mesh, jnp.asarray(xy[1])))
+
+            # size=1: consumer holds window t while t+1 is staged (plus at
+            # most one more in flight on the producer thread)
+            self._view_iter = prefetch_to_device(host_views(), size=1,
+                                                 place=place)
+            self._view_next_t = t
+        self._view_next_t += 1
+        return next(self._view_iter)
+
+    def _run_iteration_fused(self, t: int, opt_states,
+                             stream: bool = False) -> None:
         """ALL rounds of the time step + every scheduled eval as ONE device
         program (TrainStep.train_iteration_eval): a single dispatch and a
         single bulk D2H fetch per time step. On tunneled TPU links this is
         ~E× fewer round trips than the per-chunk path. Entered only for
         chunkable algorithms with a non-ensemble test path; trajectories are
         bitwise-identical to both other paths (same fold_in keys, same eval
-        cadence)."""
+        cadence).
+
+        ``stream=True`` swaps the device-resident dataset for a [C, 2, N]
+        window of steps (t, t+1): the local time axis is (current, test), so
+        the program runs with t_idx 0 and a 2-slot weight tensor. Batches are
+        identical to resident execution — the weighted step draw degenerates
+        to the single nonzero slot and the within-step slot draw uses the
+        same key — so trajectories stay bitwise-identical.
+        """
         cfg = self.cfg
         R, freq = cfg.comm_round, cfg.frequency_of_the_test
         it_key = iteration_key(self.key, t)
         tw, sw, fm, lr_scale = self.algo.round_inputs(t, 0)
         tw = self._pad_clients(tw)
         sw = self._pad_clients(sw, value=1.0)
+        if stream:
+            tw_np = np.asarray(tw)
+            if np.delete(tw_np, t, axis=2).any():
+                raise ValueError("stream_data: algorithm weights reference "
+                                 "steps other than the current one")
+            tw2 = np.zeros((*tw_np.shape[:2], 2), dtype=tw_np.dtype)
+            tw2[:, :, 0] = tw_np[:, :, t]
+            tw = jnp.asarray(tw2)
+            x, y = self._stream_view(t)
+            t_idx = 0
+        else:
+            x, y = self.x, self.y
+            t_idx = t
         g0 = self.global_round
         cms = self._client_masks(t, range(R))
         with self.tracer.phase("train_round"):
             new_params, opt_states, n, losses, bufs, total = \
                 self.step.train_iteration_eval(
-                    self.pool.params, opt_states, it_key, self.x, self.y,
-                    tw, sw, fm, lr_scale, R, freq, jnp.int32(t),
+                    self.pool.params, opt_states, it_key, x, y,
+                    tw, sw, fm, lr_scale, R, freq, jnp.int32(t_idx),
                     None if cms is None else jnp.asarray(cms))
             if cfg.trace_sync:
                 jax.block_until_ready(new_params)
