@@ -62,9 +62,23 @@ def _probe_backend(timeout_s: float = 90.0) -> str:
     return "cpu-fallback"
 
 
+def _enable_compile_cache() -> None:
+    """Persist compiled executables across processes (~20-40s saved per
+    program on repeat benchmark runs; cache is keyed by platform + HLO)."""
+    import os
+    d = os.path.join(os.path.dirname(os.path.abspath(__file__)), ".jax_cache")
+    try:
+        jax.config.update("jax_compilation_cache_dir", d)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except Exception as e:                   # cache is an optimization only
+        print(json.dumps({"warning": f"compile cache unavailable: {e}"}),
+              file=sys.stderr)
+
+
 def main() -> None:
     smoke = "--smoke" in sys.argv
     backend = _probe_backend()
+    _enable_compile_cache()
 
     from feddrift_tpu.config import ExperimentConfig
     from feddrift_tpu.simulation.runner import Experiment

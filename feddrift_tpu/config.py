@@ -91,6 +91,9 @@ class ExperimentConfig:
     # flight; data/prefetch.py). Requires an algorithm whose training window
     # is the current step only (win-1 family, supports_streaming trait).
     stream_data: bool = False
+    # Debug mode: validate round-input invariants every iteration and raise
+    # inside the op that produces a NaN (utils/invariants.py).
+    debug_checks: bool = False
     out_dir: str = "./runs"
     checkpoint_every_iteration: bool = True
 

@@ -112,6 +112,9 @@ class Experiment:
         self.start_iteration = 0
         self.out_dir = out_dir
         self.tracer = PhaseTracer()
+        if cfg.debug_checks:
+            from feddrift_tpu.utils.invariants import enable_nan_debugging
+            enable_nan_debugging()
 
     def _make_apply(self):
         """Forward fn honoring cfg.compute_dtype.
@@ -254,6 +257,13 @@ class Experiment:
         t0 = time.time()
         with self.tracer.phase("cluster"):   # drift detection / clustering
             self.algo.begin_iteration(t)
+        if cfg.debug_checks:
+            from feddrift_tpu.utils.invariants import check_round_inputs
+            tw, sw, fm, _ = self.algo.round_inputs(t, 0)
+            check_round_inputs(
+                tw, sw, fm, num_models=self.pool.num_models,
+                num_clients=self.C_, num_steps_p1=self.ds.num_steps + 1,
+                sample_num=self.ds.samples_per_step)
         opt_states = self.step.init_opt_states(
             self.pool.params, self.pool.num_models, self.C_pad)
 

@@ -1,0 +1,67 @@
+"""Debug-mode invariant checks — the sanitizer analog for this framework.
+
+The reference has no race detection or sanitizers (SURVEY.md §5: its comm
+layer kills threads via ctypes); a single-program SPMD design has no data
+races to detect, so the failure modes worth guarding are *numerical and
+shape* invariants of the tensors that drive the round program. Enabled by
+``cfg.debug_checks``: the runner validates every iteration's round inputs
+here and turns on jax_debug_nans so a NaN raises inside the producing op
+instead of corrupting a whole trajectory silently.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+class InvariantError(AssertionError):
+    pass
+
+
+def _fail(msg: str) -> None:
+    raise InvariantError(msg)
+
+
+def check_round_inputs(tw, sw, fm, *, num_models: int, num_clients: int,
+                       num_steps_p1: int, sample_num: int) -> None:
+    """Validate (time_w, sample_w, feat_mask) for one round/iteration.
+
+    tw: [M, C, T1] — finite, nonnegative, at least one active (m, c) pair.
+    sw: [M, C, N]  — finite, nonnegative.
+    fm: [M, F...]  — finite.
+    """
+    tw = np.asarray(tw)
+    sw = np.asarray(sw)
+    fm = np.asarray(fm)
+    M, C, T1, N = num_models, num_clients, num_steps_p1, sample_num
+    if tw.shape != (M, C, T1):
+        _fail(f"time_w shape {tw.shape} != {(M, C, T1)}")
+    if sw.shape != (M, C, N):
+        _fail(f"sample_w shape {sw.shape} != {(M, C, N)}")
+    if fm.shape[0] != M:
+        _fail(f"feat_mask leading axis {fm.shape[0]} != M={M}")
+    for name, a in (("time_w", tw), ("sample_w", sw), ("feat_mask", fm)):
+        if not np.isfinite(a).all():
+            _fail(f"{name} contains non-finite values")
+    if (tw < 0).any():
+        _fail("time_w has negative weights")
+    if (sw < 0).any():
+        _fail("sample_w has negative weights")
+    if tw.sum() == 0:
+        _fail("time_w is all-zero: no (model, client) pair would train")
+
+
+def check_weight_partition(weights_tmc: np.ndarray, t: int,
+                           atol: float = 1e-5) -> None:
+    """SoftCluster invariant: at step t the per-client weights over models
+    sum to 1 (cluster assignment is a distribution; FedAvgEnsDataLoader.py
+    weight semantics)."""
+    w = np.asarray(weights_tmc)[t]          # [M, C]
+    col = w.sum(axis=0)
+    if not np.allclose(col, 1.0, atol=atol):
+        _fail(f"cluster weights at t={t} do not partition: {col}")
+
+
+def enable_nan_debugging() -> None:
+    import jax
+    jax.config.update("jax_debug_nans", True)

@@ -1,0 +1,61 @@
+"""Debug-mode invariant checks (utils/invariants.py, cfg.debug_checks)."""
+
+import numpy as np
+import pytest
+
+from feddrift_tpu.utils.invariants import (InvariantError,
+                                           check_round_inputs,
+                                           check_weight_partition)
+
+
+class TestCheckRoundInputs:
+    def _ok(self):
+        M, C, T1, N = 2, 3, 4, 8
+        return (np.ones((M, C, T1), np.float32),
+                np.ones((M, C, N), np.float32),
+                np.ones((M, 5), np.float32),
+                dict(num_models=M, num_clients=C, num_steps_p1=T1,
+                     sample_num=N))
+
+    def test_accepts_valid(self):
+        tw, sw, fm, kw = self._ok()
+        check_round_inputs(tw, sw, fm, **kw)
+
+    @pytest.mark.parametrize("mutation,match", [
+        (lambda tw, sw, fm: (tw[:, :, :2], sw, fm), "time_w shape"),
+        (lambda tw, sw, fm: (tw, sw[:1], fm), "sample_w shape"),
+        (lambda tw, sw, fm: (tw * np.nan, sw, fm), "non-finite"),
+        (lambda tw, sw, fm: (tw - 2.0, sw, fm), "negative"),
+        (lambda tw, sw, fm: (tw * 0.0, sw, fm), "all-zero"),
+    ])
+    def test_rejects_invalid(self, mutation, match):
+        tw, sw, fm, kw = self._ok()
+        with pytest.raises(InvariantError, match=match):
+            check_round_inputs(*mutation(tw, sw, fm), **kw)
+
+
+class TestWeightPartition:
+    def test_partition_holds_in_softcluster_run(self):
+        w = np.zeros((3, 2, 4), np.float32)
+        w[1, 0, :] = 0.3
+        w[1, 1, :] = 0.7
+        check_weight_partition(w, 1)
+        with pytest.raises(InvariantError):
+            check_weight_partition(w, 0)
+
+    def test_e2e_with_debug_checks(self):
+        from feddrift_tpu.config import ExperimentConfig
+        from feddrift_tpu.simulation.runner import run_experiment
+        cfg = ExperimentConfig(dataset="sea", model="fnn",
+                               concept_drift_algo="softcluster",
+                               concept_drift_algo_arg="H_A_C_1_10_0",
+                               concept_num=3, change_points="A",
+                               client_num_in_total=10, client_num_per_round=10,
+                               train_iterations=2, comm_round=4, epochs=2,
+                               batch_size=32, sample_num=32,
+                               frequency_of_the_test=2, debug_checks=True)
+        exp = run_experiment(cfg)
+        assert exp.logger.last("Test/Acc") is not None
+        # restore global flag for the rest of the suite
+        import jax
+        jax.config.update("jax_debug_nans", False)
