@@ -146,6 +146,12 @@ class SoftCluster(DriftAlgorithm):
             for c in range(self.C):
                 self.mmacc_acc[c] = acc[idx[c], c]
         self._log_models(t)
+        if self.cfg.debug_checks and self.kind not in ("softmax", "gmm"):
+            # hard-assignment variants: per-client weights at t must be a
+            # one-hot partition (softmax/gmm produce fractional assignments
+            # validated by their own normalization)
+            from feddrift_tpu.utils.invariants import check_weight_partition
+            check_weight_partition(self.weights, t)
         self._sync_device_weights()
 
     def after_round(self, t: int, r: int, prev_params, agg_params,
