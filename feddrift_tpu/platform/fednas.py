@@ -40,23 +40,44 @@ def make_loss(apply_fn: Callable):
     return loss_fn
 
 
-@partial(jax.jit, static_argnames=("apply_fn", "steps"))
+@partial(jax.jit, static_argnames=("apply_fn", "steps", "second_order"))
 def client_search_round(apply_fn, params_stack, weight_mask, arch_mask,
                         x_train, y_train, x_search, y_search,
-                        w_lr: float, arch_lr: float, steps: int = 1):
+                        w_lr: float, arch_lr: float, steps: int = 1,
+                        second_order: bool = False):
     """One local search round for ALL clients at once.
 
     params_stack: [C, ...] pytree (each client's copy of the DARTS net);
     x_train/y_train, x_search/y_search: [C, B, ...] local splits
     (FedNASTrainer holds separate train/search loaders). Returns
     (new params_stack, [C] train loss after the round).
+
+    ``second_order`` selects the unrolled DARTS architecture gradient
+    (the reference's ``architect.py`` "unrolled" path): the alphas gradient
+    of the search loss evaluated at the *virtually updated* weights
+    ``w' = w - xi * grad_w L_train``. The reference approximates the
+    second-order term with finite differences (architect.py's
+    _hessian_vector_product); here autodiff differentiates through the
+    virtual step exactly — same mathematics, no epsilon, one extra
+    backward pass.
     """
     loss_fn = make_loss(apply_fn)
 
     def one_client(params, xt, yt, xs, ys):
+        def arch_grads_first(p):
+            return jax.grad(loss_fn)(p, xs, ys)
+
+        def arch_grads_unrolled(p):
+            def unrolled(q):
+                w_grads = jax.grad(loss_fn)(q, xt, yt)
+                q_virtual = masked_sgd_step(q, w_grads, weight_mask, w_lr)
+                return loss_fn(q_virtual, xs, ys)
+            return jax.grad(unrolled)(p)
+
         def body(p, _):
-            # first-order DARTS: alphas step on the search split...
-            a_grads = jax.grad(loss_fn)(p, xs, ys)
+            # alphas step on the search split...
+            a_grads = (arch_grads_unrolled(p) if second_order
+                       else arch_grads_first(p))
             p = masked_sgd_step(p, a_grads, arch_mask, arch_lr)
             # ...then weights step on the train split
             w_grads = jax.grad(loss_fn)(p, xt, yt)
@@ -99,14 +120,19 @@ class FedNAS:
 
     def __init__(self, module, sample_input, num_clients: int,
                  w_lr: float = 0.025, arch_lr: float = 3e-4,
-                 local_steps: int = 1, seed: int = 0) -> None:
+                 local_steps: int = 1, seed: int = 0,
+                 arch_search: str = "first_order") -> None:
         from feddrift_tpu.models.darts import split_arch_params
+        if arch_search not in ("first_order", "second_order"):
+            raise ValueError(f"arch_search must be first_order|second_order, "
+                             f"got {arch_search!r}")
         self.module = module
         params = module.init(jax.random.PRNGKey(seed), sample_input)["params"]
         self.params = params
         self.weight_mask, self.arch_mask = split_arch_params(params)
         self.C = num_clients
         self.w_lr, self.arch_lr, self.local_steps = w_lr, arch_lr, local_steps
+        self.second_order = arch_search == "second_order"
         self.apply_fn = lambda p, x: module.apply({"params": p}, x)
 
     def round(self, x_train, y_train, x_search, y_search, n):
@@ -116,7 +142,8 @@ class FedNAS:
         stack, losses = client_search_round(
             self.apply_fn, stack, self.weight_mask, self.arch_mask,
             x_train, y_train, x_search, y_search,
-            self.w_lr, self.arch_lr, self.local_steps)
+            self.w_lr, self.arch_lr, self.local_steps,
+            second_order=self.second_order)
         self.params = aggregate_search(stack, n)
         return losses
 

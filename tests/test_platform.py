@@ -218,3 +218,34 @@ class TestFedNAS:
         assert len(arch) > 0  # discrete genotype extracted
         for v in arch.values():
             assert 0 <= v < 5
+
+    def test_second_order_unrolled_search(self):
+        from feddrift_tpu.models.darts import DARTSNetwork, split_arch_params
+        from feddrift_tpu.platform.fednas import FedNAS
+        C, B = 2, 4
+        net = DARTSNetwork(num_classes=3, filters=4, cells=1, nodes=2)
+        rng = np.random.default_rng(0)
+        x = jnp.asarray(rng.normal(size=(C, B, 8, 8, 3)).astype(np.float32))
+        y = jnp.asarray(rng.integers(0, 3, size=(C, B)).astype(np.int32))
+        n = jnp.ones((C,), jnp.float32)
+
+        first = FedNAS(net, x[0, :1], C, local_steps=1, w_lr=0.1, arch_lr=0.1)
+        second = FedNAS(net, x[0, :1], C, local_steps=1, w_lr=0.1,
+                        arch_lr=0.1, arch_search="second_order")
+        p1, _, l1 = first.search(2, x, y, x, y, n)
+        p2, _, l2 = second.search(2, x, y, x, y, n)
+        assert np.isfinite(np.asarray(l2)).all()
+        # the unrolled arch gradient must differ from first-order on alphas
+        _, arch_mask = split_arch_params(p1)
+        diffs = [np.abs(np.asarray(a) - np.asarray(b)).max()
+                 for a, b, m in zip(jax.tree_util.tree_leaves(p1),
+                                    jax.tree_util.tree_leaves(p2),
+                                    jax.tree_util.tree_leaves(arch_mask)) if m]
+        assert max(diffs) > 0
+
+    def test_invalid_arch_search_rejected(self):
+        from feddrift_tpu.models.darts import DARTSNetwork
+        from feddrift_tpu.platform.fednas import FedNAS
+        net = DARTSNetwork(num_classes=3, filters=4, cells=1, nodes=2)
+        with pytest.raises(ValueError, match="arch_search"):
+            FedNAS(net, jnp.zeros((1, 8, 8, 3)), 2, arch_search="nope")
