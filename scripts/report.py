@@ -1,0 +1,91 @@
+"""Summarize experiment runs from their metrics.jsonl files.
+
+The reference's results live in wandb dashboards; here every run writes
+``<out_dir>/metrics.jsonl`` (utils/metrics.py) and this tool renders the
+cross-run comparison table those dashboards answered: final/best Test/Acc
+per run, with per-iteration trajectories on request.
+
+    python scripts/report.py runs/                # all runs under a dir
+    python scripts/report.py runs/sea-* --traj    # with trajectories
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+
+
+def load_run(path: str) -> dict | None:
+    mfile = os.path.join(path, "metrics.jsonl")
+    if not os.path.isfile(mfile):
+        return None
+    records = []
+    with open(mfile) as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                records.append(json.loads(line))
+    if not records:
+        return None
+    test = [(r.get("iteration", 0), r.get("round", 0), r["Test/Acc"])
+            for r in records if "Test/Acc" in r]
+    if not test:
+        return None
+    per_iter: dict[int, float] = {}
+    for it, _, acc in test:
+        per_iter[it] = acc                      # last eval point of each step
+    return {
+        "name": os.path.basename(os.path.normpath(path)),
+        "final": test[-1][2],
+        "best": max(a for _, _, a in test),
+        "mean_final_per_iter": sum(per_iter.values()) / len(per_iter),
+        "iterations": len(per_iter),
+        "rounds": test[-1][1] + 1,
+        "trajectory": [per_iter[k] for k in sorted(per_iter)],
+    }
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("paths", nargs="+",
+                    help="run directories, or a parent directory of runs")
+    ap.add_argument("--traj", action="store_true",
+                    help="include per-iteration Test/Acc trajectories")
+    ap.add_argument("--json", action="store_true", help="machine-readable")
+    args = ap.parse_args(argv)
+
+    dirs: list[str] = []
+    for p in args.paths:
+        for q in sorted(glob.glob(p)) or [p]:
+            if os.path.isfile(os.path.join(q, "metrics.jsonl")):
+                dirs.append(q)
+            elif os.path.isdir(q):
+                dirs.extend(sorted(
+                    d for d in glob.glob(os.path.join(q, "*"))
+                    if os.path.isfile(os.path.join(d, "metrics.jsonl"))))
+    runs = [r for r in (load_run(d) for d in dict.fromkeys(dirs)) if r]
+    if not runs:
+        print("no runs with metrics.jsonl found", file=sys.stderr)
+        return 1
+
+    if args.json:
+        print(json.dumps(runs, indent=2))
+        return 0
+
+    w = max(len(r["name"]) for r in runs)
+    print(f"| {'run':<{w}} | final | best  | mean/iter | iters | rounds |")
+    print(f"|{'-' * (w + 2)}|-------|-------|-----------|-------|--------|")
+    for r in sorted(runs, key=lambda r: -r["final"]):
+        print(f"| {r['name']:<{w}} | {r['final']:.3f} | {r['best']:.3f} "
+              f"| {r['mean_final_per_iter']:^9.3f} | {r['iterations']:^5} "
+              f"| {r['rounds']:^6} |")
+        if args.traj:
+            print(f"|   {'Test/Acc per iter: ' + ', '.join(f'{a:.3f}' for a in r['trajectory']):<{w + 35}}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
