@@ -23,7 +23,6 @@ import sys
 import time
 
 import jax
-import jax.numpy as jnp
 
 REFERENCE_ROUNDS_PER_SEC = 1.0  # generous estimate; see module docstring
 

@@ -10,7 +10,6 @@ with the Vanilla single-model aggregator FedAvgEnsAggregatorVanilla.py:14).
 from __future__ import annotations
 
 import jax.numpy as jnp
-import numpy as np
 
 from feddrift_tpu.algorithms.base import DriftAlgorithm, register_algorithm
 from feddrift_tpu.data.retrain import time_weights

@@ -9,7 +9,6 @@ sentinel, so idle endpoints cost nothing and shutdown is race-free.
 from __future__ import annotations
 
 import abc
-from typing import Any
 
 from feddrift_tpu.comm.message import Message
 

@@ -10,7 +10,6 @@ better conditioning (documented deviation).
 from __future__ import annotations
 
 import flax.linen as nn
-import jax.numpy as jnp
 
 
 def _to_nhwc(x, side: int = 28, channels: int = 1):

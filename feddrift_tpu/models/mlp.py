@@ -4,7 +4,6 @@ fedml_api/model/fnn/fnn.py)."""
 from __future__ import annotations
 
 import flax.linen as nn
-import jax.numpy as jnp
 
 
 class LogisticRegression(nn.Module):

@@ -12,7 +12,6 @@ faithful to federated practice.
 
 from __future__ import annotations
 
-from functools import partial
 
 import flax.linen as nn
 import jax.numpy as jnp

@@ -22,7 +22,6 @@ position offsets derived from ``lax.axis_index``.
 
 from __future__ import annotations
 
-from functools import partial
 
 import jax
 import jax.numpy as jnp

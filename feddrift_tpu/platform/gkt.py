@@ -17,7 +17,6 @@ from functools import partial
 from typing import Callable
 
 import jax
-import jax.numpy as jnp
 import optax
 
 from feddrift_tpu.core.functional import cross_entropy

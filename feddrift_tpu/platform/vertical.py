@@ -19,7 +19,6 @@ from functools import partial
 from typing import Callable, Sequence
 
 import jax
-import jax.numpy as jnp
 import optax
 
 

@@ -34,7 +34,7 @@ from feddrift_tpu.core.pool import ModelPool
 from feddrift_tpu.core.step import TrainStep, make_optimizer
 from feddrift_tpu.data.registry import make_dataset
 from feddrift_tpu.models import create_model
-from feddrift_tpu.parallel.mesh import make_mesh, shard_client_arrays, replicate
+from feddrift_tpu.parallel.mesh import make_mesh, shard_client_arrays
 from feddrift_tpu.utils.metrics import MetricsLogger
 from feddrift_tpu.utils.prng import experiment_key, iteration_key, round_key
 from feddrift_tpu.utils.tracing import PhaseTracer

@@ -43,8 +43,6 @@ def main() -> None:
     if args.virtual:
         jax.config.update("jax_platforms", "cpu")
         jax.config.update("jax_num_cpu_devices", args.virtual)
-    import jax.numpy as jnp
-    import numpy as np
 
     from feddrift_tpu.config import ExperimentConfig
     from feddrift_tpu.simulation.runner import Experiment

@@ -4,8 +4,6 @@ Mirrors the reference's reproducibility-as-testing stance (SURVEY.md §4):
 fixed seeds, assert accuracy trajectories.
 """
 
-import numpy as np
-import pytest
 
 from feddrift_tpu.config import ExperimentConfig
 from feddrift_tpu.simulation.runner import run_experiment

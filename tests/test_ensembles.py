@@ -88,7 +88,6 @@ class TestKue:
 
     def test_kappa_formula(self):
         # Perfect predictions -> kappa 1; uniform-random-ish -> ~0.
-        from feddrift_tpu.algorithms.ensembles import Kue
         A = np.eye(3) * 10.0
         n = A.sum(); left = np.trace(A)
         right = (A.sum(1) * A.sum(0)).sum()

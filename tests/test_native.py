@@ -69,7 +69,6 @@ class TestNativeGenerator:
 
     def test_e2e_training_on_native_data(self):
         from feddrift_tpu.config import ExperimentConfig
-        from feddrift_tpu.data.registry import make_dataset
         from feddrift_tpu.simulation.runner import Experiment
         import os
         os.environ["FEDDRIFT_NATIVE_DATA"] = "1"

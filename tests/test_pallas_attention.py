@@ -1,7 +1,6 @@
 """Pallas flash-attention kernel tests (interpret mode on the CPU mesh)."""
 
 import jax
-import jax.numpy as jnp
 import numpy as np
 import pytest
 
