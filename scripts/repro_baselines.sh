@@ -34,17 +34,29 @@ run --dataset MNIST --model fnn --concept_drift_algo mmacc \
     --client_num_in_total 10 --client_num_per_round 10 \
     --train_iterations 10 --comm_round 100 --epochs 5 --batch_size 128 --lr 0.01
 
-# 3. IFCA (softclusterwin-1 hard-r) on CIFAR-10 / resnet. Smoke swaps
-# hard-r -> hard: per-ROUND re-clustering costs an M x C full-data resnet
-# eval each round, which is TPU-scale work (minutes/round on host CPU).
-IFCA_ARG=hard-r; [[ -n "$SMOKE" ]] && IFCA_ARG=hard
-run --dataset cifar10 --model resnet --concept_drift_algo softclusterwin-1 \
-    --concept_drift_algo_arg "$IFCA_ARG" --concept_num 3 --change_points A \
-    --client_num_in_total 10 --client_num_per_round 10 \
-    --train_iterations 10 --comm_round 100 --epochs 5 --batch_size 64 --lr 0.05
+# 3. IFCA (softclusterwin-1 hard-r) on CIFAR-10 / resnet. The CPU smoke
+# validates the IFCA machinery on MNIST/fnn instead: ANY convolution under
+# the double-vmapped (model x client) round program is an hours-long
+# single-core XLA:CPU compile (on TPU the same program compiles in tens of
+# seconds as batched convs — run the real config there), and hard-r's
+# per-round M x C re-cluster eval is TPU-scale work. The algorithm path is
+# identical; conv forwards are covered by tests/test_models.py.
+if [[ -n "$SMOKE" ]]; then
+  run --dataset MNIST --model fnn --concept_drift_algo softclusterwin-1 \
+      --concept_drift_algo_arg hard --concept_num 3 --change_points A \
+      --client_num_in_total 10 --client_num_per_round 10 \
+      --train_iterations 10 --comm_round 100 --epochs 5 --batch_size 64 --lr 0.05
+else
+  run --dataset cifar10 --model resnet --concept_drift_algo softclusterwin-1 \
+      --concept_drift_algo_arg hard-r --concept_num 3 --change_points A \
+      --client_num_in_total 10 --client_num_per_round 10 \
+      --train_iterations 10 --comm_round 100 --epochs 5 --batch_size 64 --lr 0.05
+fi
 
-# 4. Adaptive-FedAvg on FederatedEMNIST / cnn, 100 clients
-run --dataset femnist --model cnn --concept_drift_algo ada \
+# 4. Adaptive-FedAvg on FederatedEMNIST / cnn, 100 clients (smoke: fnn,
+# same conv-compile caveat as config 3)
+C4_MODEL=cnn; [[ -n "$SMOKE" ]] && C4_MODEL=fnn
+run --dataset femnist --model "$C4_MODEL" --concept_drift_algo ada \
     --concept_drift_algo_arg win-1_iter --concept_num 2 --change_points rand \
     --client_num_in_total 100 --client_num_per_round 20 \
     --train_iterations 10 --comm_round 100 --epochs 5 --batch_size 32 --lr 0.03
