@@ -61,8 +61,22 @@ run --dataset femnist --model "$C4_MODEL" --concept_drift_algo ada \
     --client_num_in_total 100 --client_num_per_round 20 \
     --train_iterations 10 --comm_round 100 --epochs 5 --batch_size 32 --lr 0.03
 
-# 5. AUE ensemble on fed_shakespeare / rnn, 50 clients
-run --dataset fed_shakespeare --model rnn --concept_drift_algo aue \
-    --concept_num 3 --change_points rand \
-    --client_num_in_total 50 --client_num_per_round 50 \
-    --train_iterations 10 --comm_round 100 --epochs 5 --batch_size 32 --lr 0.1
+# 5. AUE ensemble on fed_shakespeare / rnn, 50 clients. The CPU smoke
+# shrinks further (4 clients, 4 rounds, window 2): the LSTM compiles
+# slowly under the double-vmapped round program on one core (fast on TPU).
+if [[ -n "$SMOKE" ]]; then
+  # direct invocation: run() appends $SMOKE last and argparse last-wins,
+  # which would undo these smaller-than-$SMOKE sizes
+  echo "=== fed_shakespeare rnn aue (smoke)"
+  python -m feddrift_tpu run --dataset fed_shakespeare --model rnn \
+      --concept_drift_algo aue --concept_num 2 --ensemble_window 2 \
+      --change_points rand --client_num_in_total 4 --client_num_per_round 4 \
+      --train_iterations 2 --comm_round 4 --epochs 2 --batch_size 16 \
+      --sample_num 32 --frequency_of_the_test 2 --lr 0.1 \
+      ${PLATFORM:+--platform "$PLATFORM"}
+else
+  run --dataset fed_shakespeare --model rnn --concept_drift_algo aue \
+      --concept_num 3 --change_points rand \
+      --client_num_in_total 50 --client_num_per_round 50 \
+      --train_iterations 10 --comm_round 100 --epochs 5 --batch_size 32 --lr 0.1
+fi
