@@ -37,6 +37,21 @@ class TestCli:
         assert main(["resume", "--out_dir",
                      os.path.join(tmp_path, run_dir)]) == 0
 
+    def test_stream_and_debug_flags(self, tmp_path, capsys):
+        # the generated bool flags drive the new execution modes end-to-end
+        args = ["--dataset", "sine", "--model", "fnn",
+                "--concept_drift_algo", "win-1", "--concept_num", "2",
+                "--client_num_in_total", "4", "--client_num_per_round", "4",
+                "--train_iterations", "2", "--comm_round", "3",
+                "--epochs", "1", "--batch_size", "16", "--sample_num", "32",
+                "--frequency_of_the_test", "2", "--out_dir", str(tmp_path),
+                "--stream_data", "true", "--debug_checks", "true"]
+        assert main(["run", *args]) == 0
+        final = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+        assert "Test/Acc" in final
+        import jax
+        jax.config.update("jax_debug_nans", False)   # restore for the suite
+
     def test_unknown_algo_fails_cleanly(self, tmp_path):
         import pytest
         with pytest.raises(KeyError, match="nope"):
