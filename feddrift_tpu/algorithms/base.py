@@ -111,8 +111,9 @@ class DriftAlgorithm:
     def acc_matrix_at(self, t: int, feat_mask=None) -> np.ndarray:
         """[M, C] accuracy of every model on every client's step-t data
         (reference train_acc_matrix, FedAvgEnsDataLoader.py:1074-1085)."""
-        assert self.x is not None, \
-            "full-dataset eval is unavailable under cfg.stream_data"
+        if self.x is None:
+            raise RuntimeError(
+                "full-dataset eval is unavailable under cfg.stream_data")
         fm = feat_mask if feat_mask is not None else self._ones_feat_mask
         correct, _, total = self.step.acc_matrix(
             self.pool.params, self.x[:, t], self.y[:, t], fm)
@@ -124,8 +125,9 @@ class DriftAlgorithm:
         Evaluates the full [T1] axis (static shape -> one compile) and slices
         on host; the extra cells are cheap relative to a recompilation per t.
         """
-        assert self.x is not None, \
-            "full-dataset eval is unavailable under cfg.stream_data"
+        if self.x is None:
+            raise RuntimeError(
+                "full-dataset eval is unavailable under cfg.stream_data")
         fm = feat_mask if feat_mask is not None else self._ones_feat_mask
         correct = self.step.acc_cells(self.pool.params, self.x, self.y, fm)
         return np.asarray(correct)[:, :self.C, : t + 1]

@@ -1,0 +1,225 @@
+"""Turbo-Aggregate: multi-group ring secure aggregation with dropout
+recovery.
+
+The reference ships the MPC toolbox (fedml_api/distributed/turboaggregate/
+mpc_function.py) and a TurboAggregate scaffold whose aggregator is plain
+FedAvg (TA_Aggregator.py:56-85) with a topology-driven decentralized worker
+(TA_decentralized_worker.py:4-29); the actual secure ring protocol of the
+Turbo-Aggregate paper (So, Guler, Avestimehr, IEEE JSAIT'21) is left
+unimplemented.  Here we implement the protocol itself on top of the
+vectorised field primitives in `platform.secure_agg`:
+
+* Clients are partitioned into L groups arranged in a ring; aggregation
+  flows around the ring one group per stage (the paper's multi-group
+  circular strategy).
+* Privacy: each client's quantized model is degree-T Shamir-shared across
+  the n positions of the next group (`bgw_encode`).  A single share reveals
+  nothing; any T colluding receivers learn nothing (the paper separates an
+  additive zero-mask from Lagrange redundancy; Shamir sharing provides both
+  the masking and the redundancy in one object, which is the natural
+  formulation when shares are Vandermonde matmuls — see
+  secure_agg.bgw_encode).
+* Dropout recovery: the running partial aggregate exists only as n
+  per-position shares.  Positions held by dropped clients are reconstructed
+  by the next group via Lagrange interpolation over >= T+1 surviving
+  positions (`gen_lagrange_coeffs`), exactly the paper's coded-redundancy
+  role.  Up to n - T - 1 dropouts per group are tolerated.
+* A client that drops before its group's send stage contributes nothing
+  (matching the paper: its data never entered the ring); a client that
+  drops after sending is still counted, and a dropped *relay* never blocks
+  the ring.
+
+Everything is host-side numpy int64 field math: the vectors being
+aggregated are model deltas that live on host between rounds anyway
+(cf. `simulation/runner.py`), and the field ops are O(C * d) — far below
+the device math they protect.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from .secure_agg import (
+    P_DEFAULT,
+    bgw_decode,
+    bgw_encode,
+    gen_lagrange_coeffs,
+    _matmul_mod,
+    quantize,
+    dequantize,
+)
+
+
+@dataclass
+class RingConfig:
+    """Protocol parameters.
+
+    num_clients: total population C.
+    group_size:  n, positions per group (ring stage width).
+    privacy_t:   T, max colluding receivers learning nothing; also the
+                 reconstruction threshold (need T+1 alive per group).
+    scale:       fixed-point quantization scale.
+    """
+
+    num_clients: int
+    group_size: int = 4
+    privacy_t: int = 1
+    scale: int = 2 ** 16
+    p: np.int64 = field(default_factory=lambda: P_DEFAULT)
+
+    def __post_init__(self) -> None:
+        if self.group_size < self.privacy_t + 2:
+            raise ValueError(
+                f"group_size={self.group_size} must exceed privacy_t+1="
+                f"{self.privacy_t + 1} to tolerate any dropout")
+        if self.num_clients < 1:
+            raise ValueError("need at least one client")
+
+    @property
+    def num_groups(self) -> int:
+        # The remainder folds into the LAST group (its extra members are
+        # contributors without relay duty) so every relay stage has a full
+        # n occupied positions — a ragged tail group smaller than T+1
+        # would otherwise make reconstruction impossible with no dropouts
+        # at all.
+        return max(1, self.num_clients // self.group_size)
+
+    def group_members(self, g: int) -> range:
+        lo = g * self.group_size
+        hi = (self.num_clients if g == self.num_groups - 1
+              else lo + self.group_size)
+        return range(lo, hi)
+
+
+class TurboAggregateRing:
+    """Simulates the full ring protocol over a client population.
+
+    `aggregate(vectors, dropped)` returns (sum_of_contributors,
+    contributor_ids).  `dropped` maps client id -> stage at which it died:
+    ``"before_send"`` (its data never enters; excluded from the sum) or
+    ``"after_send"`` (its shares are already out; included, and its relay
+    duties are recovered by the next group).
+    """
+
+    def __init__(self, cfg: RingConfig,
+                 rng: np.random.Generator | None = None) -> None:
+        self.cfg = cfg
+        self.rng = rng or np.random.default_rng(0)
+
+    # -- share-plane helpers -------------------------------------------
+    def _reconstruct_positions(self, shares: np.ndarray,
+                               alive: np.ndarray) -> np.ndarray:
+        """Fill dead positions of the [n, d] share vector by Lagrange
+        interpolation from alive ones (the coded-recovery step).  The
+        share polynomial has degree <= T, so any T+1 alive positions
+        determine it everywhere."""
+        cfg = self.cfg
+        alive_idx = np.flatnonzero(alive)
+        dead_idx = np.flatnonzero(~alive)
+        if dead_idx.size == 0:
+            return shares
+        if alive_idx.size < cfg.privacy_t + 1:
+            raise RuntimeError(
+                f"unrecoverable stage: {alive_idx.size} alive positions "
+                f"< T+1={cfg.privacy_t + 1}")
+        alpha_dead = (dead_idx + 1).astype(np.int64)
+        # Interpolate only through T+1 alive points: the polynomial has
+        # degree <= T, so more points are redundant (and using exactly
+        # T+1 keeps the Lagrange system square, as bgw_decode does).
+        use = alive_idx[: cfg.privacy_t + 1]
+        lam = gen_lagrange_coeffs(alpha_dead,
+                                  (use + 1).astype(np.int64), cfg.p)
+        out = shares.copy()
+        out[dead_idx] = _matmul_mod(lam, shares[use], cfg.p)
+        return out
+
+    # -- the protocol ---------------------------------------------------
+    def aggregate(self, vectors: np.ndarray,
+                  dropped: dict[int, str] | None = None
+                  ) -> tuple[np.ndarray, list[int]]:
+        cfg = self.cfg
+        dropped = dropped or {}
+        for cid, stage in dropped.items():
+            if stage not in ("before_send", "after_send"):
+                raise ValueError(f"unknown dropout stage {stage!r}")
+            if not 0 <= cid < cfg.num_clients:
+                raise ValueError(f"unknown client {cid}")
+        vectors = np.asarray(vectors, np.float64)
+        if vectors.shape[0] != cfg.num_clients:
+            raise ValueError(vectors.shape)
+        d = vectors.shape[1]
+        n = cfg.group_size
+
+        # Running aggregate exists only as [n, d] position shares.
+        s = np.zeros((n, d), dtype=np.int64)
+        contributors: list[int] = []
+
+        for g in range(cfg.num_groups):
+            members = list(cfg.group_members(g))
+            if g > 0:
+                # Handoff into this stage: the running-sum share s_j is
+                # held (and forwarded) by this group's position-j member;
+                # dead positions are reconstructed from the survivors
+                # (coded recovery), so the ring never stalls.  Group 0
+                # needs no handoff — it holds only the known zero state,
+                # which is why dropouts there can never be
+                # "unrecoverable": its members relay no secret state.
+                alive_relay = np.array(
+                    [pos < len(members) and members[pos] not in dropped
+                     for pos in range(n)])
+                s = self._reconstruct_positions(s, alive_relay)
+            # Contributions: every member alive at send time Shamir-shares
+            # its quantized vector to the n positions of the next stage
+            # (extra members of a folded tail group contribute here even
+            # though they hold no relay position).  One batched encode per
+            # group: bgw_encode vectorises over the member axis.
+            send_ids = [cid for cid in members
+                        if dropped.get(cid) != "before_send"]
+            if send_ids:
+                q = quantize(vectors[send_ids], cfg.scale, cfg.p)
+                shares = bgw_encode(q, n, cfg.privacy_t, cfg.p, self.rng)
+                s = np.mod(s + shares.sum(axis=1) % cfg.p, cfg.p)
+                contributors.extend(send_ids)
+
+        # Final open at the server: the last stage's shares arrive
+        # directly (forwarded running sum already reconstructed above,
+        # contributions sent point-to-point before any death), so any
+        # T+1 positions reconstruct the total.
+        total = bgw_decode(s[: cfg.privacy_t + 1],
+                           np.arange(cfg.privacy_t + 1), cfg.p)[0]
+        return dequantize(total, cfg.scale, cfg.p), contributors
+
+
+def secure_federated_mean(vectors: np.ndarray,
+                          weights: np.ndarray,
+                          cfg: RingConfig | None = None,
+                          dropped: dict[int, str] | None = None,
+                          rng: np.random.Generator | None = None
+                          ) -> np.ndarray:
+    """Weighted FedAvg through the secure ring: clients pre-scale their
+    vector by its sample weight, the ring sums both the scaled vectors and
+    the weights (as 1-d field elements appended to the payload), and the
+    server only ever sees the two opened sums.  Mirrors the weighted-avg
+    semantics of TA_Aggregator.aggregate (TA_Aggregator.py:70-78) without
+    revealing any individual update."""
+    vectors = np.asarray(vectors, np.float64)
+    weights = np.asarray(weights, np.float64)
+    if weights.min() < 0 or weights.sum() <= 0:
+        raise ValueError("weights must be non-negative with positive sum")
+    # Normalise weights before quantization: raw sample counts (thousands
+    # per client) would push the quantized weighted sum past the field
+    # prime and wrap silently.  The server only needs ratios, so scaling
+    # by 1/sum(w) preserves the weighted mean and bounds every field
+    # element by max|v| * scale.
+    weights = weights / weights.sum()
+    cfg = cfg or RingConfig(num_clients=vectors.shape[0])
+    payload = np.concatenate(
+        [vectors * weights[:, None], weights[:, None]], axis=1)
+    ring = TurboAggregateRing(cfg, rng)
+    total, _ = ring.aggregate(payload, dropped)
+    wsum = total[-1]
+    if wsum <= 0:
+        raise RuntimeError("no surviving contributors")
+    return total[:-1] / wsum

@@ -44,8 +44,15 @@ def test_round_program_spans_two_processes():
     except subprocess.TimeoutExpired:
         for p in procs:
             p.kill()
-        pytest.fail("multihost workers timed out:\n" + "\n---\n".join(
-            (p.stdout.read() if p.stdout else "") for p in procs))
+        # Processes whose communicate() already finished have a closed
+        # stdout; only drain the ones that were still running.
+        drained = list(outs)
+        for p in procs[len(outs):]:
+            try:
+                drained.append(p.communicate()[0] or "")
+            except Exception:
+                drained.append("<unreadable>")
+        pytest.fail("multihost workers timed out:\n" + "\n---\n".join(drained))
     for p, out in zip(procs, outs):
         assert p.returncode == 0, out[-3000:]
     assert any("WORKER_OK 0" in o for o in outs), outs[0][-1500:]

@@ -1,0 +1,120 @@
+"""Turbo-Aggregate ring protocol: secure sum == plaintext sum, with and
+without dropouts (VERDICT round-1 item 9; reference scaffold
+TA_Aggregator.py / mpc_function.py)."""
+
+import numpy as np
+import pytest
+
+from feddrift_tpu.platform.turboagg import (
+    RingConfig, TurboAggregateRing, secure_federated_mean)
+
+
+def _vectors(c, d, seed=0):
+    return np.random.default_rng(seed).normal(size=(c, d)).astype(np.float64)
+
+
+def test_secure_sum_matches_plaintext_no_dropouts():
+    v = _vectors(12, 33)
+    ring = TurboAggregateRing(RingConfig(num_clients=12, group_size=4,
+                                         privacy_t=1))
+    total, contributors = ring.aggregate(v)
+    assert sorted(contributors) == list(range(12))
+    np.testing.assert_allclose(total, v.sum(axis=0), atol=1e-3)
+
+
+def test_secure_sum_under_dropouts():
+    """k dropouts across stages: before_send clients are excluded,
+    after_send clients included, and the ring completes either way."""
+    v = _vectors(12, 17, seed=3)
+    ring = TurboAggregateRing(RingConfig(num_clients=12, group_size=4,
+                                         privacy_t=1))
+    dropped = {2: "before_send",   # group 0: data never enters
+               5: "after_send",    # group 1: counted, relay recovered
+               9: "after_send"}    # group 2: counted
+    total, contributors = ring.aggregate(v, dropped)
+    expect_ids = [i for i in range(12) if i != 2]
+    assert sorted(contributors) == expect_ids
+    np.testing.assert_allclose(total, v[expect_ids].sum(axis=0), atol=1e-3)
+
+
+@pytest.mark.parametrize("c", [1, 3, 5, 9, 13])
+def test_ragged_population_folds_into_last_group(c):
+    """C not divisible by group_size: the remainder folds into the last
+    group as contributors-only, so aggregation works with no dropouts and
+    with an early dropout."""
+    v = _vectors(c, 7, seed=c)
+    cfg = RingConfig(num_clients=c, group_size=4, privacy_t=1)
+    total, contributors = TurboAggregateRing(cfg).aggregate(v)
+    assert sorted(contributors) == list(range(c))
+    np.testing.assert_allclose(total, v.sum(axis=0), atol=1e-3)
+    if c > 1:
+        total, contributors = TurboAggregateRing(cfg).aggregate(
+            v, {c - 1: "before_send"})
+        np.testing.assert_allclose(total, v[: c - 1].sum(axis=0), atol=1e-3)
+
+
+def test_max_tolerable_dropouts_per_group():
+    """n - T - 1 relays of one group may die; one more is unrecoverable."""
+    cfg = RingConfig(num_clients=8, group_size=4, privacy_t=1)
+    v = _vectors(8, 5, seed=1)
+    # group 1 = clients 4..7; kill n-T-1 = 2 of them after send: fine.
+    ok = {4: "after_send", 5: "after_send"}
+    total, contributors = TurboAggregateRing(cfg).aggregate(v, ok)
+    np.testing.assert_allclose(total, v.sum(axis=0), atol=1e-3)
+    # a third dead relay in the same group leaves < T+1 alive positions.
+    bad = {4: "after_send", 5: "after_send", 6: "after_send"}
+    with pytest.raises(RuntimeError, match="unrecoverable"):
+        TurboAggregateRing(cfg).aggregate(v, bad)
+
+
+def test_single_share_is_masked():
+    """Privacy smoke: one position's share of a constant vector is not the
+    vector (degree-T randomness masks it)."""
+    cfg = RingConfig(num_clients=4, group_size=4, privacy_t=1)
+    from feddrift_tpu.platform.secure_agg import bgw_encode, quantize
+    rng = np.random.default_rng(0)
+    q = quantize(np.full(6, 0.5))[None, :]
+    shares = bgw_encode(q, cfg.group_size, cfg.privacy_t, cfg.p, rng)
+    assert not np.array_equal(shares[0, 0], q[0])
+    # shares differ per position (nonconstant polynomial w.h.p.)
+    assert not np.array_equal(shares[0, 0], shares[1, 0])
+
+
+def test_secure_federated_mean_weighted():
+    v = _vectors(8, 9, seed=7)
+    w = np.array([1, 2, 3, 4, 1, 2, 3, 4], np.float64)
+    got = secure_federated_mean(v, w, RingConfig(num_clients=8, group_size=4))
+    expect = (v * w[:, None]).sum(0) / w.sum()
+    np.testing.assert_allclose(got, expect, atol=1e-3)
+
+
+def test_secure_federated_mean_sample_count_weights():
+    """Realistic sample-count weights (thousands per client) must not wrap
+    the field: weights are normalised before quantization."""
+    v = _vectors(8, 9, seed=11)
+    w = np.full(8, 5000.0)
+    got = secure_federated_mean(v, w, RingConfig(num_clients=8, group_size=4))
+    np.testing.assert_allclose(got, v.mean(0), atol=1e-3)
+    with pytest.raises(ValueError, match="non-negative"):
+        secure_federated_mean(v, -w)
+
+
+def test_secure_federated_mean_excludes_early_dropout():
+    v = _vectors(6, 4, seed=9)
+    w = np.ones(6)
+    got = secure_federated_mean(
+        v, w, RingConfig(num_clients=6, group_size=3),
+        dropped={1: "before_send"})
+    keep = [0, 2, 3, 4, 5]
+    np.testing.assert_allclose(got, v[keep].mean(0), atol=1e-3)
+
+
+def test_ring_config_validation():
+    with pytest.raises(ValueError, match="group_size"):
+        RingConfig(num_clients=4, group_size=2, privacy_t=1)
+    with pytest.raises(ValueError, match="unknown client"):
+        TurboAggregateRing(RingConfig(num_clients=4)).aggregate(
+            _vectors(4, 3), {99: "after_send"})
+    with pytest.raises(ValueError, match="stage"):
+        TurboAggregateRing(RingConfig(num_clients=4)).aggregate(
+            _vectors(4, 3), {1: "mid_send"})
