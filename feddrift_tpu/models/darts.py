@@ -1,125 +1,290 @@
-"""DARTS searchable-cell network (reference: fedml_api/model/cv/darts/ —
-model_search.py's MixedOp/Cell/Network used by FedNAS,
-fedml_api/distributed/fednas/).
+"""DARTS searchable-cell network with the reference's full search space.
+
+Reference: fedml_api/model/cv/darts/ — the 8-op ``PRIMITIVES`` list and
+Genotype tuple (genotypes.py:1-14), MixedOp/Cell/Network
+(model_search.py:10-59, 172-241), the concrete ops incl. SepConv/DilConv/
+FactorizedReduce (operations.py), and genotype derivation
+(model_search.py:258-297).  Used by FedNAS (platform/fednas.py).
 
 Design for TPU + federation:
-- Architecture parameters (the DARTS "alphas") are ordinary flax params whose
-  names start with ``arch_``; ``split_arch_params`` partitions a param pytree
-  into (weights, alphas) by that prefix. FedNAS (platform/fednas.py) uses the
-  split to run the bilevel update — weights on train data, alphas on search
-  data — while plain FedAvg over the whole pytree still works (alphas simply
-  average, which is exactly the reference server's behaviour,
-  fednas/FedNASAggregator.py).
-- Every candidate op runs and is mixed by softmax(alpha): no data-dependent
-  control flow, so one traced XLA program covers all architectures. This is
-  the DARTS continuous relaxation itself — it maps to TPU better than
+- The DARTS "alphas" are TWO shared tensors ``arch_alphas_normal`` /
+  ``arch_alphas_reduce`` of shape [k, 8] (k = sum_i (2+i) edges), exactly
+  the reference's ``_initialize_alphas`` (model_search.py:232-241): every
+  normal cell reads the same softmaxed weights, every reduction cell the
+  other set.  They live at the top of the flax param tree with an ``arch_``
+  name prefix; ``split_arch_params`` partitions a pytree into (weights,
+  alphas) by that prefix.  FedNAS uses the split for the bilevel update;
+  plain FedAvg over the whole pytree still works (alphas simply average,
+  the reference server's behaviour, fednas/FedNASAggregator.py).
+- Every candidate op runs and is mixed by softmax(alpha): no
+  data-dependent control flow, so ONE traced XLA program covers all
+  architectures — the DARTS continuous relaxation maps to TPU better than
   discrete NAS because the mixture is a dense weighted sum the compiler
-  fuses.
+  fuses.  ``none`` contributes a zero tensor (kept so the softmax
+  normalisation and genotype semantics match the reference; XLA folds the
+  multiply-by-zero into the sum).
+- Cells are the reference's two-input DAG: states s0 (prev-prev cell) and
+  s1 (prev cell) preprocessed to the cell width, ``steps`` intermediate
+  nodes each summing mixed edges from all predecessors, output =
+  concatenation of the last ``multiplier`` nodes.  Reduction cells (at
+  layers//3 and 2*layers//3) stride-2 the two input edges.
 """
 
 from __future__ import annotations
 
-from typing import Sequence
+from typing import NamedTuple, Sequence
 
 import flax.linen as nn
 import jax.numpy as jnp
+import numpy as np
 
 from feddrift_tpu.models.resnet import _Norm
 
-OPS: Sequence[str] = ("skip", "conv3", "sep3", "avgpool", "maxpool")
+# Same names and order as the reference (genotypes.py:5-14) so exported
+# genotypes are directly comparable.
+PRIMITIVES: Sequence[str] = (
+    "none",
+    "max_pool_3x3",
+    "avg_pool_3x3",
+    "skip_connect",
+    "sep_conv_3x3",
+    "sep_conv_5x5",
+    "dil_conv_3x3",
+    "dil_conv_5x5",
+)
 
 
-class _Op(nn.Module):
-    kind: str
+class Genotype(NamedTuple):
+    """(op_name, predecessor_state) pairs per node + concat node ids
+    (genotypes.py:3)."""
+
+    normal: list
+    normal_concat: list
+    reduce: list
+    reduce_concat: list
+
+
+def _relu_conv_bn(x, filters: int, kernel, strides, norm: str):
+    """ReLUConvBN (operations.py): relu -> conv -> norm."""
+    x = nn.relu(x)
+    x = nn.Conv(filters, kernel, strides=strides, padding="SAME",
+                use_bias=False)(x)
+    return _Norm(norm)(x)
+
+
+class FactorizedReduce(nn.Module):
+    """Stride-2 channel-preserving reduce: concat of two offset 1x1
+    stride-2 convs (operations.py FactorizedReduce)."""
+
     filters: int
     norm: str = "batch"
 
     @nn.compact
     def __call__(self, x):
-        if self.kind == "skip":
-            if x.shape[-1] != self.filters:
-                x = nn.Conv(self.filters, (1, 1), use_bias=False)(x)
-            return x
-        if self.kind == "conv3":
-            y = nn.Conv(self.filters, (3, 3), padding="SAME", use_bias=False)(x)
-            return nn.relu(_Norm(self.norm)(y))
-        if self.kind == "sep3":
-            y = nn.Conv(x.shape[-1], (3, 3), padding="SAME",
-                        feature_group_count=x.shape[-1], use_bias=False)(x)
+        x = nn.relu(x)
+        a = nn.Conv(self.filters // 2, (1, 1), strides=(2, 2),
+                    use_bias=False)(x)
+        b = nn.Conv(self.filters - self.filters // 2, (1, 1), strides=(2, 2),
+                    use_bias=False)(x[:, 1:, 1:, :])
+        return _Norm(self.norm)(jnp.concatenate([a, b], axis=-1))
+
+
+class _Op(nn.Module):
+    """One concrete candidate op (operations.py OPS table)."""
+
+    kind: str
+    filters: int
+    stride: int = 1
+    norm: str = "batch"
+
+    @nn.compact
+    def __call__(self, x):
+        s = (self.stride, self.stride)
+        if self.kind == "none":
+            # Zero op at the strided output shape (operations.py Zero).
+            return jnp.zeros_like(x[:, ::self.stride, ::self.stride, :])
+        if self.kind in ("max_pool_3x3", "avg_pool_3x3"):
+            pool = nn.max_pool if self.kind.startswith("max") else nn.avg_pool
+            y = pool(x, (3, 3), strides=s, padding="SAME")
+            # reference wraps pooling in an affine-less BN
+            # (model_search.py:17-18); _Norm's batch mode is stateless.
+            return _Norm(self.norm)(y)
+        if self.kind == "skip_connect":
+            if self.stride == 1:
+                return x
+            return FactorizedReduce(self.filters, self.norm)(x)
+        if self.kind in ("sep_conv_3x3", "sep_conv_5x5"):
+            k = 3 if self.kind.endswith("3x3") else 5
+            # SepConv applies depthwise-separable twice, stride on the
+            # first (operations.py SepConv).
+            y = x
+            for i, st in enumerate((s, (1, 1))):
+                y = nn.relu(y)
+                y = nn.Conv(y.shape[-1], (k, k), strides=st, padding="SAME",
+                            feature_group_count=y.shape[-1],
+                            use_bias=False)(y)
+                y = nn.Conv(self.filters, (1, 1), use_bias=False)(y)
+                y = _Norm(self.norm)(y)
+            return y
+        if self.kind in ("dil_conv_3x3", "dil_conv_5x5"):
+            k = 3 if self.kind.endswith("3x3") else 5
+            y = nn.relu(x)
+            y = nn.Conv(y.shape[-1], (k, k), strides=s, padding="SAME",
+                        kernel_dilation=(2, 2),
+                        feature_group_count=y.shape[-1], use_bias=False)(y)
             y = nn.Conv(self.filters, (1, 1), use_bias=False)(y)
-            return nn.relu(_Norm(self.norm)(y))
-        if self.kind == "avgpool":
-            y = nn.avg_pool(x, (3, 3), strides=(1, 1), padding="SAME")
-            if y.shape[-1] != self.filters:
-                y = nn.Conv(self.filters, (1, 1), use_bias=False)(y)
-            return y
-        if self.kind == "maxpool":
-            y = nn.max_pool(x, (3, 3), strides=(1, 1), padding="SAME")
-            if y.shape[-1] != self.filters:
-                y = nn.Conv(self.filters, (1, 1), use_bias=False)(y)
-            return y
+            return _Norm(self.norm)(y)
         raise ValueError(self.kind)
 
 
 class MixedOp(nn.Module):
-    """softmax(alpha)-weighted sum of all candidate ops (model_search.py MixedOp)."""
+    """softmax(alpha)-weighted sum of all 8 candidates on one edge
+    (model_search.py:10-23).  ``weights`` come from the shared cell-type
+    alpha tensor — this module holds no arch params itself."""
 
     filters: int
+    stride: int = 1
     norm: str = "batch"
 
     @nn.compact
-    def __call__(self, x):
-        alpha = self.param("arch_alpha", nn.initializers.normal(1e-3),
-                           (len(OPS),))
-        w = nn.softmax(alpha)
-        outs = [_Op(k, self.filters, self.norm, name=f"op_{k}")(x) for k in OPS]
-        return sum(w[i] * outs[i] for i in range(len(OPS)))
+    def __call__(self, x, weights):
+        outs = [_Op(k, self.filters, self.stride, self.norm,
+                    name=f"op_{k}")(x) for k in PRIMITIVES]
+        return sum(weights[i] * outs[i] for i in range(len(PRIMITIVES)))
 
 
 class Cell(nn.Module):
-    """DARTS cell: ``nodes`` intermediate nodes, each summing mixed ops from
-    all predecessors; output concatenates the intermediate nodes."""
+    """Two-input DARTS cell (model_search.py:26-59): preprocess s0/s1 to
+    ``filters`` channels, build ``steps`` nodes over all predecessors,
+    concat the last ``multiplier`` nodes."""
 
     filters: int
-    nodes: int = 3
-    reduce: bool = False
+    steps: int = 4
+    multiplier: int = 4
+    reduction: bool = False
+    reduction_prev: bool = False
     norm: str = "batch"
 
     @nn.compact
-    def __call__(self, x):
-        if self.reduce:
-            x = nn.avg_pool(x, (2, 2), strides=(2, 2))
-        states = [nn.Conv(self.filters, (1, 1), use_bias=False)(x)]
-        for i in range(self.nodes):
-            s = sum(MixedOp(self.filters, self.norm,
-                            name=f"edge_{j}_{i}")(states[j])
-                    for j in range(len(states)))
-            states.append(s)
-        return jnp.concatenate(states[1:], axis=-1)
+    def __call__(self, s0, s1, weights):
+        if self.reduction_prev:
+            s0 = FactorizedReduce(self.filters, self.norm,
+                                  name="preprocess0")(s0)
+        else:
+            s0 = _relu_conv_bn(s0, self.filters, (1, 1), (1, 1), self.norm)
+        s1 = _relu_conv_bn(s1, self.filters, (1, 1), (1, 1), self.norm)
+        states = [s0, s1]
+        offset = 0
+        for i in range(self.steps):
+            acc = None
+            for j, h in enumerate(states):
+                stride = 2 if self.reduction and j < 2 else 1
+                y = MixedOp(self.filters, stride, self.norm,
+                            name=f"edge_{offset + j}")(h, weights[offset + j])
+                acc = y if acc is None else acc + y
+            offset += len(states)
+            states.append(acc)
+        return jnp.concatenate(states[-self.multiplier:], axis=-1)
+
+
+def num_edges(steps: int) -> int:
+    """k = 2 + 3 + ... + (steps+1) mixed edges per cell type
+    (model_search.py:233)."""
+    return sum(2 + i for i in range(steps))
 
 
 class DARTSNetwork(nn.Module):
-    """The searchable network (model_search.py Network): stem, alternating
-    normal/reduce cells, global pool, classifier."""
+    """The searchable network (model_search.py Network): stem, cells with
+    reduction at layers//3 and 2*layers//3, global pool, classifier.
+
+    Field names keep round-1's API: ``filters`` = init channels C,
+    ``cells`` = layers, ``nodes`` = steps.  ``multiplier`` defaults to
+    ``nodes`` (the reference's steps=multiplier=4 concats ALL intermediate
+    nodes; same here for any node count)."""
 
     num_classes: int = 10
     filters: int = 16
     cells: int = 3
-    nodes: int = 3
+    nodes: int = 4
+    multiplier: int = 0          # 0 -> use ``nodes``
+    stem_multiplier: int = 3
     norm: str = "batch"
 
     @nn.compact
     def __call__(self, x):
+        mult = self.multiplier or self.nodes
+        k = num_edges(self.nodes)
+        alphas_normal = self.param(
+            "arch_alphas_normal", nn.initializers.normal(1e-3),
+            (k, len(PRIMITIVES)))
+        alphas_reduce = self.param(
+            "arch_alphas_reduce", nn.initializers.normal(1e-3),
+            (k, len(PRIMITIVES)))
+        w_normal = nn.softmax(alphas_normal, axis=-1)
+        w_reduce = nn.softmax(alphas_reduce, axis=-1)
+
         if x.ndim == 2:
             x = x.reshape((x.shape[0], 32, 32, 3))
-        x = nn.Conv(self.filters, (3, 3), padding="SAME", use_bias=False)(x)
-        x = nn.relu(_Norm(self.norm)(x))
+        stem = nn.Conv(self.stem_multiplier * self.filters, (3, 3),
+                       padding="SAME", use_bias=False)(x)
+        s0 = s1 = _Norm(self.norm)(stem)
+
+        c_curr = self.filters
+        reduction_prev = False
+        reduce_at = {self.cells // 3, 2 * self.cells // 3}
         for i in range(self.cells):
-            reduce = i > 0 and i % 2 == 0
-            x = Cell(self.filters * (2 if reduce else 1), self.nodes,
-                     reduce=reduce, norm=self.norm, name=f"cell_{i}")(x)
-        x = x.mean(axis=(1, 2))
-        return nn.Dense(self.num_classes)(x)
+            reduction = i in reduce_at
+            if reduction:
+                c_curr *= 2
+            cell = Cell(c_curr, self.nodes, mult, reduction,
+                        reduction_prev, self.norm, name=f"cell_{i}")
+            s0, s1 = s1, cell(s0, s1, w_reduce if reduction else w_normal)
+            reduction_prev = reduction
+        out = s1.mean(axis=(1, 2))
+        return nn.Dense(self.num_classes)(out)
+
+
+def derive_genotype(alphas_normal, alphas_reduce, steps: int,
+                    multiplier: int | None = None) -> Genotype:
+    """Discretize alphas into a reference-shaped Genotype
+    (model_search.py genotype():258-297): per node keep the top-2
+    predecessor edges ranked by their best non-``none`` weight; each kept
+    edge's op is its argmax non-``none`` primitive."""
+
+    def _parse(alpha):
+        w = np.asarray(jnp.asarray(alpha), np.float64)
+        w = np.exp(w - w.max(axis=-1, keepdims=True))
+        w = w / w.sum(axis=-1, keepdims=True)
+        none_idx = PRIMITIVES.index("none")
+        gene = []
+        start, n = 0, 2
+        for _ in range(steps):
+            W = w[start:start + n]
+            best_non_none = np.delete(W, none_idx, axis=1).max(axis=1)
+            edges = sorted(range(n), key=lambda j: -best_non_none[j])[:2]
+            for j in edges:
+                ops = W[j].copy()
+                ops[none_idx] = -np.inf
+                gene.append((PRIMITIVES[int(ops.argmax())], j))
+            start += n
+            n += 1
+        return gene
+
+    mult = multiplier or steps
+    concat = list(range(2 + steps - mult, steps + 2))
+    return Genotype(normal=_parse(alphas_normal), normal_concat=concat,
+                    reduce=_parse(alphas_reduce), reduce_concat=concat)
+
+
+def genotype_of(params, steps: int | None = None,
+                multiplier: int | None = None) -> Genotype:
+    """Extract the Genotype from a DARTSNetwork param pytree."""
+    an, ar = params["arch_alphas_normal"], params["arch_alphas_reduce"]
+    if steps is None:
+        # invert k = steps*(steps+3)/2
+        k = an.shape[0]
+        steps = int((-3 + np.sqrt(9 + 8 * k)) / 2)
+    return derive_genotype(an, ar, steps, multiplier)
 
 
 def is_arch_param(path) -> bool:

@@ -101,16 +101,12 @@ def aggregate_search(params_stack, n):
     return jax.tree_util.tree_map(avg, params_stack)
 
 
-def derive_architecture(params) -> dict[str, int]:
-    """Discretize: argmax op per mixed edge (the reference's genotype
-    derivation, darts/model_search.py genotype())."""
-    from feddrift_tpu.models.darts import is_arch_param
-    arch = {}
-    for path, leaf in jax.tree_util.tree_flatten_with_path(params)[0]:
-        if is_arch_param(path):
-            keys = [str(getattr(k, "key", getattr(k, "idx", k))) for k in path]
-            arch["/".join(keys)] = int(jnp.argmax(leaf))
-    return arch
+def derive_architecture(params):
+    """Discretize the searched alphas into a reference-shaped Genotype
+    (darts/model_search.py genotype():258-297): per node the top-2 edges by
+    best non-none weight, each with its argmax non-none primitive."""
+    from feddrift_tpu.models.darts import genotype_of
+    return genotype_of(params)
 
 
 class FedNAS:

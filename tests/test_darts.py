@@ -1,0 +1,118 @@
+"""DARTS search-space parity: 8 primitives, two-input cells with reduction,
+shared alpha tensors, reference-shaped genotype derivation (reference
+darts/genotypes.py:5-14, model_search.py:258-297)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from feddrift_tpu.models.darts import (
+    PRIMITIVES, Cell, DARTSNetwork, FactorizedReduce, Genotype, MixedOp,
+    derive_genotype, genotype_of, num_edges, split_arch_params)
+
+
+def test_primitives_match_reference():
+    assert list(PRIMITIVES) == [
+        "none", "max_pool_3x3", "avg_pool_3x3", "skip_connect",
+        "sep_conv_3x3", "sep_conv_5x5", "dil_conv_3x3", "dil_conv_5x5"]
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("kind", PRIMITIVES)
+@pytest.mark.parametrize("stride", [1, 2])
+def test_each_primitive_forward(kind, stride):
+    from feddrift_tpu.models.darts import _Op
+    op = _Op(kind, filters=8, stride=stride)
+    x = jnp.ones((2, 8, 8, 8))
+    params = op.init(jax.random.PRNGKey(0), x)
+    y = op.apply(params, x)
+    assert y.shape == (2, 8 // stride, 8 // stride, 8)
+    if kind == "none":
+        assert np.all(np.asarray(y) == 0)
+
+
+@pytest.mark.slow
+def test_mixed_op_is_weighted_sum():
+    op = MixedOp(filters=4, stride=1)
+    x = jnp.ones((1, 4, 4, 4))
+    w = jnp.zeros((len(PRIMITIVES),)).at[PRIMITIVES.index("none")].set(1.0)
+    params = op.init(jax.random.PRNGKey(0), x, w)
+    y = op.apply(params, x, w)
+    np.testing.assert_allclose(np.asarray(y), 0.0, atol=1e-6)
+
+
+@pytest.mark.slow
+def test_cell_shapes_normal_and_reduce():
+    k = num_edges(2)
+    w = jnp.full((k, len(PRIMITIVES)), 1.0 / len(PRIMITIVES))
+    s0 = jnp.ones((2, 8, 8, 6))
+    s1 = jnp.ones((2, 8, 8, 6))
+    normal = Cell(filters=4, steps=2, multiplier=2)
+    p = normal.init(jax.random.PRNGKey(0), s0, s1, w)
+    y = normal.apply(p, s0, s1, w)
+    assert y.shape == (2, 8, 8, 8)          # multiplier * filters channels
+    red = Cell(filters=4, steps=2, multiplier=2, reduction=True)
+    p = red.init(jax.random.PRNGKey(0), s0, s1, w)
+    y = red.apply(p, s0, s1, w)
+    assert y.shape == (2, 4, 4, 8)          # spatial halved
+
+
+@pytest.mark.slow
+def test_factorized_reduce_halves_spatial():
+    fr = FactorizedReduce(filters=6)
+    x = jnp.ones((2, 8, 8, 3))
+    p = fr.init(jax.random.PRNGKey(0), x)
+    assert fr.apply(p, x).shape == (2, 4, 4, 6)
+
+
+@pytest.mark.slow
+def test_network_has_two_shared_alpha_tensors():
+    net = DARTSNetwork(num_classes=5, filters=4, cells=3, nodes=2)
+    x = jnp.ones((2, 16, 16, 3))
+    params = net.init(jax.random.PRNGKey(0), x)["params"]
+    k = num_edges(2)
+    assert params["arch_alphas_normal"].shape == (k, len(PRIMITIVES))
+    assert params["arch_alphas_reduce"].shape == (k, len(PRIMITIVES))
+    out = net.apply({"params": params}, x)
+    assert out.shape == (2, 5)
+    wmask, amask = split_arch_params(params)
+    n_arch = sum(jax.tree_util.tree_leaves(amask))
+    assert n_arch == 2                       # exactly the two shared tensors
+
+
+def test_genotype_derivation_golden():
+    """Alphas engineered so the expected genotype is known: node 0 prefers
+    sep_conv_3x3 on both input edges; node 1's best two edges are 0 and 2
+    with max_pool_3x3 / dil_conv_5x5.  'none' never wins even when its raw
+    weight is highest (reference excludes it, model_search.py:272-283)."""
+    steps = 2
+    k = num_edges(steps)                     # 5 edges: [0,1 | 2,3,4]
+    a = np.full((k, len(PRIMITIVES)), -5.0)
+    sep3 = PRIMITIVES.index("sep_conv_3x3")
+    mp = PRIMITIVES.index("max_pool_3x3")
+    dil5 = PRIMITIVES.index("dil_conv_5x5")
+    none = PRIMITIVES.index("none")
+    a[0, sep3] = 3.0
+    a[1, sep3] = 2.0
+    a[2, mp] = 4.0          # node 1, edge from state 0
+    a[2, none] = 4.5        # none outweighs mp but must be ignored as an op
+    a[4, dil5] = 3.5        # node 1, edge from state 2
+    g = derive_genotype(jnp.asarray(a), jnp.asarray(a), steps)
+    assert isinstance(g, Genotype)
+    assert g.normal[0] == ("sep_conv_3x3", 0)
+    assert g.normal[1] == ("sep_conv_3x3", 1)
+    assert set(g.normal[2:]) == {("max_pool_3x3", 0), ("dil_conv_5x5", 2)}
+    assert g.normal_concat == [2, 3]
+    assert g.reduce == g.normal
+
+
+@pytest.mark.slow
+def test_genotype_of_infers_steps():
+    net = DARTSNetwork(num_classes=3, filters=4, cells=1, nodes=2)
+    x = jnp.ones((1, 8, 8, 3))
+    params = net.init(jax.random.PRNGKey(0), x)["params"]
+    g = genotype_of(params)
+    assert len(g.normal) == 2 * 2            # top-2 edges per node
+    for op, j in g.normal:
+        assert op in PRIMITIVES and op != "none"
