@@ -51,18 +51,22 @@ def _load_uci_csv(path: str, name: str, feature_dim: int,
     xs, ys = [], []
     with open(path, newline="") as f:
         reader = csv.reader(f)
-        for i, row in enumerate(reader):
-            if i >= max_rows:
-                break
+        for row in reader:
+            if len(xs) >= max_rows:   # count accepted rows, not raw lines —
+                break                 # a skipped header must not shrink the cap
             try:
+                # parse BOTH fields before appending either, so a row that
+                # fails mid-parse cannot desynchronize xs from ys
                 if name == "susy":
-                    ys.append(int(float(row[0])))
-                    xs.append([float(v) for v in row[1:1 + feature_dim]])
+                    label = int(float(row[0]))
+                    feats = [float(v) for v in row[1:1 + feature_dim]]
                 else:
-                    xs.append([float(v) for v in row[2:2 + feature_dim]])
-                    ys.append(int(float(row[-1])))
+                    feats = [float(v) for v in row[2:2 + feature_dim]]
+                    label = int(float(row[-1]))
             except (ValueError, IndexError):
                 continue  # header / malformed row
+            xs.append(feats)
+            ys.append(label)
     if not xs:
         return None
     return (np.asarray(xs, dtype=np.float32),

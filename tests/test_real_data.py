@@ -1,0 +1,173 @@
+"""Real-format data ingestion: miniature fixture files in the reference's
+on-disk layouts must be loaded by the registry instead of prototype
+synthesis (VERDICT round-1 item 4).
+
+Formats covered:
+- LEAF MNIST train JSON  (reference MNIST/data_loader_cont.py:152-171)
+- FMoW npz partitions    (reference fmow/data_loader.py:63-103 layout)
+- UCI SUSY / RO CSV      (reference data_loader_for_susy_and_ro.py)
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from feddrift_tpu.config import ExperimentConfig
+from feddrift_tpu.data.registry import make_dataset
+
+C, T, N = 2, 2, 5   # tiny: 2 clients, 2 iterations (+1 test step), 5 samples
+
+
+def _cfg(tmp_path, dataset, **kw):
+    return ExperimentConfig(
+        dataset=dataset, model="fnn", concept_drift_algo="win-1",
+        change_points="rand", drift_together=1,
+        client_num_in_total=C, client_num_per_round=C,
+        train_iterations=T, comm_round=1, sample_num=N,
+        data_dir=str(tmp_path), **kw)
+
+
+# ----------------------------------------------------------------- LEAF MNIST
+def _write_leaf_mnist(tmp_path, n_samples=40):
+    rng = np.random.default_rng(7)
+    d = os.path.join(tmp_path, "MNIST", "train")
+    os.makedirs(d)
+    users = ["f_0001", "f_0002"]
+    xs = rng.random((n_samples, 784)).round(4)
+    ys = rng.integers(0, 10, n_samples)
+    half = n_samples // 2
+    payload = {
+        "users": users,
+        "num_samples": [half, n_samples - half],
+        "user_data": {
+            users[0]: {"x": xs[:half].tolist(), "y": ys[:half].tolist()},
+            users[1]: {"x": xs[half:].tolist(), "y": ys[half:].tolist()},
+        },
+    }
+    with open(os.path.join(d, "all_data_niid_0_keep_10_train_9.json"), "w") as f:
+        json.dump(payload, f)
+    return xs, ys
+
+
+def test_leaf_mnist_json_is_loaded(tmp_path):
+    xs, _ = _write_leaf_mnist(tmp_path)
+    ds = make_dataset(_cfg(tmp_path, "MNIST"))
+    assert ds.meta["real_data"] is True
+    # every served sample is one of the fixture images (shuffled + wrapped,
+    # never synthesized)
+    source = {r.tobytes() for r in xs.astype(np.float32)}
+    flat = ds.x.reshape(-1, 784)
+    for row in flat[:: max(1, len(flat) // 16)]:
+        assert np.asarray(row, np.float32).tobytes() in source
+
+
+def test_leaf_mnist_label_swap_applies_to_real_labels(tmp_path):
+    """Drift semantics on real data: a concept-k step serves the same images
+    with the reference's swapped label pairs (data_loader_cont.py:179-214)."""
+    from feddrift_tpu.data.prototype import apply_label_swap
+    xs, ys = _write_leaf_mnist(tmp_path)
+    cfg = _cfg(tmp_path, "MNIST", concept_num=2)
+    ds = make_dataset(cfg)
+    by_img = {xs[i].astype(np.float32).tobytes(): int(ys[i])
+              for i in range(len(xs))}
+    flat_x = np.asarray(ds.x).reshape(C, T + 1, N, 784)
+    for c in range(C):
+        for t in range(T + 1):
+            k = int(ds.concepts[t, c])
+            true = np.array([by_img[flat_x[c, t, i].astype(np.float32)
+                                    .tobytes()] for i in range(N)], np.int32)
+            np.testing.assert_array_equal(
+                np.asarray(ds.y[c, t]), apply_label_swap(true, k, 10))
+
+
+def test_missing_leaf_dir_falls_back_to_prototypes(tmp_path):
+    ds = make_dataset(_cfg(tmp_path, "MNIST"))
+    assert ds.meta["real_data"] is False
+
+
+# ----------------------------------------------------------------- FMoW npz
+def test_fmow_npz_partitions_are_loaded(tmp_path):
+    rng = np.random.default_rng(3)
+    part = os.path.join(tmp_path, "fmow", "partitions", "rand")
+    os.makedirs(part)
+    truth = {}
+    for c in range(C):
+        for t in range(T + 1):
+            x = rng.random((3, 32, 32, 3)).astype(np.float32)  # < N: wraps
+            y = rng.integers(0, 62, 3).astype(np.int32)
+            np.savez(os.path.join(part, f"client_{c}_iter_{t}.npz"), x=x, y=y)
+            truth[(c, t)] = (x, y)
+    ds = make_dataset(_cfg(tmp_path, "fmow"))
+    assert ds.meta["real_data"] is True
+    take = np.arange(N) % 3
+    for (c, t), (x, y) in truth.items():
+        np.testing.assert_array_equal(np.asarray(ds.x[c, t]), x[take])
+        np.testing.assert_array_equal(np.asarray(ds.y[c, t]), y[take])
+
+
+def test_fmow_incomplete_partitions_fall_back(tmp_path):
+    part = os.path.join(tmp_path, "fmow", "partitions", "rand")
+    os.makedirs(part)
+    np.savez(os.path.join(part, "client_0_iter_0.npz"),
+             x=np.zeros((2, 32, 32, 3), np.float32),
+             y=np.zeros(2, np.int32))         # only one of C*(T+1) files
+    ds = make_dataset(_cfg(tmp_path, "fmow"))
+    assert ds.meta["real_data"] is False
+
+
+def test_fmow_wrong_resolution_is_rejected(tmp_path):
+    part = os.path.join(tmp_path, "fmow", "partitions", "rand")
+    os.makedirs(part)
+    for c in range(C):
+        for t in range(T + 1):
+            np.savez(os.path.join(part, f"client_{c}_iter_{t}.npz"),
+                     x=np.zeros((2, 16, 16, 3), np.float32),
+                     y=np.zeros(2, np.int32))
+    with pytest.raises(ValueError, match="fmow_image_size"):
+        make_dataset(_cfg(tmp_path, "fmow"))
+
+
+# ----------------------------------------------------------------- UCI CSV
+def test_susy_csv_is_loaded(tmp_path):
+    rng = np.random.default_rng(11)
+    rows = rng.normal(size=(C * (T + 1) * N, 18)).astype(np.float32)
+    labels = rng.integers(0, 2, len(rows))
+    with open(os.path.join(tmp_path, "SUSY.csv"), "w") as f:
+        for lab, r in zip(labels, rows):
+            f.write(",".join([f"{float(lab):.1f}"] + [f"{v:.6f}" for v in r])
+                    + "\n")
+    ds = make_dataset(_cfg(tmp_path, "susy"))
+    assert ds.meta["source"] == "csv"
+    # file order, z-scored: client 0 / t=0 serves the first N rows
+    mu, sd = rows.mean(0), rows.std(0) + 1e-6
+    np.testing.assert_allclose(np.asarray(ds.x[0, 0]),
+                               (rows[:N] - mu) / sd, atol=1e-4)
+    # concept 0 keeps the true labels
+    if int(ds.concepts[0, 0]) == 0:
+        np.testing.assert_array_equal(np.asarray(ds.y[0, 0]), labels[:N])
+
+
+def test_ro_csv_is_loaded_with_header_skipped(tmp_path):
+    rng = np.random.default_rng(13)
+    n = C * (T + 1) * N
+    feats = rng.normal(size=(n, 5)).astype(np.float32)
+    labels = rng.integers(0, 2, n)
+    with open(os.path.join(tmp_path, "datatraining.txt"), "w") as f:
+        f.write('"id","date","Temperature","Humidity","Light","CO2",'
+                '"HumidityRatio","Occupancy"\n')       # header: skipped
+        for i in range(n):
+            f.write(",".join(
+                [str(i + 1), "2015-02-04 17:51:00"]
+                + [f"{v:.6f}" for v in feats[i]] + [str(labels[i])]) + "\n")
+    ds = make_dataset(_cfg(tmp_path, "ro"))
+    assert ds.meta["source"] == "csv"
+    mu, sd = feats.mean(0), feats.std(0) + 1e-6
+    np.testing.assert_allclose(np.asarray(ds.x[0, 0]),
+                               (feats[:N] - mu) / sd, atol=1e-4)
+
+
+def test_uci_without_csv_synthesizes(tmp_path):
+    ds = make_dataset(_cfg(tmp_path, "susy"))
+    assert ds.meta["source"] == "synthetic"
