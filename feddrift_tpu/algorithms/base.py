@@ -28,6 +28,8 @@ from typing import Any, Callable, Optional
 import jax.numpy as jnp
 import numpy as np
 
+from feddrift_tpu.comm import multihost
+
 _REGISTRY: dict[str, Callable[..., "DriftAlgorithm"]] = {}
 
 
@@ -117,6 +119,7 @@ class DriftAlgorithm:
         fm = feat_mask if feat_mask is not None else self._ones_feat_mask
         correct, _, total = self.step.acc_matrix(
             self.pool.params, self.x[:, t], self.y[:, t], fm)
+        correct, total = multihost.fetch((correct, total))
         return np.asarray(correct)[:, :self.C] / np.asarray(total)[None, :self.C]
 
     def acc_cells_upto(self, t: int, feat_mask=None) -> np.ndarray:
@@ -130,7 +133,7 @@ class DriftAlgorithm:
                 "full-dataset eval is unavailable under cfg.stream_data")
         fm = feat_mask if feat_mask is not None else self._ones_feat_mask
         correct = self.step.acc_cells(self.pool.params, self.x, self.y, fm)
-        return np.asarray(correct)[:, :self.C, : t + 1]
+        return np.asarray(multihost.fetch(correct))[:, :self.C, : t + 1]
 
     # -- hooks ----------------------------------------------------------
     def begin_iteration(self, t: int) -> None:

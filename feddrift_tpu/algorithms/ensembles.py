@@ -19,6 +19,7 @@ from __future__ import annotations
 import numpy as np
 
 from feddrift_tpu.algorithms.base import DriftAlgorithm, EnsembleSpec, register_algorithm
+from feddrift_tpu.comm import multihost
 from feddrift_tpu.data.retrain import poisson_sample_counts, time_weights
 
 import jax.numpy as jnp
@@ -100,6 +101,7 @@ class _AueBase(DriftAlgorithm):
         """
         mse_sum, total = self.step.mse_matrix(
             self.pool.params, self.x[:, t], self.y[:, t], self._ones_feat_mask)
+        mse_sum, total = multihost.fetch((mse_sum, total))
         mse_sum = np.asarray(mse_sum)[:, : self.C]
         total = np.asarray(total)[: self.C]
         if self.per_client_weights:
@@ -217,7 +219,8 @@ class Kue(DriftAlgorithm):
         (update_ens_weights, AggregatorKue.py:59-77)."""
         cms = self.step.confusion_matrices(
             self.pool.params, self.x[:, t], self.y[:, t], self._fm)
-        cms = np.asarray(cms, dtype=np.float64)[:, : self.C].sum(axis=1)  # [M, K, K]
+        cms = np.asarray(multihost.fetch(cms),
+                         dtype=np.float64)[:, : self.C].sum(axis=1)  # [M, K, K]
         for m in range(self.M):
             self.ens_weights[m] = kappa_from_confusion(cms[m])
 

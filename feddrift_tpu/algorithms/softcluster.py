@@ -38,6 +38,7 @@ from scipy.spatial.distance import squareform
 from scipy.special import softmax as sp_softmax
 
 from feddrift_tpu.algorithms.base import DriftAlgorithm, register_algorithm
+from feddrift_tpu.comm import multihost
 
 log = logging.getLogger("feddrift_tpu.softcluster")
 
@@ -385,7 +386,6 @@ class SoftCluster(DriftAlgorithm):
                            client_params, n) -> bool:
         """Gradient-norm gated bipartition (cluster_cfl, :1159-1223)."""
         did_split = False
-        n_np = np.asarray(n)[:, :self.C]
         in_use = [m for m in range(self.M) if (self.weights[t, m] > 0).any()]
 
         # flatten per-client updates: [C_pad, P] per model
@@ -397,12 +397,18 @@ class SoftCluster(DriftAlgorithm):
                 rows.append(delta.reshape(delta.shape[0], -1))
             return jnp.concatenate(rows, axis=1)
 
+        # ONE fetch for n + every model's update matrix: on DCN links the
+        # per-collective round-trip dominates, so batch them.
+        n_np, updates = multihost.fetch(
+            (n, {m: flat_updates(m) for m in in_use}))
+        n_np = np.asarray(n_np)[:, :self.C]
+
         for m in in_use:
             clients = np.nonzero(self.weights[t, m])[0]
             participating = [c for c in clients if n_np[m, c] > 0]
             if not participating:
                 continue
-            dW = np.asarray(flat_updates(m))[participating]   # [k, P]
+            dW = np.asarray(updates[m])[participating]
             norms = np.linalg.norm(dW, axis=1)
             max_norm = float(norms.max())
             mean_norm = float(np.linalg.norm(dW.mean(axis=0)))
@@ -445,7 +451,9 @@ class SoftCluster(DriftAlgorithm):
         :1245-1249). d = 1 - S is a strictly monotone transform of the
         reference's -S, and complete linkage is invariant under monotone
         distance transforms, so the 2-way cut is identical."""
-        d = 1.0 - S
+        # clip: float error can push a cosine similarity past 1.0, which
+        # would hand scipy a negative distance
+        d = 1.0 - np.clip(S, -1.0, 1.0)
         np.fill_diagonal(d, 0.0)
         d = (d + d.T) / 2.0     # numerical symmetry for squareform
         Z = sch.linkage(squareform(d, checks=False), method="complete")

@@ -16,6 +16,7 @@ import jax
 import jax.numpy as jnp
 
 from feddrift_tpu.algorithms.base import DriftAlgorithm, register_algorithm
+from feddrift_tpu.comm import multihost
 from feddrift_tpu.config import DEFAULT_DELTAS, DRIFTSURF_DELTAS
 from feddrift_tpu.data.retrain import time_weights
 
@@ -61,6 +62,7 @@ class DriftSurf(DriftAlgorithm):
         correct, _, total = self.step.acc_matrix(
             params, self.x[:, t], self.y[:, t],
             jnp.ones((1, *self._ones_feat_mask.shape[1:]), jnp.float32))
+        correct, total = multihost.fetch((correct, total))
         return float(np.asarray(correct)[0, : self.C].sum()
                      / np.asarray(total)[: self.C].sum())
 
@@ -420,14 +422,15 @@ class LegacyClusterFL(DriftAlgorithm):
         # Restrict to participating clients (n > 0): under client
         # subsampling, unsampled clients' deltas are all-zero and would
         # dilute the norm gate / feed zero rows into the similarity matrix.
+        n, client_params = multihost.fetch((n, client_params))
         part = np.where(np.asarray(n)[0, : self.C] > 0)[0]
         if len(part) < 2:
             return self.pool.params
         rows = []
         for cp_leaf, pv_leaf in zip(jax.tree_util.tree_leaves(client_params),
                                     jax.tree_util.tree_leaves(prev_params)):
-            delta = cp_leaf[0] - pv_leaf[0][None]
-            rows.append(np.asarray(delta.reshape(delta.shape[0], -1)))
+            delta = np.asarray(cp_leaf[0]) - np.asarray(pv_leaf[0])[None]
+            rows.append(delta.reshape(delta.shape[0], -1))
         dW = np.concatenate(rows, axis=1)[: self.C][part]   # [P_c, P]
         norms = np.linalg.norm(dW, axis=1)
         max_norm = float(norms.max())
@@ -461,11 +464,11 @@ class LegacyClusterFL(DriftAlgorithm):
                 wsum = n0[cl].sum()
                 if wsum <= 0:
                     continue
-                wts = jnp.asarray(n0[cl] / wsum, jnp.float32)
+                wts = (n0[cl] / wsum).astype(np.float32)
                 def avg(leaf):
-                    sel = leaf[0][jnp.asarray(cl)]
+                    sel = np.asarray(leaf[0])[cl]   # fetched host copies
                     wb = wts.reshape((-1,) + (1,) * (sel.ndim - 1))
-                    return (sel * wb).sum(axis=0)
+                    return jnp.asarray((sel * wb).sum(axis=0))
                 merged = jax.tree_util.tree_map(avg, client_params)
                 self.pool.set_slot(m_idx, merged)
             self._sync_weights()

@@ -47,6 +47,29 @@ def _add_run_args(p: argparse.ArgumentParser) -> None:
                    help="force a JAX platform (e.g. 'cpu'); must be applied "
                         "before backend init, which env vars can't do when "
                         "jax was pre-imported (tests/conftest.py note)")
+    _add_multihost_args(p)
+
+
+def _add_multihost_args(p: argparse.ArgumentParser) -> None:
+    p.add_argument("--multihost", action="store_true",
+                   help="join a multi-controller runtime "
+                        "(jax.distributed.initialize) before building the "
+                        "experiment; the client mesh axis then spans every "
+                        "process (DCN). On TPU pods the coordinator "
+                        "auto-detects; elsewhere pass the three flags below")
+    p.add_argument("--coordinator_address", type=str, default=None,
+                   help="host:port of process 0 (non-TPU multihost)")
+    p.add_argument("--num_processes", type=int, default=None)
+    p.add_argument("--process_id", type=int, default=None)
+
+
+def _maybe_init_multihost(args: argparse.Namespace) -> None:
+    if getattr(args, "multihost", False):
+        from feddrift_tpu.comm import multihost
+        multihost.initialize(
+            coordinator_address=args.coordinator_address,
+            num_processes=args.num_processes,
+            process_id=args.process_id)
 
 
 def _cfg_from_args(args: argparse.Namespace):
@@ -73,6 +96,7 @@ def main(argv: list[str] | None = None) -> int:
     res_p.add_argument("--wandb", action="store_true")
     res_p.add_argument("--platform", type=str, default="",
                        help="force a JAX platform (e.g. 'cpu')")
+    _add_multihost_args(res_p)
 
     sub.add_parser("list", help="list algorithms / datasets / models")
 
@@ -81,6 +105,7 @@ def main(argv: list[str] | None = None) -> int:
     if getattr(args, "platform", ""):
         import jax
         jax.config.update("jax_platforms", args.platform)
+    _maybe_init_multihost(args)
 
     if args.cmd == "list":
         from feddrift_tpu.algorithms import available_algorithms

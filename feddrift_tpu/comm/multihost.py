@@ -74,3 +74,19 @@ def broadcast_sum(tree):
         return tree
     g = _gather(tree)
     return jax.tree_util.tree_map(lambda l: l.sum(axis=0), g)
+
+
+def fetch(tree):
+    """Device->host fetch of (possibly cross-process-sharded) arrays.
+
+    Single-process: plain ``jax.device_get``.  Multi-controller: a global
+    array sharded over the ``clients`` mesh axis has shards this process
+    cannot address, so ``device_get``/``np.asarray`` would raise; the
+    supported path is an allgather that materialises the full value on
+    every host (the algorithms' host-side clustering logic then runs
+    identically everywhere, keeping the SPMD programs in lockstep).
+    """
+    if jax.process_count() == 1:
+        return jax.device_get(tree)
+    from jax.experimental import multihost_utils
+    return multihost_utils.process_allgather(tree, tiled=True)

@@ -29,6 +29,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from feddrift_tpu.algorithms import algorithm_class, make_algorithm
+from feddrift_tpu.comm import multihost
 from feddrift_tpu.config import ExperimentConfig
 from feddrift_tpu.core.pool import ModelPool
 from feddrift_tpu.core.step import TrainStep, make_optimizer
@@ -98,7 +99,11 @@ class Experiment:
                 f"stream_data requires a current-step-window algorithm "
                 f"(supports_streaming); {cfg.concept_drift_algo!r} trains on "
                 f"past steps or reads the full dataset")
-        self.logger = MetricsLogger(out_dir, use_wandb)
+        # Multi-controller runs: every process computes metrics (host logic
+        # must stay in lockstep) but only the coordinator touches disk/wandb.
+        self.is_coordinator = multihost.is_coordinator()
+        self.logger = MetricsLogger(out_dir if self.is_coordinator else None,
+                                    use_wandb and self.is_coordinator)
         self.algo.bind(self.x, self.y, self.logger, self.C_pad)
         from feddrift_tpu.platform.faults import FailureDetector, FaultInjector
         self.fault_injector = (
@@ -169,14 +174,14 @@ class Experiment:
             # one bulk D2H transfer: per-array fetches each pay a host<->TPU
             # round-trip, which dominated eval time on tunneled links
             (correct, loss_sum, corr_te, loss_te), total = \
-                jax.device_get(precomputed)
+                multihost.fetch(precomputed)
         else:
             xt, yt = self.x[:, t], self.y[:, t]
             fetch = [self.step.acc_matrix(self.pool.params, xt, yt, fm)]
             if spec is None:
                 fetch.append(self.step.acc_matrix(
                     self.pool.params, xtest, ytest, fm))
-            fetched = jax.device_get(fetch)
+            fetched = multihost.fetch(fetch)
             correct, loss_sum, total = fetched[0]
             if spec is None:
                 corr_te, loss_te, _ = fetched[1]
@@ -202,7 +207,7 @@ class Experiment:
             None if spec.model_mask is None
             else jnp.asarray(spec.model_mask, jnp.float32),
             fm)
-        ec, et, el = jax.device_get((ec, et, el))
+        ec, et, el = multihost.fetch((ec, et, el))
         return self._log_metrics(t, idx, train_correct, train_loss, total,
                                  ec[:C], el[:C], et[:C])
 
@@ -441,7 +446,7 @@ class Experiment:
                 t, R - 1, None, new_params, None, n)
         with self.tracer.phase("eval"):
             C = self.C_
-            bufs, total, n = jax.device_get((bufs, total, n))
+            bufs, total, n = multihost.fetch((bufs, total, n))
             corr_tr, loss_tr, corr_te, loss_te = bufs
             for slot, r in enumerate(self.step.eval_rounds(R, freq)):
                 self.global_round = g0 + r
@@ -463,6 +468,8 @@ class Experiment:
         return os.path.join(self.out_dir or self.cfg.out_dir, "ckpt")
 
     def save_checkpoint(self, completed_iteration: int) -> None:
+        if not self.is_coordinator:
+            return        # pool params are replicated; one writer suffices
         from feddrift_tpu.utils.checkpoint import save_checkpoint
         save_checkpoint(
             self.ckpt_path(), config_json=self.cfg.to_json(),

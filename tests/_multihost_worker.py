@@ -42,53 +42,39 @@ def main() -> None:
     s = multihost.broadcast_sum(np.float32(pid + 1))
     assert float(s) == n * (n + 1) / 2, s
 
-    # the actual round program, client axis spanning both processes
-    from jax.sharding import Mesh
-
+    # The PRODUCT round loop, unmodified, client axis spanning both
+    # processes: Experiment builds the global mesh itself, algorithms fetch
+    # eval matrices through multihost.fetch, and only the coordinator
+    # writes logs/checkpoints (runner.py coordinator gating).
     from feddrift_tpu.config import ExperimentConfig
-    from feddrift_tpu.core.pool import ModelPool
-    from feddrift_tpu.core.step import TrainStep, make_optimizer
-    from feddrift_tpu.data.registry import make_dataset
-    from feddrift_tpu.models import create_model
-    from feddrift_tpu.parallel.mesh import shard_client_arrays
+    from feddrift_tpu.simulation.runner import Experiment
 
     C = len(jax.devices())            # one client per global device
-    cfg = ExperimentConfig(dataset="sea", model="fnn", train_iterations=2,
+    cfg = ExperimentConfig(dataset="sea", model="fnn",
+                           concept_drift_algo="softcluster",
+                           concept_drift_algo_arg="H_A_C_1_10_0",
+                           change_points="rand", drift_together=1,
+                           train_iterations=2, comm_round=2,
                            sample_num=32, batch_size=16, epochs=2,
                            client_num_in_total=C, client_num_per_round=C,
-                           concept_num=2, seed=0)
-    ds = make_dataset(cfg)            # same seed -> identical on every process
-    module = create_model(cfg.model, ds, cfg)
-    pool = ModelPool.create(module, jnp.asarray(ds.x[0, 0, :2]),
-                            cfg.num_models, seed=0)
-    step = TrainStep(pool.apply, make_optimizer("adam", cfg.lr, cfg.wd),
-                     cfg.batch_size, cfg.epochs, ds.num_classes)
+                           concept_num=2, seed=0, frequency_of_the_test=1)
+    exp = Experiment(cfg)             # same seed -> identical on every process
+    assert exp.is_coordinator == (pid == 0)
+    for t in range(cfg.train_iterations):
+        exp.run_iteration(t)
 
-    mesh = Mesh(np.asarray(jax.devices()), ("clients",))
-    x = shard_client_arrays(mesh, jnp.asarray(ds.x))
-    y = shard_client_arrays(mesh, jnp.asarray(ds.y))
-    M, T1, N = cfg.num_models, ds.num_steps + 1, ds.samples_per_step
-    tw = shard_client_arrays(mesh, jnp.ones((M, C, T1), jnp.float32),
-                             client_axis=1)
-    sw = shard_client_arrays(mesh, jnp.ones((M, C, N), jnp.float32),
-                             client_axis=1)
-    fm = jnp.ones((M, *ds.feature_shape), jnp.float32)
-    opt = step.init_opt_states(pool.params, M, C)
+    acc = float(exp.logger.last("Test/Acc"))
+    assert np.isfinite(acc), acc
 
-    new_params, _, _, n_arr, losses = step.train_round(
-        pool.params, opt, jax.random.PRNGKey(0), x, y, tw, sw, fm,
-        jnp.float32(1.0))
-    jax.block_until_ready(new_params)
-
-    # aggregated params are replicated: every process sees identical values
-    leaf0 = np.asarray(jax.tree_util.tree_leaves(new_params)[0])
+    # aggregated pool params are replicated: every process holds identical
+    # values, and host-side metric state stayed in lockstep
+    leaf0 = np.asarray(jax.tree_util.tree_leaves(exp.pool.params)[0])
     digest = float(np.abs(leaf0).sum())
     digests = multihost.broadcast_sum(np.float32(digest))
     assert abs(float(digests) - n * digest) < 1e-3 * max(1.0, abs(digest)), (
         digest, float(digests))
-
-    correct, _, total = step.acc_matrix(new_params, x[:, 0], y[:, 0], fm)
-    jax.block_until_ready(correct)
+    accs = multihost.broadcast_sum(np.float32(acc))
+    assert abs(float(accs) - n * acc) < 1e-5, (acc, float(accs))
     print(f"WORKER_OK {pid} digest={digest:.4f}", flush=True)
 
 

@@ -17,6 +17,8 @@ from pathlib import Path
 
 import pytest
 
+pytestmark = pytest.mark.slow   # heavy compiles: full-tier only
+
 WORKER = Path(__file__).resolve().parent / "_multihost_worker.py"
 
 

@@ -10,6 +10,8 @@ import pytest
 from feddrift_tpu.config import ExperimentConfig
 from feddrift_tpu.simulation.runner import Experiment, run_experiment
 
+pytestmark = pytest.mark.slow   # heavy compiles: full-tier only
+
 
 def _cfg(**kw):
     base = dict(dataset="sine", model="fnn", concept_num=4,
@@ -155,3 +157,54 @@ class TestHostLogic:
         exp2.algo.load_state_dict(d)
         assert np.array_equal(exp2.algo.weights, algo.weights)
         assert exp2.algo.h_next_free == algo.h_next_free
+
+
+class TestSoftClusterCFL:
+    """The cfl_{gamma}_{rt} variant: gradient-norm gated bipartition inside
+    the round loop (cluster_cfl, FedAvgEnsDataLoader.py:1159-1223)."""
+
+    def test_cfl_e2e_runs_and_partitions(self):
+        exp = run_experiment(_cfg(concept_drift_algo_arg="cfl_0.1_win-1",
+                                  train_iterations=2, comm_round=3))
+        accs = [v for _, v in exp.logger.series("Test/Acc")]
+        assert accs and np.isfinite(accs).all()
+        # win-1 retrain zeroes past steps; the CURRENT step must partition
+        np.testing.assert_allclose(exp.algo.weights[1].sum(axis=0), 1.0,
+                                   atol=1e-5)
+
+    def test_cfl_round_splits_on_crafted_updates(self):
+        """Direct exercise of _cluster_cfl_round: two client blocks pushing
+        in opposite directions with a tiny mean update must bipartition once
+        the norm gate opens."""
+        import jax
+        import jax.numpy as jnp
+        exp = Experiment(_cfg(concept_drift_algo_arg="cfl_0.05_win-1",
+                              train_iterations=2, comm_round=3,
+                              client_num_in_total=8, client_num_per_round=8))
+        algo = exp.algo
+        algo.begin_iteration(0)
+        prev = exp.pool.params
+        C_pad = exp.C_pad
+
+        def crafted_with_signs(signs):
+            def crafted(leaf):
+                u = jnp.ones_like(leaf[0])
+                sb = jnp.asarray(signs).reshape(
+                    (-1,) + (1,) * leaf[0].ndim)
+                return leaf[:, None, ...] + sb[None] * u[None, None] * 10.0
+            return jax.tree_util.tree_map(crafted, prev)
+        n = jnp.ones((algo.M, C_pad), jnp.float32) * 50.0
+
+        # round 1: coherent updates (all +u) arm the norm gate: cfl_norm
+        # jumps, eps1 = norm/10, eps2 = 0.6*norm
+        algo._cluster_cfl_round(0, 1, prev,
+                                crafted_with_signs([1.0] * C_pad), n)
+        assert algo.cfl_norm > 0
+        # round 2: opposite halves -> mean ~0 < eps1, per-client max > eps2
+        did = algo._cluster_cfl_round(
+            0, 2, prev,
+            crafted_with_signs([1.0] * 4 + [-1.0] * (C_pad - 4)), n)
+        assert did, (algo.cfl_norm, algo.cfl_eps1, algo.cfl_eps2)
+        w = algo.weights[0]
+        assert set(np.argmax(w, axis=0)[:8].tolist()) == {0, 1}
+        np.testing.assert_allclose(w.sum(axis=0), 1.0, atol=1e-5)
