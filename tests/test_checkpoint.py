@@ -6,6 +6,9 @@ import numpy as np
 
 from feddrift_tpu.config import ExperimentConfig
 from feddrift_tpu.simulation.runner import Experiment
+import pytest
+
+pytestmark = pytest.mark.slow   # heavy compiles: full-tier only
 
 
 def _cfg(**kw):

@@ -8,9 +8,12 @@ is a generated --flag, and the packed algo-arg strings parse unchanged.
 import json
 import os
 
+import pytest
+
 from feddrift_tpu.cli import main
 
 
+@pytest.mark.slow
 class TestCli:
     def test_list(self, capsys):
         assert main(["list"]) == 0

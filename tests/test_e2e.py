@@ -7,6 +7,9 @@ fixed seeds, assert accuracy trajectories.
 
 from feddrift_tpu.config import ExperimentConfig
 from feddrift_tpu.simulation.runner import run_experiment
+import pytest
+
+pytestmark = pytest.mark.slow   # heavy compiles: full-tier only
 
 
 def _cfg(**kw):

@@ -2,6 +2,9 @@
 
 import sys
 from pathlib import Path
+import pytest
+
+pytestmark = pytest.mark.slow   # heavy compiles: full-tier only
 
 sys.path.insert(0, str(Path(__file__).resolve().parent.parent / "examples"))
 

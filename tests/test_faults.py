@@ -11,6 +11,8 @@ from feddrift_tpu.config import ExperimentConfig
 from feddrift_tpu.platform.faults import FailureDetector, FaultInjector
 from feddrift_tpu.simulation.runner import run_experiment
 
+pytestmark = pytest.mark.slow   # heavy compiles: full-tier only
+
 
 class TestFaultInjector:
     def test_deterministic_masks(self):

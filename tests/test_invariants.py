@@ -7,6 +7,8 @@ from feddrift_tpu.utils.invariants import (InvariantError,
                                            check_round_inputs,
                                            check_weight_partition)
 
+pytestmark = pytest.mark.slow   # heavy compiles: full-tier only
+
 
 class TestCheckRoundInputs:
     def _ok(self):

@@ -21,7 +21,8 @@ class TestModels:
         ("lr", "sea", (4, 3)),
         ("fnn", "sea", (4, 3)),
         ("cnn", "MNIST", (4, 784)),
-        ("resnet20", "cifar10", (4, 32, 32, 3)),
+        pytest.param("resnet20", "cifar10", (4, 32, 32, 3),
+                     marks=pytest.mark.slow),
         ("resnet8", "cifar10", (4, 32, 32, 3)),
     ])
     def test_forward_shapes(self, name, dataset, xshape):
@@ -40,6 +41,7 @@ class TestModels:
         out = mod.apply({"params": params}, x)
         assert out.shape == (2, 90)
 
+    @pytest.mark.slow
     @pytest.mark.parametrize("name", ["mobilenet", "mobilenet_gn", "densenet"])
     def test_cv_zoo_forward(self, name):
         ds, cfg = _ds("cifar10")
@@ -49,6 +51,7 @@ class TestModels:
         out = mod.apply({"params": params}, x)
         assert out.shape == (2, ds.num_classes)
 
+    @pytest.mark.slow
     def test_darts_forward_and_arch_split(self):
         from feddrift_tpu.models.darts import split_arch_params
         ds, cfg = _ds("cifar10")

@@ -67,6 +67,7 @@ class TestNativeGenerator:
         flip_rate = (y0 != y1).mean()
         assert 0.4 < flip_rate < 0.6, flip_rate
 
+    @pytest.mark.slow
     def test_e2e_training_on_native_data(self):
         from feddrift_tpu.config import ExperimentConfig
         from feddrift_tpu.simulation.runner import Experiment

@@ -3,6 +3,7 @@
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 import optax
 
 
@@ -95,6 +96,7 @@ class TestGkt:
         acc = float((np.asarray(logits).argmax(-1) == y).mean())
         assert acc > 0.85, acc
 
+    @pytest.mark.slow
     def test_resnet8_split_round_runs(self):
         # the reference-shaped split: resnet8 trunk -> feature maps -> server
         # tail (tiny server_depth to keep single-core compile cheap)

@@ -11,6 +11,8 @@ import numpy as np
 import pytest
 from jax.sharding import Mesh, PartitionSpec as P
 
+pytestmark = pytest.mark.slow   # heavy compiles: full-tier only
+
 
 def naive_attention(q, k, v, causal=True):
     D = q.shape[-1]

@@ -1,6 +1,7 @@
 """TrainStep unit tests: masking, aggregation math, batched eval."""
 
 import jax
+import pytest
 import jax.numpy as jnp
 import numpy as np
 
@@ -33,6 +34,7 @@ def _leafdiff(a, b):
                                  jax.tree_util.tree_leaves(b)))
 
 
+@pytest.mark.slow
 class TestTrainRound:
     def test_unused_models_untouched(self):
         cfg, ds, pool, step, x, y, opt, sw, fm = _setup()

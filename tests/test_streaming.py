@@ -7,6 +7,8 @@ import pytest
 from feddrift_tpu.config import ExperimentConfig
 from feddrift_tpu.simulation.runner import Experiment, run_experiment
 
+pytestmark = pytest.mark.slow   # heavy compiles: full-tier only
+
 
 def _cfg(**kw):
     base = dict(dataset="sine", model="fnn", concept_drift_algo="win-1",

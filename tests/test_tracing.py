@@ -3,6 +3,9 @@
 import time
 
 import numpy as np
+import pytest
+
+pytestmark = pytest.mark.slow   # heavy compiles: full-tier only
 
 
 class TestPhaseTracer:
