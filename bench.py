@@ -1,6 +1,6 @@
 """Benchmark: FedDrift canonical config throughput on real hardware.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
 
 Config: the reference's canonical run (README.md:46-50): SEA-4, 10 clients,
 fnn, 200 rounds x 5 local steps per time step, batch 500, lr 0.01, 500
@@ -8,63 +8,75 @@ samples/client/step. We measure steady-state communication-round throughput
 (train_round + the periodic eval), which is the quantity the reference logs
 per round ("aggregate time cost", FedAvgEnsAggregatorSoftCluster.py:193-194).
 
-Baseline: the reference publishes no numbers (BASELINE.md). Its round time is
-bounded below by its 0.3 s communication polling alone
-(mpi_send_thread.py:29, com_manager.py:78) plus pickling M state_dicts per
-client and serial M x C evaluation; we take 1.0 rounds/s as a *generous*
-reference estimate on its 4-GPU setup, and report vs_baseline against it.
+Baseline: the reference publishes no numbers (BASELINE.md), so
+``vs_baseline`` is measured, not assumed: before the timed run we execute
+the same canonical config on THIS HOST's CPU through the per-round
+dispatch path (cfg.chunk_rounds=False — one host->device dispatch and one
+eval fetch per round, the closest shape to the reference's per-round
+message loop) for a short sample and extrapolate rounds/s.  The reported
+ratio is therefore "device fused path vs this host's CPU per-round path";
+it is an intra-framework speedup, NOT a measured reference-GPU comparison.
 Run with --smoke for a fast CI-sized check.
 """
 
 from __future__ import annotations
 
 import json
+import os
 import sys
 import time
 
 import jax
 
-REFERENCE_ROUNDS_PER_SEC = 1.0  # generous estimate; see module docstring
+# TPU v5 lite (v5e) peak: ~197 TFLOP/s bf16, ~98 TFLOP/s f32 per chip.
+PEAK_FLOPS = {"tpu": {"bfloat16": 197e12, "float32": 98e12},
+              "cpu": {"bfloat16": 5e10, "float32": 1e11}}
 
 
-def _probe_backend(timeout_s: float = 90.0) -> str:
-    """Return the usable backend name, falling back to CPU if the default
-    backend is unreachable.
+def _probe_backend(attempts: int = 3, timeout_s: float = 120.0):
+    """Return (usable backend name, probe diagnosis list).
 
     The axon TPU tunnel can hang indefinitely at client creation when the
-    remote side is unhealthy; a hung benchmark records nothing. The probe
+    remote side is unhealthy; a hung benchmark records nothing. Each probe
     runs in a SUBPROCESS (an in-process thread would wedge this process:
     backend creation holds jax's global init lock, so once a thread hangs in
-    it no other thread can create any backend). On timeout the main process
-    — which has not initialized any backend yet — pins the CPU platform.
+    it no other thread can create any backend). The tunnel also flakes
+    transiently, so we retry before falling back. On timeout the main
+    process — which has not initialized any backend yet — pins the CPU
+    platform; the per-attempt diagnosis is returned for the bench JSON.
     """
     import subprocess
 
-    why = f"probe timed out after {timeout_s:.0f}s"
-    try:
-        out = subprocess.run(
-            [sys.executable, "-c",
-             "import jax, jax.numpy as jnp;"
-             "jax.block_until_ready(jnp.ones((8, 8)) @ jnp.ones((8, 8)));"
-             "print(jax.default_backend())"],
-            capture_output=True, text=True, timeout=timeout_s)
-        if out.returncode == 0 and out.stdout.strip():
-            return out.stdout.strip().splitlines()[-1]
-        why = (f"probe exited {out.returncode}: "
-               + (out.stderr or "").strip()[-500:])
-    except subprocess.TimeoutExpired:
-        pass
+    diagnosis = []
+    for i in range(attempts):
+        t0 = time.time()
+        try:
+            out = subprocess.run(
+                [sys.executable, "-c",
+                 "import jax, jax.numpy as jnp;"
+                 "jax.block_until_ready(jnp.ones((8, 8)) @ jnp.ones((8, 8)));"
+                 "print(jax.default_backend())"],
+                capture_output=True, text=True, timeout=timeout_s)
+            if out.returncode == 0 and out.stdout.strip():
+                backend = out.stdout.strip().splitlines()[-1]
+                diagnosis.append(f"attempt {i}: ok ({backend}, "
+                                 f"{time.time() - t0:.0f}s)")
+                return backend, diagnosis
+            diagnosis.append(
+                f"attempt {i}: exited {out.returncode}: "
+                + (out.stderr or "").strip()[-300:])
+        except subprocess.TimeoutExpired:
+            diagnosis.append(f"attempt {i}: timed out after {timeout_s:.0f}s")
     jax.config.update("jax_platforms", "cpu")
-    print(json.dumps({"warning": f"default backend unreachable ({why}); "
-                      "benchmarking on CPU fallback"}),
-          file=sys.stderr)
-    return "cpu-fallback"
+    print(json.dumps({"warning": "default backend unreachable; "
+                      "benchmarking on CPU fallback",
+                      "probe": diagnosis}), file=sys.stderr)
+    return "cpu-fallback", diagnosis
 
 
 def _enable_compile_cache() -> None:
     """Persist compiled executables across processes (~20-40s saved per
     program on repeat benchmark runs; cache is keyed by platform + HLO)."""
-    import os
     d = os.path.join(os.path.dirname(os.path.abspath(__file__)), ".jax_cache")
     try:
         jax.config.update("jax_compilation_cache_dir", d)
@@ -74,21 +86,11 @@ def _enable_compile_cache() -> None:
               file=sys.stderr)
 
 
-def main() -> None:
-    smoke = "--smoke" in sys.argv
-    backend = _probe_backend()
-    _enable_compile_cache()
-
+def _canonical_cfg(smoke: bool, **overrides):
     from feddrift_tpu.config import ExperimentConfig
-    from feddrift_tpu.simulation.runner import Experiment
 
-    algo = "softcluster"
-    from feddrift_tpu.algorithms import available_algorithms
-    if "softcluster" not in available_algorithms():
-        algo = "win-1"   # pre-softcluster fallback
-
-    cfg = ExperimentConfig(
-        dataset="sea", model="fnn", concept_drift_algo=algo,
+    base = dict(
+        dataset="sea", model="fnn", concept_drift_algo="softcluster",
         concept_drift_algo_arg="H_A_C_1_10_0", concept_num=4,
         change_points="A",
         client_num_in_total=10, client_num_per_round=10,
@@ -96,8 +98,86 @@ def main() -> None:
         comm_round=20 if smoke else 200,
         epochs=5, batch_size=500, sample_num=100 if smoke else 500,
         lr=0.01, frequency_of_the_test=10,
-        report_client=0,
-    )
+        report_client=0)
+    base.update(overrides)
+    return ExperimentConfig(**base)
+
+
+def _flops_per_round(exp) -> float:
+    """Analytic round-FLOPs estimate for the MFU line.
+
+    Dense-model forward ~= 2 FLOPs per param per sample; backward ~= 2x
+    forward. Per round: M x C local trainers each run `epochs` SGD steps on
+    a `batch_size` batch. Eval matrices add M x C full-step inferences every
+    frequency_of_the_test rounds (amortised in).
+    """
+    import numpy as np
+    cfg, ds = exp.cfg, exp.ds
+    n_params = sum(int(np.prod(l.shape[1:]))   # leading M axis excluded
+                   for l in jax.tree_util.tree_leaves(exp.pool.params))
+    M, C = exp.pool.num_models, cfg.client_num_in_total
+    train = M * C * cfg.epochs * cfg.batch_size * (2 * n_params) * 3
+    eval_amortised = (M * C * ds.samples_per_step * (2 * n_params)
+                     / max(cfg.frequency_of_the_test, 1))
+    return float(train + eval_amortised)
+
+
+def _measure_cpu_baseline(smoke: bool) -> float | None:
+    """Rounds/s of the canonical config on this host's CPU through the
+    PER-ROUND dispatch path (chunk_rounds=False) — the measured stand-in
+    for the reference's per-round message loop. Runs in a subprocess so the
+    main process's backend choice (TPU) is untouched."""
+    import subprocess
+
+    code = (
+        "import jax, json, time;"
+        "jax.config.update('jax_platforms', 'cpu');"
+        "import bench;"
+        "bench._enable_compile_cache();"
+        "from feddrift_tpu.simulation.runner import Experiment;"
+        f"cfg = bench._canonical_cfg({smoke}, train_iterations=3, "
+        "comm_round=20, chunk_rounds=False);"
+        "exp = Experiment(cfg);"
+        # warm-up t=0 AND t=1: t>=1 is the first trace of the acc_cells /
+        # merge path (same reason the main measurement starts at t=2)
+        "exp.run_iteration(0); exp.run_iteration(1);"
+        "t0 = time.time(); exp.run_iteration(2);"
+        "jax.block_until_ready(exp.pool.params);"
+        "print(json.dumps({'rps': cfg.comm_round / (time.time() - t0)}))")
+    try:
+        out = subprocess.run([sys.executable, "-c", code],
+                             capture_output=True, text=True, timeout=1200,
+                             cwd=os.path.dirname(os.path.abspath(__file__)))
+        for line in reversed(out.stdout.strip().splitlines()):
+            try:
+                return float(json.loads(line)["rps"])
+            except (json.JSONDecodeError, KeyError):
+                continue
+        print(json.dumps({"warning": "cpu baseline produced no number",
+                          "stderr": (out.stderr or "")[-300:]}),
+              file=sys.stderr)
+    except subprocess.TimeoutExpired:
+        print(json.dumps({"warning": "cpu baseline timed out"}),
+              file=sys.stderr)
+    return None
+
+
+def main() -> None:
+    smoke = "--smoke" in sys.argv
+    if "--cpu" in sys.argv:       # explicit local run: skip the probe wait
+        jax.config.update("jax_platforms", "cpu")
+        backend, probe_diag = "cpu-forced", ["--cpu flag"]
+    else:
+        backend, probe_diag = _probe_backend()
+    _enable_compile_cache()
+
+    # Measured baseline (see module docstring). Skipped under --smoke (the
+    # CI-sized check must stay fast; vs_baseline is reported null there).
+    baseline_rps = None if smoke else _measure_cpu_baseline(smoke)
+
+    from feddrift_tpu.simulation.runner import Experiment
+
+    cfg = _canonical_cfg(smoke)
     exp = Experiment(cfg)
 
     # Warm-up: run time steps 0 AND 1 fully — t=0 takes the cluster_init
@@ -115,18 +195,31 @@ def main() -> None:
     rounds = cfg.comm_round * (cfg.train_iterations - 2)
     rps = rounds / elapsed
 
+    dtype = cfg.compute_dtype if backend == "tpu" else "float32"
+    peak = PEAK_FLOPS["tpu" if backend == "tpu" else "cpu"][dtype]
+    mfu = _flops_per_round(exp) * rps / peak
+
     final_acc = exp.logger.last("Test/Acc")
-    print(json.dumps({
-        "metric": f"FedDrift SEA-4 round throughput ({algo}, 10 clients, "
-                  f"M=4, fnn, batch 500)",
+    out = {
+        "metric": f"FedDrift SEA-4 round throughput (softcluster, "
+                  f"10 clients, M=4, fnn, batch 500)",
         "value": round(rps, 3),
         "unit": "rounds/s",
-        "vs_baseline": round(rps / REFERENCE_ROUNDS_PER_SEC, 3),
+        "vs_baseline": (round(rps / baseline_rps, 3)
+                        if baseline_rps else None),
+        "baseline": ({"rounds_per_sec": round(baseline_rps, 3),
+                      "what": "same config, this host CPU, per-round "
+                              "dispatch path (reference-shaped)"}
+                     if baseline_rps else None),
         "final_test_acc": round(float(final_acc), 4),
         "wall_s": round(elapsed, 2),
         "rounds": rounds,
         "backend": backend,
-    }))
+        "probe": probe_diag,
+        "mfu_estimate": round(mfu, 6),
+        "phases": getattr(exp, "last_phase_summary", None),
+    }
+    print(json.dumps(out))
 
 
 if __name__ == "__main__":

@@ -28,6 +28,12 @@ class WindowBaseline(DriftAlgorithm):
         spec = cfg.retrain_data
         if cfg.concept_drift_algo in ("win-1", "all"):
             spec = cfg.concept_drift_algo
+        elif cfg.concept_drift_algo == "oblivious":
+            # the paper's drift-oblivious baseline: ONE model on ALL data
+            # (cont_one with retrain_data=all); without this it would fall
+            # back to cfg.retrain_data's win-1 default and silently equal
+            # the win-1 baseline
+            spec = "all"
         self.spec = spec
         self._tw = None
         # win-1 trains on the current step only -> streamable

@@ -1,0 +1,56 @@
+"""Single-model baseline semantics (fast tier): retrain-window specs per
+algorithm name (reference cont_one retrain_data arg,
+run_fedavg_distributed_pytorch.sh:21)."""
+
+import numpy as np
+
+from feddrift_tpu.config import ExperimentConfig
+from feddrift_tpu.core.pool import ModelPool
+from feddrift_tpu.core.step import TrainStep, make_optimizer
+from feddrift_tpu.data.registry import make_dataset
+from feddrift_tpu.models import create_model
+
+
+def _algo(name, **kw):
+    import jax.numpy as jnp
+    from feddrift_tpu.algorithms import make_algorithm
+    cfg = ExperimentConfig(dataset="sea", model="fnn", concept_drift_algo=name,
+                           train_iterations=3, sample_num=8, batch_size=4,
+                           client_num_in_total=2, client_num_per_round=2, **kw)
+    ds = make_dataset(cfg)
+    module = create_model(cfg.model, ds, cfg)
+    pool = ModelPool.create(module, jnp.asarray(ds.x[0, 0, :2]),
+                            cfg.num_models, seed=0)
+    step = TrainStep(pool.apply, make_optimizer("adam", cfg.lr, cfg.wd),
+                     cfg.batch_size, cfg.epochs, ds.num_classes)
+    return make_algorithm(cfg, ds, pool, step)
+
+
+def _weights_at(algo, t):
+    algo.begin_iteration(t)
+    tw = np.asarray(algo.round_inputs(t, 0)[0])   # [1, C, T1]
+    return tw[0, 0]                               # client 0's time weights
+
+
+def test_win1_trains_on_current_step_only():
+    w = _weights_at(_algo("win-1"), 2)
+    assert w[2] > 0 and w[:2].sum() == 0 and w[3:].sum() == 0
+
+
+def test_oblivious_trains_on_all_past_steps():
+    """'oblivious' is the paper's drift-oblivious baseline: ONE model on ALL
+    data — it must NOT inherit cfg.retrain_data's win-1 default (that bug
+    made oblivious == win-1 trajectories bitwise-identical)."""
+    w = _weights_at(_algo("oblivious"), 2)
+    assert (w[:3] > 0).all() and w[3:].sum() == 0
+
+
+def test_all_equals_oblivious_window():
+    wa = _weights_at(_algo("all"), 2)
+    wo = _weights_at(_algo("oblivious"), 2)
+    np.testing.assert_array_equal(wa, wo)
+
+
+def test_window_respects_retrain_data():
+    w = _weights_at(_algo("window", retrain_data="win-2"), 2)
+    assert (w[1:3] > 0).all() and w[0] == 0
