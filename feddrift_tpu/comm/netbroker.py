@@ -1,0 +1,187 @@
+"""TCP network binding for the pub/sub transport.
+
+The reference's MQTT manager speaks to a real broker over the network
+(fedml_core/distributed/communication/mqtt/mqtt_comm_manager.py:14-135,
+default broker.emqx.io:1883). `comm/pubsub.py` keeps the topic/JSON wire
+semantics in-process; this module provides the actual network hop with the
+SAME ``Broker`` interface (subscribe/publish/unsubscribe), so
+``PubSubCommManager(NetworkBrokerClient(...), rank)`` is a drop-in swap for
+``PubSubCommManager(Broker(), rank)``.
+
+Protocol: newline-delimited JSON frames over TCP (stdlib-only; no
+paho-mqtt in this environment, and the control-plane traffic — model-free
+coordination messages — does not need MQTT QoS machinery):
+
+    client -> broker:  {"op": "sub"|"unsub", "topic": str}
+                       {"op": "pub", "topic": str, "payload": str}
+    broker -> client:  {"topic": str, "payload": str}
+
+This is control-plane transport only: array state rides XLA collectives
+(comm/multihost.py); like the reference's MQTT path, this exists for
+loosely-coupled deployments (mobile/cross-silo clients, serving).
+"""
+
+from __future__ import annotations
+
+import json
+import queue
+import socket
+import threading
+from collections import defaultdict
+
+
+class NetworkBroker:
+    """The broker process: accepts clients, routes topic publishes."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0) -> None:
+        self._srv = socket.create_server((host, port))
+        self.host, self.port = self._srv.getsockname()[:2]
+        self._subs: dict[str, list[socket.socket]] = defaultdict(list)
+        self._conns: set[socket.socket] = set()
+        self._lock = threading.Lock()
+        self._closed = False
+        self._accept = threading.Thread(target=self._accept_loop, daemon=True)
+        self._accept.start()
+
+    # -- broker internals ----------------------------------------------
+    def _accept_loop(self) -> None:
+        while not self._closed:
+            try:
+                conn, _ = self._srv.accept()
+            except OSError:
+                return                      # server socket closed
+            with self._lock:
+                self._conns.add(conn)
+            threading.Thread(target=self._serve, args=(conn,),
+                             daemon=True).start()
+
+    def _serve(self, conn: socket.socket) -> None:
+        f = conn.makefile("r", encoding="utf-8")
+        try:
+            for line in f:
+                try:
+                    d = json.loads(line)
+                except json.JSONDecodeError:
+                    continue                # tolerate garbage frames
+                op, topic = d.get("op"), d.get("topic")
+                if op == "sub":
+                    with self._lock:
+                        if conn not in self._subs[topic]:
+                            self._subs[topic].append(conn)
+                elif op == "unsub":
+                    with self._lock:
+                        if conn in self._subs.get(topic, ()):
+                            self._subs[topic].remove(conn)
+                elif op == "pub":
+                    frame = (json.dumps({"topic": topic,
+                                         "payload": d.get("payload", "")})
+                             + "\n").encode()
+                    # snapshot under the lock, send OUTSIDE it: payloads are
+                    # full model params, and one stalled subscriber's full
+                    # TCP buffer must not wedge every other connection on
+                    # the broker lock (the in-process Broker's under-lock
+                    # puts are safe only because queue puts cannot block,
+                    # pubsub.py)
+                    with self._lock:
+                        targets = list(self._subs.get(topic, ()))
+                    dead = []
+                    for c in targets:
+                        try:
+                            c.sendall(frame)
+                        except OSError:     # dead subscriber: drop it
+                            dead.append(c)
+                    if dead:
+                        with self._lock:
+                            for c in dead:
+                                if c in self._subs.get(topic, ()):
+                                    self._subs[topic].remove(c)
+        finally:
+            with self._lock:
+                for subs in self._subs.values():
+                    if conn in subs:
+                        subs.remove(conn)
+                self._conns.discard(conn)
+            conn.close()
+
+    def close(self) -> None:
+        self._closed = True
+        try:
+            self._srv.close()
+        except OSError:
+            pass
+        with self._lock:
+            conns = list(self._conns)
+        for c in conns:                     # unblock _serve readlines
+            try:
+                c.close()
+            except OSError:
+                pass
+
+
+class NetworkBrokerClient:
+    """Client-side endpoint exposing the in-process ``Broker`` interface
+    (pubsub.Broker): subscribe(topic) -> Queue, publish, unsubscribe."""
+
+    def __init__(self, host: str, port: int, timeout: float = 10.0) -> None:
+        self._sock = socket.create_connection((host, port), timeout=timeout)
+        self._sock.settimeout(None)
+        self._wlock = threading.Lock()
+        self._queues: dict[str, list[queue.Queue]] = defaultdict(list)
+        self._qlock = threading.Lock()
+        self._reader = threading.Thread(target=self._read_loop, daemon=True)
+        self._reader.start()
+
+    def _send(self, obj: dict) -> None:
+        data = (json.dumps(obj) + "\n").encode()
+        with self._wlock:
+            self._sock.sendall(data)
+
+    def _read_loop(self) -> None:
+        f = self._sock.makefile("r", encoding="utf-8")
+        try:
+            for line in f:
+                try:
+                    d = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                with self._qlock:
+                    qs = list(self._queues.get(d.get("topic"), ()))
+                for q in qs:
+                    q.put(d.get("payload", ""))
+        except (OSError, ValueError):
+            pass                            # socket closed
+
+    # -- Broker interface ----------------------------------------------
+    # sub/unsub hold _qlock ACROSS the state change and the frame write:
+    # releasing between them would let a racing subscribe/unsubscribe pair
+    # reorder their frames and leave the broker unsubscribed while a live
+    # local queue exists. Lock order is always _qlock -> _wlock; the read
+    # loop takes only _qlock, so no cycle.
+    def subscribe(self, topic: str) -> queue.Queue:
+        q: queue.Queue = queue.Queue()
+        with self._qlock:
+            first = not self._queues[topic]
+            self._queues[topic].append(q)
+            if first:
+                self._send({"op": "sub", "topic": topic})
+        return q
+
+    def publish(self, topic: str, payload: str) -> None:
+        self._send({"op": "pub", "topic": topic, "payload": payload})
+
+    def unsubscribe(self, topic: str, q: queue.Queue) -> None:
+        with self._qlock:
+            subs = self._queues.get(topic, [])
+            if q in subs:
+                subs.remove(q)
+            if not subs:
+                self._queues.pop(topic, None)
+                try:
+                    self._send({"op": "unsub", "topic": topic})
+                except OSError:
+                    pass                    # broker already gone
+    def close(self) -> None:
+        try:
+            self._sock.close()
+        except OSError:
+            pass

@@ -6,8 +6,10 @@ The reference publishes JSON-serialized messages to a public broker
 in-process object with the same topic semantics and the same JSON wire
 constraint — payloads must survive JSON round-trips (lists/floats, not live
 arrays), which is exactly the MQTT manager's contract and what a real broker
-binding would need. Swapping in a network broker means reimplementing
-``Broker`` only; managers and message schema stay untouched.
+binding would need. The actual network binding lives in
+``comm/netbroker.py`` (TCP, newline-delimited JSON frames): it exposes this
+module's ``Broker`` interface, so managers and message schema run unchanged
+over a real socket.
 """
 
 from __future__ import annotations

@@ -1,0 +1,99 @@
+"""TCP pub/sub binding: PubSubCommManager over an actual network socket
+(reference MQTT manager, mqtt_comm_manager.py:14-135)."""
+
+import queue
+
+import numpy as np
+
+from feddrift_tpu.comm.message import Message
+from feddrift_tpu.comm.netbroker import NetworkBroker, NetworkBrokerClient
+from feddrift_tpu.comm.pubsub import PubSubCommManager
+
+
+def _sync(client, topic="__sync__"):
+    """Wait until the broker has processed this client's subscriptions:
+    publish to a private topic and wait for the loopback (the MQTT
+    SUBACK-analog; frames per connection are processed in order)."""
+    q = client.subscribe(topic)
+    client.publish(topic, "ready")
+    assert q.get(timeout=5) == "ready"
+    client.unsubscribe(topic, q)
+
+
+def test_pub_sub_roundtrip_over_tcp():
+    broker = NetworkBroker()
+    try:
+        a = NetworkBrokerClient(broker.host, broker.port)
+        b = NetworkBrokerClient(broker.host, broker.port)
+        qa = a.subscribe("t")
+        _sync(a)
+        b.publish("t", "hello")
+        assert qa.get(timeout=5) == "hello"
+        # unsubscribed clients stop receiving
+        a.unsubscribe("t", qa)
+        _sync(a)
+        b.publish("t", "again")
+        try:
+            got = qa.get(timeout=0.3)
+            raise AssertionError(f"received after unsubscribe: {got}")
+        except queue.Empty:
+            pass
+        a.close(); b.close()
+    finally:
+        broker.close()
+
+
+def test_comm_manager_over_network_broker():
+    """The SAME PubSubCommManager used with the in-process broker runs
+    unchanged over TCP, arrays surviving the JSON wire."""
+    broker = NetworkBroker()
+    try:
+        m0 = PubSubCommManager(NetworkBrokerClient(broker.host, broker.port), 0)
+        m1 = PubSubCommManager(NetworkBrokerClient(broker.host, broker.port), 1)
+        _sync(m0.broker); _sync(m1.broker)
+
+        got = []
+
+        class Obs:
+            def receive_message(self, msg_type, msg):
+                got.append(msg)
+
+        m1.add_observer(Obs())
+        m1.run_async()
+        params = {"w": np.arange(6, dtype=np.float32).reshape(2, 3), "n": 7}
+        m0.send_message(Message(3, 0, 1, params))
+        import time
+        for _ in range(100):
+            if got:
+                break
+            time.sleep(0.05)
+        assert got, "message never delivered over TCP"
+        msg = got[0]
+        assert msg.msg_type == 3 and msg.sender_id == 0
+        np.testing.assert_allclose(np.asarray(msg.params["w"]),
+                                   params["w"])
+        assert msg.params["n"] == 7
+        m1.stop_receive_message()
+        m0.broker.close()
+        m1.broker.close()
+    finally:
+        broker.close()
+
+
+def test_dead_subscriber_does_not_break_broker():
+    broker = NetworkBroker()
+    try:
+        a = NetworkBrokerClient(broker.host, broker.port)
+        b = NetworkBrokerClient(broker.host, broker.port)
+        qa = a.subscribe("t")
+        _sync(a)
+        a.close()                       # dies while subscribed
+        _sync(b)
+        b.publish("t", "x")             # must not wedge the broker
+        qb = b.subscribe("t")
+        _sync(b)
+        b.publish("t", "y")
+        assert qb.get(timeout=5) == "y"
+        b.close()
+    finally:
+        broker.close()
