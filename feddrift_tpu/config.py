@@ -75,6 +75,11 @@ class ExperimentConfig:
     # scaled down so the dense [C, T, N, F] array stays small — data/tabular.py)
     so_vocab_size: int = 1000
     so_tag_size: int = 50
+    text_seq_len: int = 80             # char-dataset sequence length
+                                       # (reference LEAF shakespeare: 80 =
+                                       # data/text.py SEQ_LEN; shrink for CPU
+                                       # smokes — the drift semantics are
+                                       # length-independent)
 
     # --- reproducibility & numerics -------------------------------------
     seed: int = 0                      # reference --dummy_arg (main_fedavg.py:292-298)

@@ -75,7 +75,8 @@ def _mk_fmow(cfg: ExperimentConfig, change_points: np.ndarray) -> DriftDataset:
 def _mk_text(cfg: ExperimentConfig, change_points: np.ndarray) -> DriftDataset:
     return generate_text_drift(
         change_points, cfg.train_iterations, cfg.client_num_in_total,
-        cfg.sample_num, cfg.noise_prob, cfg.time_stretch, cfg.seed)
+        cfg.sample_num, cfg.noise_prob, cfg.time_stretch, cfg.seed,
+        seq_len=cfg.text_seq_len)
 
 
 @register_dataset("susy", "ro")
@@ -98,6 +99,8 @@ def _mk_so_lr(cfg: ExperimentConfig, change_points: np.ndarray) -> DriftDataset:
 
 @register_dataset("stackoverflow", "stackoverflow_nwp")
 def _mk_word(cfg: ExperimentConfig, change_points: np.ndarray) -> DriftDataset:
+    # word-NWP keeps its own default seq len (reference StackOverflow
+    # windows are ~20 tokens); cfg.text_seq_len governs the char datasets
     return generate_word_drift(
         change_points, cfg.train_iterations, cfg.client_num_in_total,
         cfg.sample_num, cfg.noise_prob, cfg.time_stretch, cfg.seed)
