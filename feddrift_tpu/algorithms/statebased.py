@@ -18,7 +18,7 @@ import jax.numpy as jnp
 from feddrift_tpu.algorithms.base import DriftAlgorithm, register_algorithm
 from feddrift_tpu.comm import multihost
 from feddrift_tpu.config import DEFAULT_DELTAS, DRIFTSURF_DELTAS
-from feddrift_tpu.data.retrain import time_weights
+from feddrift_tpu.data.retrain import is_retrain_spec, time_weights
 
 
 @register_algorithm("driftsurf")
@@ -383,7 +383,14 @@ class LegacyClusterFL(DriftAlgorithm):
 
     def __init__(self, cfg, ds, pool, step) -> None:
         super().__init__(cfg, ds, pool, step)
-        self.retrain = cfg.concept_drift_algo_arg or "win-1"
+        # arg = a retrain-window spec; other algorithms' packed strings
+        # (e.g. the config default "H_A_C_1_10_0") are meaningless here, so
+        # anything outside time_weights' grammar falls back to win-1 rather
+        # than failing deep inside the weight builder mid-run
+        arg = cfg.concept_drift_algo_arg
+        if not arg or not is_retrain_spec(arg):
+            arg = "win-1"
+        self.retrain = arg
         self.gamma_max = 0.5
         self._reset_state()
 

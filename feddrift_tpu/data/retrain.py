@@ -26,6 +26,13 @@ import json
 import numpy as np
 
 
+def is_retrain_spec(retrain_method: str) -> bool:
+    """True iff ``time_weights`` accepts the string (its full grammar)."""
+    return retrain_method == "all" or any(
+        retrain_method.startswith(p)
+        for p in ("win-", "weight-", "sel-", "clientsel-", "poisson"))
+
+
 def time_weights(retrain_method: str, num_clients: int, current_iteration: int,
                  total_steps: int) -> np.ndarray:
     """Dense ``[C, total_steps]`` weights; zero for steps > current_iteration."""

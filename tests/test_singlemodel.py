@@ -54,3 +54,17 @@ def test_all_equals_oblivious_window():
 def test_window_respects_retrain_data():
     w = _weights_at(_algo("window", retrain_data="win-2"), 2)
     assert (w[1:3] > 0).all() and w[0] == 0
+
+
+def test_clusterfl_ignores_foreign_packed_args():
+    """LegacyClusterFL's arg is a retrain spec; other algorithms' packed
+    strings (incl. the config default) must fall back to win-1 instead of
+    crashing in time_weights."""
+    from feddrift_tpu.data.retrain import is_retrain_spec
+    assert is_retrain_spec("win-3") and is_retrain_spec("all")
+    assert not is_retrain_spec("H_A_C_1_10_0")
+    for arg in ("H_A_C_1_10_0", "", "cfl_0.4_win-1"):
+        algo = _algo("clusterfl", concept_drift_algo_arg=arg, concept_num=2)
+        assert algo.retrain == "win-1"
+    algo = _algo("clusterfl", concept_drift_algo_arg="all", concept_num=2)
+    assert algo.retrain == "all"
