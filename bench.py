@@ -29,8 +29,10 @@ import time
 import jax
 
 # TPU v5 lite (v5e) peak: ~197 TFLOP/s bf16, ~98 TFLOP/s f32 per chip.
-PEAK_FLOPS = {"tpu": {"bfloat16": 197e12, "float32": 98e12},
-              "cpu": {"bfloat16": 5e10, "float32": 1e11}}
+# No CPU entry on purpose: this host's peak is unknown, and an invented
+# constant would make mfu_estimate meaningless — MFU is reported null
+# unless the backend is a real TPU.
+PEAK_FLOPS = {"tpu": {"bfloat16": 197e12, "float32": 98e12}}
 
 
 def _probe_backend(attempts: int = 3, timeout_s: float = 120.0):
@@ -98,26 +100,50 @@ def _canonical_cfg(smoke: bool, **overrides):
         comm_round=20 if smoke else 200,
         epochs=5, batch_size=500, sample_num=100 if smoke else 500,
         lr=0.01, frequency_of_the_test=10,
+        # honest phase attribution: block on device output inside each
+        # traced phase so async dispatch can't bill train time to eval
+        trace_sync=True,
         report_client=0)
     base.update(overrides)
     return ExperimentConfig(**base)
 
 
+def _flops_per_example(exp) -> float:
+    """Forward FLOPs per example, preferring XLA's cost analysis of the
+    compiled single-model forward (exact for convs, where the dense
+    2-FLOPs-per-param rule undercounts by orders of magnitude). Falls back
+    to the dense analytic rule if the backend exposes no cost model."""
+    import numpy as np
+    import jax.numpy as jnp
+
+    batch = min(exp.cfg.batch_size, 256)
+    try:
+        # exp.ds is always populated (exp.x is None under stream_data)
+        x1 = jnp.zeros((batch, *exp.ds.feature_shape), exp.ds.x.dtype)
+        compiled = jax.jit(exp.pool.apply).lower(exp.pool.slot(0), x1).compile()
+        cost = compiled.cost_analysis()
+        if isinstance(cost, list):           # older jax returns [dict]
+            cost = cost[0]
+        return float(cost["flops"]) / batch
+    except Exception:
+        n_params = sum(int(np.prod(l.shape[1:]))   # leading M axis excluded
+                       for l in jax.tree_util.tree_leaves(exp.pool.params))
+        return 2.0 * n_params
+
+
 def _flops_per_round(exp) -> float:
     """Analytic round-FLOPs estimate for the MFU line.
 
-    Dense-model forward ~= 2 FLOPs per param per sample; backward ~= 2x
-    forward. Per round: M x C local trainers each run `epochs` SGD steps on
-    a `batch_size` batch. Eval matrices add M x C full-step inferences every
+    backward ~= 2x forward, so a train step costs ~3x the forward. Per
+    round: M x C local trainers each run `epochs` SGD steps on a
+    `batch_size` batch. Eval matrices add M x C full-step inferences every
     frequency_of_the_test rounds (amortised in).
     """
-    import numpy as np
     cfg, ds = exp.cfg, exp.ds
-    n_params = sum(int(np.prod(l.shape[1:]))   # leading M axis excluded
-                   for l in jax.tree_util.tree_leaves(exp.pool.params))
+    fpe = _flops_per_example(exp)
     M, C = exp.pool.num_models, cfg.client_num_in_total
-    train = M * C * cfg.epochs * cfg.batch_size * (2 * n_params) * 3
-    eval_amortised = (M * C * ds.samples_per_step * (2 * n_params)
+    train = M * C * cfg.epochs * cfg.batch_size * fpe * 3
+    eval_amortised = (M * C * ds.samples_per_step * fpe
                      / max(cfg.frequency_of_the_test, 1))
     return float(train + eval_amortised)
 
@@ -162,22 +188,10 @@ def _measure_cpu_baseline(smoke: bool) -> float | None:
     return None
 
 
-def main() -> None:
-    smoke = "--smoke" in sys.argv
-    if "--cpu" in sys.argv:       # explicit local run: skip the probe wait
-        jax.config.update("jax_platforms", "cpu")
-        backend, probe_diag = "cpu-forced", ["--cpu flag"]
-    else:
-        backend, probe_diag = _probe_backend()
-    _enable_compile_cache()
-
-    # Measured baseline (see module docstring). Skipped under --smoke (the
-    # CI-sized check must stay fast; vs_baseline is reported null there).
-    baseline_rps = None if smoke else _measure_cpu_baseline(smoke)
-
+def _measure(cfg, backend: str) -> dict:
+    """Run one config to steady state and return its measured numbers."""
     from feddrift_tpu.simulation.runner import Experiment
 
-    cfg = _canonical_cfg(smoke)
     exp = Experiment(cfg)
 
     # Warm-up: run time steps 0 AND 1 fully — t=0 takes the cluster_init
@@ -195,29 +209,70 @@ def main() -> None:
     rounds = cfg.comm_round * (cfg.train_iterations - 2)
     rps = rounds / elapsed
 
-    dtype = cfg.compute_dtype if backend == "tpu" else "float32"
-    peak = PEAK_FLOPS["tpu" if backend == "tpu" else "cpu"][dtype]
-    mfu = _flops_per_round(exp) * rps / peak
+    # MFU only means something against a known peak: report it exclusively
+    # for a real TPU backend (ADVICE r2: the old CPU placeholder peaks made
+    # the estimate meaningless while sharing the TPU key).
+    mfu = None
+    if backend == "tpu":
+        peak = PEAK_FLOPS["tpu"].get(cfg.compute_dtype,
+                                     PEAK_FLOPS["tpu"]["float32"])
+        mfu = round(_flops_per_round(exp) * rps / peak, 6)
 
-    final_acc = exp.logger.last("Test/Acc")
-    out = {
-        "metric": f"FedDrift SEA-4 round throughput (softcluster, "
-                  f"10 clients, M=4, fnn, batch 500)",
+    return {
         "value": round(rps, 3),
         "unit": "rounds/s",
-        "vs_baseline": (round(rps / baseline_rps, 3)
+        "final_test_acc": round(float(exp.logger.last("Test/Acc")), 4),
+        "wall_s": round(elapsed, 2),
+        "rounds": rounds,
+        "mfu_estimate": mfu,
+        "phases": getattr(exp, "last_phase_summary", None),
+    }
+
+
+def main() -> None:
+    smoke = "--smoke" in sys.argv
+    if "--cpu" in sys.argv:       # explicit local run: skip the probe wait
+        jax.config.update("jax_platforms", "cpu")
+        backend, probe_diag = "cpu-forced", ["--cpu flag"]
+    else:
+        backend, probe_diag = _probe_backend()
+    _enable_compile_cache()
+
+    # Measured baseline (see module docstring). Skipped under --smoke (the
+    # CI-sized check must stay fast; vs_baseline is reported null there).
+    baseline_rps = None if smoke else _measure_cpu_baseline(smoke)
+
+    res = _measure(_canonical_cfg(smoke), backend)
+
+    # Second datapoint on real TPU hardware (or under --conv for local
+    # checks): a bf16 conv config where the MXU actually has work — the
+    # canonical fnn is ~21k params, so its MFU is noise by construction.
+    conv = None
+    if backend == "tpu" or "--conv" in sys.argv:
+        conv_cfg = _canonical_cfg(
+            smoke, dataset="cifar10", model="resnet8",
+            concept_drift_algo="win-1", concept_drift_algo_arg="",
+            concept_num=1, change_points="A",
+            batch_size=128, compute_dtype="bfloat16",
+            train_iterations=3 if smoke else 4,
+            comm_round=10 if smoke else 50)
+        conv = {"metric": "cifar10 resnet8 bf16 round throughput "
+                          "(win-1, 10 clients, batch 128)",
+                **_measure(conv_cfg, backend)}
+
+    out = {
+        "metric": "FedDrift SEA-4 round throughput (softcluster, "
+                  "10 clients, M=4, fnn, batch 500)",
+        **res,
+        "vs_baseline": (round(res["value"] / baseline_rps, 3)
                         if baseline_rps else None),
         "baseline": ({"rounds_per_sec": round(baseline_rps, 3),
                       "what": "same config, this host CPU, per-round "
                               "dispatch path (reference-shaped)"}
                      if baseline_rps else None),
-        "final_test_acc": round(float(final_acc), 4),
-        "wall_s": round(elapsed, 2),
-        "rounds": rounds,
         "backend": backend,
         "probe": probe_diag,
-        "mfu_estimate": round(mfu, 6),
-        "phases": getattr(exp, "last_phase_summary", None),
+        "conv_bench": conv,
     }
     print(json.dumps(out))
 

@@ -388,7 +388,9 @@ class LegacyClusterFL(DriftAlgorithm):
         # anything outside time_weights' grammar falls back to win-1 rather
         # than failing deep inside the weight builder mid-run
         arg = cfg.concept_drift_algo_arg
-        if not arg or not is_retrain_spec(arg):
+        # probe at self.T1 — the width the runtime time_weights calls use
+        # (includes the holdout slot), not cfg.train_iterations
+        if not arg or not is_retrain_spec(arg, self.C, self.T1):
             arg = "win-1"
         self.retrain = arg
         self.gamma_max = 0.5

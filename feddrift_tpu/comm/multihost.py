@@ -89,4 +89,18 @@ def fetch(tree):
     if jax.process_count() == 1:
         return jax.device_get(tree)
     from jax.experimental import multihost_utils
+
+    def _require_jax_array(leaf):
+        # process_allgather(tiled=True) silently CONCATENATES host-local
+        # numpy/scalar leaves across processes — a wrong-shaped result with
+        # no error. Every fetch() call site passes device-backed arrays;
+        # make any future misuse loud instead of wrong.
+        if not isinstance(leaf, jax.Array):
+            raise TypeError(
+                "multihost.fetch() requires jax.Array leaves in "
+                f"multi-process runs, got {type(leaf).__name__}; fetch "
+                "numpy/host values with plain code, not a collective")
+        return leaf
+
+    tree = jax.tree_util.tree_map(_require_jax_array, tree)
     return multihost_utils.process_allgather(tree, tiled=True)

@@ -33,11 +33,16 @@ from collections import defaultdict
 class NetworkBroker:
     """The broker process: accepts clients, routes topic publishes."""
 
+    # Outbound frames a slow subscriber may lag behind before being dropped.
+    # Sized for control-plane traffic (coordination messages, not tensors).
+    OUT_QUEUE_DEPTH = 256
+
     def __init__(self, host: str = "127.0.0.1", port: int = 0) -> None:
         self._srv = socket.create_server((host, port))
         self.host, self.port = self._srv.getsockname()[:2]
         self._subs: dict[str, list[socket.socket]] = defaultdict(list)
         self._conns: set[socket.socket] = set()
+        self._out: dict[socket.socket, queue.Queue] = {}
         self._lock = threading.Lock()
         self._closed = False
         self._accept = threading.Thread(target=self._accept_loop, daemon=True)
@@ -50,10 +55,42 @@ class NetworkBroker:
                 conn, _ = self._srv.accept()
             except OSError:
                 return                      # server socket closed
+            outq: queue.Queue = queue.Queue(maxsize=self.OUT_QUEUE_DEPTH)
             with self._lock:
                 self._conns.add(conn)
+                self._out[conn] = outq
             threading.Thread(target=self._serve, args=(conn,),
                              daemon=True).start()
+            threading.Thread(target=self._write_loop, args=(conn, outq),
+                             daemon=True).start()
+
+    @staticmethod
+    def _kill(conn: socket.socket) -> None:
+        """Force-disconnect: close() alone does not abort another thread's
+        in-flight blocking send/recv syscall (the kernel holds the open
+        file description), so shutdown() first — that sends FIN and makes
+        blocked sendall/readline return immediately."""
+        try:
+            conn.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        try:
+            conn.close()
+        except OSError:
+            pass
+
+    def _write_loop(self, conn: socket.socket, outq: queue.Queue) -> None:
+        """Per-connection writer: drains the outbound queue so publishers
+        never block on a subscriber's TCP buffer (a wedged subscriber fills
+        its bounded queue and is dropped, see ``_serve``)."""
+        while True:
+            frame = outq.get()
+            if frame is None:               # connection teardown sentinel
+                return
+            try:
+                conn.sendall(frame)
+            except OSError:
+                return                      # reader side will clean up
 
     def _serve(self, conn: socket.socket) -> None:
         f = conn.makefile("r", encoding="utf-8")
@@ -76,32 +113,41 @@ class NetworkBroker:
                     frame = (json.dumps({"topic": topic,
                                          "payload": d.get("payload", "")})
                              + "\n").encode()
-                    # snapshot under the lock, send OUTSIDE it: payloads are
-                    # full model params, and one stalled subscriber's full
-                    # TCP buffer must not wedge every other connection on
-                    # the broker lock (the in-process Broker's under-lock
-                    # puts are safe only because queue puts cannot block,
-                    # pubsub.py)
+                    # Fan-out goes through per-subscriber bounded queues
+                    # drained by dedicated writer threads (_write_loop):
+                    # the publishing connection's thread never touches a
+                    # subscriber socket, so one stalled subscriber (full
+                    # TCP buffer) cannot wedge frames to anyone else. A
+                    # subscriber whose queue overflows is dropped.
                     with self._lock:
-                        targets = list(self._subs.get(topic, ()))
+                        targets = [(c, self._out[c])
+                                   for c in self._subs.get(topic, ())
+                                   if c in self._out]
                     dead = []
-                    for c in targets:
+                    for c, outq in targets:
                         try:
-                            c.sendall(frame)
-                        except OSError:     # dead subscriber: drop it
+                            outq.put_nowait(frame)
+                        except queue.Full:  # wedged subscriber: drop it
                             dead.append(c)
-                    if dead:
+                    for c in dead:
                         with self._lock:
-                            for c in dead:
-                                if c in self._subs.get(topic, ()):
-                                    self._subs[topic].remove(c)
+                            for subs in self._subs.values():
+                                if c in subs:
+                                    subs.remove(c)
+                        self._kill(c)       # unblocks its _serve/_write_loop
         finally:
             with self._lock:
                 for subs in self._subs.values():
                     if conn in subs:
                         subs.remove(conn)
                 self._conns.discard(conn)
-            conn.close()
+                outq = self._out.pop(conn, None)
+            if outq is not None:
+                try:
+                    outq.put_nowait(None)   # stop the writer thread
+                except queue.Full:
+                    pass                    # writer dies on the shutdown
+            self._kill(conn)                # aborts a blocked sendall too
 
     def close(self) -> None:
         self._closed = True
@@ -112,10 +158,7 @@ class NetworkBroker:
         with self._lock:
             conns = list(self._conns)
         for c in conns:                     # unblock _serve readlines
-            try:
-                c.close()
-            except OSError:
-                pass
+            self._kill(c)
 
 
 class NetworkBrokerClient:

@@ -26,11 +26,34 @@ import json
 import numpy as np
 
 
-def is_retrain_spec(retrain_method: str) -> bool:
-    """True iff ``time_weights`` accepts the string (its full grammar)."""
-    return retrain_method == "all" or any(
-        retrain_method.startswith(p)
-        for p in ("win-", "weight-", "sel-", "clientsel-", "poisson"))
+# Fallback horizon for grammar probing when the caller's true dimensions
+# are unknown: far beyond any experiment's train_iterations.
+_PROBE_STEPS = 4096
+
+
+def is_retrain_spec(retrain_method: str, num_clients: int = 1,
+                    total_steps: int = _PROBE_STEPS) -> bool:
+    """True iff ``time_weights`` accepts the string.
+
+    Validated by actually running the parse rather than prefix-matching, so
+    near-miss specs like ``win-abc`` or ``weight-bogus`` are rejected here
+    instead of raising mid-experiment (the LegacyClusterFL
+    fall-back-to-win-1 guard relies on this, algorithms/statebased.py).
+    Pass the experiment's real ``num_clients``/``total_steps`` to also
+    reject specs that are structurally invalid at those dimensions
+    (``sel-``/``clientsel-`` indices out of range, too-short per-client
+    lists): with real dimensions every iteration index is probed, so
+    late-step references are exercised too. The defaults validate grammar
+    only (single probe at t=0 — probing 4096 steps would overflow
+    ``weight-exp``'s 2**t and buys nothing at an imaginary horizon).
+    """
+    probe_ts = [0] if total_steps >= _PROBE_STEPS else range(total_steps)
+    try:
+        for t in probe_ts:
+            time_weights(retrain_method, num_clients, t, total_steps)
+    except Exception:
+        return False
+    return True
 
 
 def time_weights(retrain_method: str, num_clients: int, current_iteration: int,
@@ -45,6 +68,8 @@ def time_weights(retrain_method: str, num_clients: int, current_iteration: int,
         w[:, max(0, t - win + 1) : t + 1] = 1.0
     elif retrain_method.startswith("weight-"):
         kind = retrain_method.removeprefix("weight-")
+        if kind not in ("linear", "exp"):
+            raise NameError(retrain_method)
         for it in range(t + 1):
             w[:, it] = (it + 1) if kind == "linear" else float(2**it)
     elif retrain_method.startswith("sel-"):

@@ -183,12 +183,28 @@ class TurboAggregateRing:
                 s = np.mod(s + shares.sum(axis=1) % cfg.p, cfg.p)
                 contributors.extend(send_ids)
 
-        # Final open at the server: the last stage's shares arrive
-        # directly (forwarded running sum already reconstructed above,
-        # contributions sent point-to-point before any death), so any
-        # T+1 positions reconstruct the total.
-        total = bgw_decode(s[: cfg.privacy_t + 1],
-                           np.arange(cfg.privacy_t + 1), cfg.p)[0]
+        # Final open at the server.  Position p of the final merged share
+        # vector has two components: the last group's contribution shares
+        # (sent point-to-point before any death — always arrive) and the
+        # forwarded running sum through the earlier groups, which only
+        # arrives if the last group's position-p holder is alive.  A real
+        # server can therefore open only from positions whose last-group
+        # holders survived; pick T+1 of those (the merged polynomial still
+        # has degree <= T, so alive positions alone determine the total).
+        # A single group forwards no running sum — every position is a
+        # direct contribution share, so no aliveness constraint applies.
+        if cfg.num_groups > 1:
+            last = list(cfg.group_members(cfg.num_groups - 1))
+            alive_idx = np.flatnonzero(np.array(
+                [last[pos] not in dropped for pos in range(n)]))
+            if alive_idx.size < cfg.privacy_t + 1:
+                raise RuntimeError(
+                    f"unrecoverable final stage: {alive_idx.size} alive "
+                    f"positions < T+1={cfg.privacy_t + 1}")
+            use = alive_idx[: cfg.privacy_t + 1]
+        else:
+            use = np.arange(cfg.privacy_t + 1)
+        total = bgw_decode(s[use], use, cfg.p)[0]
         return dequantize(total, cfg.scale, cfg.p), contributors
 
 

@@ -63,6 +63,15 @@ def test_clusterfl_ignores_foreign_packed_args():
     from feddrift_tpu.data.retrain import is_retrain_spec
     assert is_retrain_spec("win-3") and is_retrain_spec("all")
     assert not is_retrain_spec("H_A_C_1_10_0")
+    # near-miss specs: right prefix, unparsable remainder (ADVICE r2)
+    assert not is_retrain_spec("win-abc")
+    assert not is_retrain_spec("weight-bogus")
+    assert is_retrain_spec("weight-exp") and is_retrain_spec("weight-linear")
+    # structurally invalid at the experiment's real dimensions
+    assert not is_retrain_spec("sel-20", num_clients=10, total_steps=10)
+    assert is_retrain_spec("sel-2", num_clients=10, total_steps=10)
+    assert not is_retrain_spec("clientsel-[[0]]", num_clients=10,
+                               total_steps=10)
     for arg in ("H_A_C_1_10_0", "", "cfl_0.4_win-1"):
         algo = _algo("clusterfl", concept_drift_algo_arg=arg, concept_num=2)
         assert algo.retrain == "win-1"
