@@ -1,0 +1,321 @@
+"""Minimal MQTT 3.1.1 wire protocol: broker and client.
+
+The reference's MQTT transport is paho-mqtt against a real broker
+(fedml_core/distributed/communication/mqtt/mqtt_comm_manager.py:14-135,
+default broker.emqx.io:1883). `comm/netbroker.py` gives the same
+pub/sub semantics over an NDJSON wire, which cannot interoperate with an
+actual MQTT broker; this module closes that gap with real MQTT 3.1.1
+framing (spec: OASIS mqtt-v3.1.1, control packets 1-14):
+
+* ``MqttBroker`` — a broker speaking MQTT 3.1.1: CONNECT/CONNACK,
+  PUBLISH (QoS 0), SUBSCRIBE/SUBACK, UNSUBSCRIBE/UNSUBACK,
+  PINGREQ/PINGRESP, DISCONNECT. Any compliant client (e.g. paho-mqtt)
+  can connect to it.
+* ``MqttBrokerClient`` — a client exposing the same ``Broker`` interface
+  as `comm/pubsub.py` (subscribe(topic) -> Queue, publish, unsubscribe),
+  so ``PubSubCommManager(MqttBrokerClient(host, port), rank)`` is a
+  drop-in swap — and the host:port may be ANY MQTT 3.1.1 broker, not
+  just ours.
+
+Scope, stated plainly: QoS 0 delivery (the reference publishes with the
+paho default QoS 0); inbound QoS 1 publishes are PUBACK'd and delivered
+once, QoS 2 connections are closed rather than silently downgraded;
+standard '+'/'#' topic wildcards; no retained messages, wills, or auth.
+The client sends keepalive=0 by default (no automatic ping timer — FL
+clients are silent for minutes while training; see ``connect_packet``).
+Payloads are UTF-8 strings (the JSON-serialised Message wire format,
+matching the reference's json.dumps payloads).
+
+Fan-out uses the same per-subscriber bounded-queue + writer-thread
+pattern as netbroker so one stalled subscriber cannot wedge the broker.
+"""
+
+from __future__ import annotations
+
+import queue
+import socket
+import struct
+import threading
+from collections import defaultdict
+
+from .netbroker import TcpFanoutServer
+
+# Control packet types (MQTT 3.1.1 §2.2.1)
+CONNECT, CONNACK, PUBLISH, PUBACK, SUBSCRIBE, SUBACK = 1, 2, 3, 4, 8, 9
+UNSUBSCRIBE, UNSUBACK, PINGREQ, PINGRESP, DISCONNECT = 10, 11, 12, 13, 14
+
+
+# ----------------------------------------------------------------------
+# Frame encoding/decoding
+def encode_varint(n: int) -> bytes:
+    """Remaining-length varint (§2.2.3): 7 bits per byte, MSB = continue."""
+    if not 0 <= n < 268_435_456:
+        raise ValueError(f"remaining length out of range: {n}")
+    out = bytearray()
+    while True:
+        n, digit = divmod(n, 128)
+        out.append(digit | (0x80 if n else 0))
+        if not n:
+            return bytes(out)
+
+
+def _read_varint(f) -> int | None:
+    mult, value = 1, 0
+    for _ in range(4):
+        b = f.read(1)
+        if not b:
+            return None                    # connection closed
+        value += (b[0] & 0x7F) * mult
+        if not b[0] & 0x80:
+            return value
+        mult *= 128
+    raise ValueError("malformed remaining length")
+
+
+def _utf8(s: str) -> bytes:
+    b = s.encode("utf-8")
+    return struct.pack(">H", len(b)) + b
+
+
+def _read_utf8(buf: bytes, off: int) -> tuple[str, int]:
+    (n,) = struct.unpack_from(">H", buf, off)
+    off += 2
+    return buf[off : off + n].decode("utf-8"), off + n
+
+
+def make_packet(ptype: int, flags: int, body: bytes) -> bytes:
+    return bytes([(ptype << 4) | flags]) + encode_varint(len(body)) + body
+
+
+def connect_packet(client_id: str, keepalive: int = 0) -> bytes:
+    """CONNECT with clean-session (§3.1): protocol name 'MQTT', level 4.
+
+    keepalive defaults to 0 = keep-alive mechanism OFF (§3.1.2.10): this
+    client has no automatic ping timer, and a nonzero value would let a
+    real broker drop it after 1.5x the interval of idleness (FL clients
+    are routinely silent for minutes while training). Callers that want
+    liveness probing pass a nonzero value and drive ``ping()`` themselves.
+    """
+    body = (_utf8("MQTT") + bytes([4])        # protocol level 3.1.1
+            + bytes([0x02])                   # connect flags: clean session
+            + struct.pack(">H", keepalive)
+            + _utf8(client_id))
+    return make_packet(CONNECT, 0, body)
+
+
+def publish_packet(topic: str, payload: bytes) -> bytes:
+    """PUBLISH, QoS 0 (§3.3): no packet identifier."""
+    return make_packet(PUBLISH, 0, _utf8(topic) + payload)
+
+
+def subscribe_packet(packet_id: int, topic: str) -> bytes:
+    """SUBSCRIBE (§3.8): fixed-header flags MUST be 0b0010."""
+    body = struct.pack(">H", packet_id) + _utf8(topic) + bytes([0])  # QoS 0
+    return make_packet(SUBSCRIBE, 0x02, body)
+
+
+def unsubscribe_packet(packet_id: int, topic: str) -> bytes:
+    return make_packet(UNSUBSCRIBE, 0x02,
+                       struct.pack(">H", packet_id) + _utf8(topic))
+
+
+def read_packet(f) -> tuple[int, int, bytes] | None:
+    """Read one control packet -> (type, flags, body); None at EOF."""
+    h = f.read(1)
+    if not h:
+        return None
+    length = _read_varint(f)
+    if length is None:
+        return None
+    body = f.read(length) if length else b""
+    if len(body) != length:
+        return None
+    return h[0] >> 4, h[0] & 0x0F, body
+
+
+def topic_matches(flt: str, topic: str) -> bool:
+    """Topic-filter match with '+' (one level) and '#' (tail) (§4.7)."""
+    fparts, tparts = flt.split("/"), topic.split("/")
+    for i, fp in enumerate(fparts):
+        if fp == "#":
+            return True
+        if i >= len(tparts):
+            return False
+        if fp != "+" and fp != tparts[i]:
+            return False
+    return len(fparts) == len(tparts)
+
+
+# ----------------------------------------------------------------------
+class MqttBroker(TcpFanoutServer):
+    """MQTT 3.1.1 broker (QoS 0 delivery). Shares the accept / reader /
+    bounded-queue-writer lifecycle with netbroker.TcpFanoutServer; this
+    class is only the MQTT framing."""
+
+    _BINARY = True
+
+    def _handle(self, conn: socket.socket, f) -> None:
+        pkt = read_packet(f)
+        if pkt is None or pkt[0] != CONNECT:
+            return                           # §3.1: first packet MUST be CONNECT
+        self._enqueue(conn, make_packet(CONNACK, 0, b"\x00\x00"))
+        while True:
+            pkt = read_packet(f)
+            if pkt is None:
+                return
+            ptype, flags, body = pkt
+            if ptype == PUBLISH:
+                qos = (flags >> 1) & 0x03
+                if qos == 3:
+                    return                   # §3.3.1.2: malformed, close
+                topic, off = _read_utf8(body, 0)
+                if qos:                      # QoS 1/2 carry a packet id
+                    (pid,) = struct.unpack_from(">H", body, off)
+                    off += 2
+                    if qos == 1:
+                        self._enqueue(conn, make_packet(
+                            PUBACK, 0, struct.pack(">H", pid)))
+                    else:                    # QoS 2 unsupported: close
+                        return               # rather than silently downgrade
+                with self._lock:
+                    targets = [c for flt, subs in self._subs.items()
+                               if topic_matches(flt, topic)
+                               for c in subs]
+                frame = publish_packet(topic, body[off:])  # re-sent as QoS 0
+                for c in dict.fromkeys(targets):   # dedupe, keep order
+                    self._enqueue(c, frame)
+            elif ptype == SUBSCRIBE:
+                (pid,) = struct.unpack_from(">H", body, 0)
+                off, codes = 2, bytearray()
+                while off < len(body):
+                    flt, off = _read_utf8(body, off)
+                    off += 1                 # requested QoS byte
+                    with self._lock:
+                        if conn not in self._subs[flt]:
+                            self._subs[flt].append(conn)
+                    codes.append(0)          # granted QoS 0
+                self._enqueue(conn, make_packet(
+                    SUBACK, 0, struct.pack(">H", pid) + bytes(codes)))
+            elif ptype == UNSUBSCRIBE:
+                (pid,) = struct.unpack_from(">H", body, 0)
+                off = 2
+                while off < len(body):
+                    flt, off = _read_utf8(body, off)
+                    with self._lock:
+                        if conn in self._subs.get(flt, ()):
+                            self._subs[flt].remove(conn)
+                self._enqueue(conn, make_packet(
+                    UNSUBACK, 0, struct.pack(">H", pid)))
+            elif ptype == PINGREQ:
+                self._enqueue(conn, make_packet(PINGRESP, 0, b""))
+            elif ptype == DISCONNECT:
+                return
+
+
+# ----------------------------------------------------------------------
+class MqttBrokerClient:
+    """MQTT 3.1.1 client exposing the in-process ``Broker`` interface
+    (pubsub.Broker): subscribe(topic) -> Queue, publish, unsubscribe.
+
+    Works against ``MqttBroker`` or any compliant MQTT 3.1.1 broker."""
+
+    def __init__(self, host: str, port: int, client_id: str = "",
+                 timeout: float = 10.0) -> None:
+        self._sock = socket.create_connection((host, port), timeout=timeout)
+        # clear the connect timeout BEFORE the reader starts: an inherited
+        # per-socket timeout would make the reader's first long idle recv
+        # raise and silently kill the loop (handshake timeout is enforced
+        # by the Event wait below instead, as netbroker does)
+        self._sock.settimeout(None)
+        self._wlock = threading.Lock()
+        self._queues: dict[str, list[queue.Queue]] = defaultdict(list)
+        self._qlock = threading.Lock()
+        self._pid = 0
+        self._connack = threading.Event()
+        self._connack_code: int | None = None
+        self._f = self._sock.makefile("rb")
+        self._send(connect_packet(client_id or f"feddrift-{id(self):x}"))
+        threading.Thread(target=self._read_loop, daemon=True).start()
+        if not self._connack.wait(timeout):
+            self._sock.close()
+            raise ConnectionError("no CONNACK from broker")
+        if self._connack_code:
+            self._sock.close()
+            raise ConnectionError(
+                f"broker refused connection: return code "
+                f"{self._connack_code} (§3.2.2.3)")
+
+    def _send(self, frame: bytes) -> None:
+        with self._wlock:
+            self._sock.sendall(frame)
+
+    def _next_pid(self) -> int:
+        self._pid = self._pid % 65535 + 1
+        return self._pid
+
+    def _read_loop(self) -> None:
+        try:
+            while True:
+                pkt = read_packet(self._f)
+                if pkt is None:
+                    return
+                ptype, _flags, body = pkt
+                if ptype == CONNACK:
+                    self._connack_code = body[1] if len(body) > 1 else 0xFF
+                    self._connack.set()      # __init__ raises on refusal
+                    if self._connack_code:
+                        return
+                elif ptype == PUBLISH:
+                    topic, off = _read_utf8(body, 0)
+                    try:
+                        payload = body[off:].decode("utf-8")
+                    except UnicodeDecodeError:
+                        continue             # binary payload from a third-
+                        # party client: skip it, keep the loop alive (our
+                        # wire carries JSON strings only)
+                    with self._qlock:
+                        qs = [q for flt, lst in self._queues.items()
+                              if topic_matches(flt, topic) for q in lst]
+                    for q in qs:
+                        q.put(payload)
+                # SUBACK/UNSUBACK/PINGRESP need no action at QoS 0
+        except (OSError, ValueError):
+            pass
+
+    # -- Broker interface ----------------------------------------------
+    def subscribe(self, topic: str) -> queue.Queue:
+        q: queue.Queue = queue.Queue()
+        with self._qlock:
+            first = not self._queues[topic]
+            self._queues[topic].append(q)
+            if first:
+                self._send(subscribe_packet(self._next_pid(), topic))
+        return q
+
+    def publish(self, topic: str, payload: str) -> None:
+        self._send(publish_packet(topic, payload.encode("utf-8")))
+
+    def unsubscribe(self, topic: str, q: queue.Queue) -> None:
+        with self._qlock:
+            subs = self._queues.get(topic, [])
+            if q in subs:
+                subs.remove(q)
+            if not subs:
+                self._queues.pop(topic, None)
+                try:
+                    self._send(unsubscribe_packet(self._next_pid(), topic))
+                except OSError:
+                    pass
+
+    def ping(self) -> None:
+        self._send(make_packet(PINGREQ, 0, b""))
+
+    def close(self) -> None:
+        try:
+            self._send(make_packet(DISCONNECT, 0, b""))
+        except OSError:
+            pass
+        try:
+            self._sock.close()
+        except OSError:
+            pass

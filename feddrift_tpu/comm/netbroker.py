@@ -8,9 +8,9 @@ SAME ``Broker`` interface (subscribe/publish/unsubscribe), so
 ``PubSubCommManager(NetworkBrokerClient(...), rank)`` is a drop-in swap for
 ``PubSubCommManager(Broker(), rank)``.
 
-Protocol: newline-delimited JSON frames over TCP (stdlib-only; no
-paho-mqtt in this environment, and the control-plane traffic — model-free
-coordination messages — does not need MQTT QoS machinery):
+Protocol: newline-delimited JSON frames over TCP (stdlib-only; for true
+MQTT 3.1.1 wire framing see `comm/mqtt.py`, which shares this module's
+broker lifecycle):
 
     client -> broker:  {"op": "sub"|"unsub", "topic": str}
                        {"op": "pub", "topic": str, "payload": str}
@@ -30,12 +30,23 @@ import threading
 from collections import defaultdict
 
 
-class NetworkBroker:
-    """The broker process: accepts clients, routes topic publishes."""
+class TcpFanoutServer:
+    """Shared TCP pub/sub broker lifecycle.
+
+    Owns the accept loop, a reader thread per connection, and a bounded
+    per-connection outbound queue drained by a dedicated writer thread —
+    so a publisher never touches a subscriber socket and one stalled
+    subscriber (full TCP buffer) cannot wedge anyone else; a subscriber
+    whose queue overflows is force-dropped. Subclasses implement
+    ``_handle(conn, f)`` to speak their framing (NDJSON here, MQTT in
+    `comm/mqtt.py`), calling ``_enqueue(conn, frame_bytes)`` for output
+    and using ``self._subs`` (topic -> [conn]) for routing.
+    """
 
     # Outbound frames a slow subscriber may lag behind before being dropped.
     # Sized for control-plane traffic (coordination messages, not tensors).
     OUT_QUEUE_DEPTH = 256
+    _BINARY = False          # subclasses: True for byte-framed protocols
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0) -> None:
         self._srv = socket.create_server((host, port))
@@ -45,25 +56,9 @@ class NetworkBroker:
         self._out: dict[socket.socket, queue.Queue] = {}
         self._lock = threading.Lock()
         self._closed = False
-        self._accept = threading.Thread(target=self._accept_loop, daemon=True)
-        self._accept.start()
+        threading.Thread(target=self._accept_loop, daemon=True).start()
 
-    # -- broker internals ----------------------------------------------
-    def _accept_loop(self) -> None:
-        while not self._closed:
-            try:
-                conn, _ = self._srv.accept()
-            except OSError:
-                return                      # server socket closed
-            outq: queue.Queue = queue.Queue(maxsize=self.OUT_QUEUE_DEPTH)
-            with self._lock:
-                self._conns.add(conn)
-                self._out[conn] = outq
-            threading.Thread(target=self._serve, args=(conn,),
-                             daemon=True).start()
-            threading.Thread(target=self._write_loop, args=(conn, outq),
-                             daemon=True).start()
-
+    # -- lifecycle ------------------------------------------------------
     @staticmethod
     def _kill(conn: socket.socket) -> None:
         """Force-disconnect: close() alone does not abort another thread's
@@ -79,10 +74,24 @@ class NetworkBroker:
         except OSError:
             pass
 
+    def _accept_loop(self) -> None:
+        while not self._closed:
+            try:
+                conn, _ = self._srv.accept()
+            except OSError:
+                return                      # server socket closed
+            outq: queue.Queue = queue.Queue(maxsize=self.OUT_QUEUE_DEPTH)
+            with self._lock:
+                self._conns.add(conn)
+                self._out[conn] = outq
+            threading.Thread(target=self._serve, args=(conn,),
+                             daemon=True).start()
+            threading.Thread(target=self._write_loop, args=(conn, outq),
+                             daemon=True).start()
+
     def _write_loop(self, conn: socket.socket, outq: queue.Queue) -> None:
         """Per-connection writer: drains the outbound queue so publishers
-        never block on a subscriber's TCP buffer (a wedged subscriber fills
-        its bounded queue and is dropped, see ``_serve``)."""
+        never block on a subscriber's TCP buffer."""
         while True:
             frame = outq.get()
             if frame is None:               # connection teardown sentinel
@@ -92,49 +101,28 @@ class NetworkBroker:
             except OSError:
                 return                      # reader side will clean up
 
-    def _serve(self, conn: socket.socket) -> None:
-        f = conn.makefile("r", encoding="utf-8")
+    def _enqueue(self, conn: socket.socket, frame: bytes) -> None:
+        """Queue outbound bytes; drop the connection if it is wedged."""
+        with self._lock:
+            outq = self._out.get(conn)
+        if outq is None:
+            return
         try:
-            for line in f:
-                try:
-                    d = json.loads(line)
-                except json.JSONDecodeError:
-                    continue                # tolerate garbage frames
-                op, topic = d.get("op"), d.get("topic")
-                if op == "sub":
-                    with self._lock:
-                        if conn not in self._subs[topic]:
-                            self._subs[topic].append(conn)
-                elif op == "unsub":
-                    with self._lock:
-                        if conn in self._subs.get(topic, ()):
-                            self._subs[topic].remove(conn)
-                elif op == "pub":
-                    frame = (json.dumps({"topic": topic,
-                                         "payload": d.get("payload", "")})
-                             + "\n").encode()
-                    # Fan-out goes through per-subscriber bounded queues
-                    # drained by dedicated writer threads (_write_loop):
-                    # the publishing connection's thread never touches a
-                    # subscriber socket, so one stalled subscriber (full
-                    # TCP buffer) cannot wedge frames to anyone else. A
-                    # subscriber whose queue overflows is dropped.
-                    with self._lock:
-                        targets = [(c, self._out[c])
-                                   for c in self._subs.get(topic, ())
-                                   if c in self._out]
-                    dead = []
-                    for c, outq in targets:
-                        try:
-                            outq.put_nowait(frame)
-                        except queue.Full:  # wedged subscriber: drop it
-                            dead.append(c)
-                    for c in dead:
-                        with self._lock:
-                            for subs in self._subs.values():
-                                if c in subs:
-                                    subs.remove(c)
-                        self._kill(c)       # unblocks its _serve/_write_loop
+            outq.put_nowait(frame)
+        except queue.Full:                  # wedged subscriber: drop it
+            with self._lock:
+                for subs in self._subs.values():
+                    if conn in subs:
+                        subs.remove(conn)
+            self._kill(conn)                # unblocks its reader/writer
+
+    def _serve(self, conn: socket.socket) -> None:
+        f = (conn.makefile("rb") if self._BINARY
+             else conn.makefile("r", encoding="utf-8"))
+        try:
+            self._handle(conn, f)
+        except (OSError, ValueError):
+            pass
         finally:
             with self._lock:
                 for subs in self._subs.values():
@@ -149,6 +137,9 @@ class NetworkBroker:
                     pass                    # writer dies on the shutdown
             self._kill(conn)                # aborts a blocked sendall too
 
+    def _handle(self, conn: socket.socket, f) -> None:
+        raise NotImplementedError
+
     def close(self) -> None:
         self._closed = True
         try:
@@ -157,8 +148,36 @@ class NetworkBroker:
             pass
         with self._lock:
             conns = list(self._conns)
-        for c in conns:                     # unblock _serve readlines
+        for c in conns:                     # unblock blocked reads/writes
             self._kill(c)
+
+
+class NetworkBroker(TcpFanoutServer):
+    """The NDJSON broker: accepts clients, routes topic publishes."""
+
+    def _handle(self, conn: socket.socket, f) -> None:
+        for line in f:
+            try:
+                d = json.loads(line)
+            except json.JSONDecodeError:
+                continue                    # tolerate garbage frames
+            op, topic = d.get("op"), d.get("topic")
+            if op == "sub":
+                with self._lock:
+                    if conn not in self._subs[topic]:
+                        self._subs[topic].append(conn)
+            elif op == "unsub":
+                with self._lock:
+                    if conn in self._subs.get(topic, ()):
+                        self._subs[topic].remove(conn)
+            elif op == "pub":
+                frame = (json.dumps({"topic": topic,
+                                     "payload": d.get("payload", "")})
+                         + "\n").encode()
+                with self._lock:
+                    targets = list(self._subs.get(topic, ()))
+                for c in targets:
+                    self._enqueue(c, frame)
 
 
 class NetworkBrokerClient:
@@ -193,7 +212,6 @@ class NetworkBrokerClient:
                     q.put(d.get("payload", ""))
         except (OSError, ValueError):
             pass                            # socket closed
-
     # -- Broker interface ----------------------------------------------
     # sub/unsub hold _qlock ACROSS the state change and the frame write:
     # releasing between them would let a racing subscribe/unsubscribe pair
