@@ -279,7 +279,9 @@ class MqttBrokerClient:
                     for q in qs:
                         q.put(payload)
                 # SUBACK/UNSUBACK/PINGRESP need no action at QoS 0
-        except (OSError, ValueError):
+        except (OSError, ValueError, struct.error):
+            # struct.error: truncated PUBLISH body — treat like a closed
+            # socket rather than silently killing only the reader thread
             pass
 
     # -- Broker interface ----------------------------------------------

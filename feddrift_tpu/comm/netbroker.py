@@ -25,6 +25,7 @@ from __future__ import annotations
 
 import json
 import queue
+import struct
 import socket
 import threading
 from collections import defaultdict
@@ -121,7 +122,9 @@ class TcpFanoutServer:
              else conn.makefile("r", encoding="utf-8"))
         try:
             self._handle(conn, f)
-        except (OSError, ValueError):
+        except (OSError, ValueError, struct.error):
+            # struct.error: a binary _handle (MqttBroker) hit a truncated
+            # packet body; drop the connection like any other malformed input
             pass
         finally:
             with self._lock:

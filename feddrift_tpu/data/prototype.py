@@ -93,6 +93,36 @@ def _try_load_leaf_mnist(data_dir: str) -> tuple[np.ndarray, np.ndarray] | None:
     return nX[perm], nY[perm]
 
 
+def _try_load_tff_h5(path: str, x_key: str,
+                     feature_shape: tuple[int, ...]
+                     ) -> tuple[np.ndarray, np.ndarray] | None:
+    """Load a flat TFF-style image h5 (datasets ``<x_key>``/``label``/``id``).
+
+    Covers the reference's FederatedEMNIST layout (pixels/label/id,
+    FederatedEMNIST/data_loader.py:16-33) and fed_cifar100 layout
+    (image/label/id, fed_cifar100/data_loader.py:15-32). The per-sample
+    ``id`` client ownership is intentionally not used: the drift pipeline
+    re-partitions by (client, time step) with its own change-point matrix,
+    the same way the MNIST LEAF loader pools users before slicing.
+    """
+    if not os.path.isfile(path):
+        return None
+    import h5py
+    with h5py.File(path, "r") as f:
+        if x_key not in f or "label" not in f:
+            return None
+        X = np.asarray(f[x_key][()], np.float32)
+        Y = np.asarray(f["label"][()], np.int32)
+    if X.size == 0:
+        return None
+    if X.max() > 1.5:              # uint8-encoded images -> [0, 1]
+        X = X / 255.0
+    X = X.reshape(len(X), *feature_shape)
+    rng = np.random.default_rng(100)   # same fixed shuffle as LEAF MNIST
+    perm = rng.permutation(len(X))
+    return X[perm], Y[perm]
+
+
 def generate_prototype_drift(
     name: str,
     change_points: np.ndarray,
@@ -111,6 +141,14 @@ def generate_prototype_drift(
     real: tuple[np.ndarray, np.ndarray] | None = None
     if name == "MNIST":
         real = _try_load_leaf_mnist(data_dir)
+    elif name == "femnist":
+        real = _try_load_tff_h5(
+            os.path.join(data_dir, "FederatedEMNIST", "emnist_train.h5"),
+            "pixels", feature_shape)
+    elif name == "fed_cifar100":
+        real = _try_load_tff_h5(
+            os.path.join(data_dir, "fed_cifar100", "cifar100_train.h5"),
+            "image", feature_shape)
     sampler = PrototypeSampler(feature_shape, num_classes)
     used = 0
 

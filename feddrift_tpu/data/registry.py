@@ -76,7 +76,7 @@ def _mk_text(cfg: ExperimentConfig, change_points: np.ndarray) -> DriftDataset:
     return generate_text_drift(
         change_points, cfg.train_iterations, cfg.client_num_in_total,
         cfg.sample_num, cfg.noise_prob, cfg.time_stretch, cfg.seed,
-        seq_len=cfg.text_seq_len)
+        seq_len=cfg.text_seq_len, data_dir=cfg.data_dir)
 
 
 @register_dataset("susy", "ro")
@@ -94,7 +94,8 @@ def _mk_so_lr(cfg: ExperimentConfig, change_points: np.ndarray) -> DriftDataset:
     return generate_stackoverflow_lr_drift(
         change_points, cfg.train_iterations, cfg.client_num_in_total,
         cfg.sample_num, cfg.noise_prob, cfg.time_stretch, cfg.seed,
-        vocab_size=cfg.so_vocab_size, tag_size=cfg.so_tag_size)
+        vocab_size=cfg.so_vocab_size, tag_size=cfg.so_tag_size,
+        data_dir=cfg.data_dir)
 
 
 @register_dataset("stackoverflow", "stackoverflow_nwp")
@@ -103,7 +104,8 @@ def _mk_word(cfg: ExperimentConfig, change_points: np.ndarray) -> DriftDataset:
     # windows are ~20 tokens); cfg.text_seq_len governs the char datasets
     return generate_word_drift(
         change_points, cfg.train_iterations, cfg.client_num_in_total,
-        cfg.sample_num, cfg.noise_prob, cfg.time_stretch, cfg.seed)
+        cfg.sample_num, cfg.noise_prob, cfg.time_stretch, cfg.seed,
+        data_dir=cfg.data_dir)
 
 
 def make_dataset(cfg: ExperimentConfig) -> DriftDataset:

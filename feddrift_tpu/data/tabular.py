@@ -27,6 +27,7 @@ default here is vocab 1000 / 50 tags — override with
 from __future__ import annotations
 
 import csv
+import json
 import os
 
 import numpy as np
@@ -141,6 +142,56 @@ def generate_uci_drift(
                                          else "synthetic"})
 
 
+def _try_load_stackoverflow_lr(
+    data_dir: str, vocab_size: int, tag_size: int,
+    max_samples: int = 100_000,
+) -> tuple[np.ndarray, np.ndarray] | None:
+    """Real TFF StackOverflow -> (bag-of-words [N, vocab], principal tag [N]).
+
+    Layout (reference stackoverflow_lr/data_loader.py:18-26 +
+    utils.py:5-25): ``stackoverflow/datasets/stackoverflow_train.h5`` with
+    examples/<client>/{tokens,title,tags} byte strings;
+    ``stackoverflow.word_count`` ("word count" per line, frequency-ranked);
+    ``stackoverflow.tag_count`` (JSON dict, insertion-ordered by count).
+    Samples whose tags all fall outside the top-``tag_size`` set are
+    skipped, mirroring the reference's vectorize-on-known-tags behavior.
+    """
+    base = os.path.join(data_dir, "stackoverflow", "datasets")
+    h5path = os.path.join(base, "stackoverflow_train.h5")
+    wcpath = os.path.join(base, "stackoverflow.word_count")
+    tcpath = os.path.join(base, "stackoverflow.tag_count")
+    if not all(os.path.isfile(p) for p in (h5path, wcpath, tcpath)):
+        return None
+    with open(wcpath) as fh:
+        words = [ln.split()[0] for ln in fh if ln.strip()][:vocab_size]
+    word_id = {w: i for i, w in enumerate(words)}
+    with open(tcpath) as fh:
+        tag_id = {t: i for i, t in enumerate(list(json.load(fh))[:tag_size])}
+    import h5py
+    X, Y = [], []
+    with h5py.File(h5path, "r") as f:
+        for cid in sorted(f["examples"].keys()):
+            if len(X) >= max_samples:   # the drift pipeline consumes only
+                break                   # C*(T+1)*sample_num samples; a
+            ex = f["examples"][cid]     # bounded prefix avoids OOM on the
+                                        # full ~135M-example split
+            titles = ex["title"][()] if "title" in ex else [b""] * len(ex["tokens"])
+            for tok, tit, tag in zip(ex["tokens"][()], titles, ex["tags"][()]):
+                tags = [tag_id[t] for t in tag.decode("utf8").split("|")
+                        if t in tag_id]
+                if not tags:
+                    continue
+                vec = np.zeros(vocab_size, np.float32)
+                for w in (tok.decode("utf8") + " " + tit.decode("utf8")).split():
+                    if w in word_id:
+                        vec[word_id[w]] += 1.0
+                X.append(vec)
+                Y.append(tags[0])
+    if not X:
+        return None
+    return np.stack(X), np.asarray(Y, np.int32)
+
+
 def generate_stackoverflow_lr_drift(
     change_points: np.ndarray,
     train_iterations: int,
@@ -151,21 +202,50 @@ def generate_stackoverflow_lr_drift(
     seed: int = 0,
     vocab_size: int = 1000,
     tag_size: int = 50,
+    data_dir: str = "./data",
 ) -> DriftDataset:
     """Bag-of-words tag prediction under drift.
 
-    Each tag class has a sparse topic distribution over the vocabulary; a
-    sample is a word-count vector of ~30 tokens drawn from its tag's topic
-    (the reference's preprocess_inputs word-count vectors,
-    stackoverflow_lr/utils.py). A concept permutes the tag->topic assignment,
-    the bag-of-words analog of the MNIST label-swap drift. The reference's
-    multi-hot multi-tag target is reduced to the principal tag so the dataset
+    Real TFF StackOverflow files under ``data_dir`` are used when present
+    (word-count vectors over the frequency-ranked vocabulary, principal-tag
+    target). Hermetic fallback: each tag class has a sparse topic
+    distribution over the vocabulary; a sample is a word-count vector of
+    ~30 tokens drawn from its tag's topic (the reference's
+    preprocess_inputs word-count vectors, stackoverflow_lr/utils.py). In
+    both cases a concept permutes the tag assignment, the bag-of-words
+    analog of the MNIST label-swap drift. The reference's multi-hot
+    multi-tag target is reduced to the principal tag so the dataset
     composes with the framework's single-label drift pipeline.
     """
     T = train_iterations
     rng = np.random.default_rng(seed)
     concepts = concept_matrix(change_points, T + 1, num_clients, time_stretch)
     n_concepts = max(int(concepts.max()) + 1, 2)
+
+    real = _try_load_stackoverflow_lr(data_dir, vocab_size, tag_size)
+    if real is not None:
+        rx, ry = real
+        trng = np.random.default_rng(7793)
+        perms = np.stack(
+            [np.arange(tag_size)] +
+            [trng.permutation(tag_size) for _ in range(n_concepts - 1)])
+        x = np.zeros((num_clients, T + 1, sample_num, vocab_size), np.float32)
+        y = np.zeros((num_clients, T + 1, sample_num), np.int32)
+        used = 0
+        for t in range(T + 1):
+            for c in range(num_clients):
+                k = int(concepts[t, c]) % n_concepts
+                take = np.arange(used, used + sample_num) % len(rx)
+                used = (used + sample_num) % len(rx)
+                x[c, t] = rx[take]
+                y[c, t] = perms[k][ry[take]]
+        if noise_prob > 0:
+            flip = rng.random(y.shape) < noise_prob
+            y = np.where(flip, rng.integers(0, tag_size, size=y.shape),
+                         y).astype(np.int32)
+        return DriftDataset(x=x, y=y, num_classes=tag_size, concepts=concepts,
+                            name="stackoverflow_lr",
+                            meta={"real_data": True})
 
     trng = np.random.default_rng(7793)
     # Per-tag topic: a peaked distribution over 20 signature words + noise.

@@ -8,10 +8,32 @@ other dataset (BASELINE.md config 5 requires AUE over fed_shakespeare).
 Hermetic generation: each concept is a distinct seeded Markov chain over the
 character vocabulary; a drift changes the transition matrix, i.e. the language
 statistics. Sequences are token-id arrays [seq_len] with the next character as
-label — the same (x, y) contract as the reference's dataloader.
+label — the same (x, y) contract as the reference's dataloader
+(fed_shakespeare/utils.py::split: x = window[:-1], y = window[-1]).
+
+Real data, when present under ``data_dir``, replaces synthesis:
+
+- ``fed_shakespeare/datasets/shakespeare_train.h5`` — the TFF h5 layout
+  (examples/<client>/snippets byte strings,
+  reference fed_shakespeare/data_loader.py:20-56);
+- ``shakespeare/train/*.json`` — the LEAF layout (users / user_data x,y
+  sentence strings, reference shakespeare/data_loader.py:13-50);
+- ``stackoverflow/datasets/stackoverflow_train.h5`` + ``.word_count`` —
+  the TFF word-NWP layout (examples/<client>/tokens,
+  reference stackoverflow_nwp/data_loader.py:18-45).
+
+Concept drift on real text is an alphabet rotation: concept k serves the
+same corpus with token ids rotated by a concept-specific offset. This is
+the sequence analog of the reference's MNIST label-swap drift
+(MNIST/data_loader_cont.py:179-214) — real content, changed symbol
+semantics — chosen because the reference wires its text datasets only into
+the non-drift pipeline and defines no text-drift transform of its own.
 """
 
 from __future__ import annotations
+
+import json
+import os
 
 import numpy as np
 
@@ -24,6 +46,140 @@ SEQ_LEN = 80      # reference LEAF shakespeare sequence length. Default for
                   # path (data/registry.py) always passes
                   # ExperimentConfig.text_seq_len, whose default pins the
                   # same reference value.
+
+# The TFF character vocabulary (fed_shakespeare/utils.py::CHAR_VOCAB, 86
+# chars) plus the four structural slots (pad / bos / eos / oov) = 90 ids,
+# matching the CharLSTM's embedding table (rnn.py:18). LEAF-JSON text is
+# mapped through the same table (unknown chars -> oov) so both on-disk
+# formats produce one id space.
+CHAR_VOCAB = ('dhlptx@DHLPTX $(,048cgkoswCGKOSW[_#\'/37;?bfjnrvzBFJNRVZ"&*.26:'
+              '\naeimquyAEIMQUY]!%)-159\r')
+PAD_ID = 0
+BOS_ID = len(CHAR_VOCAB) + 1    # 87
+EOS_ID = len(CHAR_VOCAB) + 2    # 88
+OOV_ID = len(CHAR_VOCAB) + 3    # 89
+_CHAR_TO_ID = {ch: i + 1 for i, ch in enumerate(CHAR_VOCAB)}
+
+
+def _char_ids(text: str) -> np.ndarray:
+    return np.array([_CHAR_TO_ID.get(ch, OOV_ID) for ch in text], np.int32)
+
+
+# Window sampling only ever consumes C * (T+1) * sample_num windows, so a
+# bounded prefix of a huge on-disk corpus (full TFF StackOverflow is ~1.7B
+# tokens) gives identical coverage without materializing the whole stream.
+_MAX_CORPUS_IDS = 2_000_000
+
+
+def _try_load_char_corpus(data_dir: str, min_len: int,
+                          max_len: int = _MAX_CORPUS_IDS) -> np.ndarray | None:
+    """Real Shakespeare as one id stream, or None if no files are present."""
+    h5path = os.path.join(data_dir, "fed_shakespeare", "datasets",
+                          "shakespeare_train.h5")
+    chunks: list[np.ndarray] = []
+    total = 0
+    if os.path.isfile(h5path):
+        import h5py
+        with h5py.File(h5path, "r") as f:
+            for cid in sorted(f["examples"].keys()):
+                if total >= max_len:
+                    break
+                for snip in f["examples"][cid]["snippets"][()]:
+                    ids = _char_ids(snip.decode("utf8"))
+                    chunks.append(np.concatenate(
+                        [[BOS_ID], ids, [EOS_ID]]).astype(np.int32))
+                    total += len(chunks[-1])
+                    if total >= max_len:
+                        break
+    else:
+        jdir = os.path.join(data_dir, "shakespeare", "train")
+        if os.path.isdir(jdir):
+            for fn in sorted(os.listdir(jdir)):
+                if not fn.endswith(".json") or total >= max_len:
+                    continue
+                with open(os.path.join(jdir, fn)) as fh:
+                    d = json.load(fh)
+                for u in d["users"]:
+                    if total >= max_len:
+                        break
+                    ud = d["user_data"][u]
+                    for sent, nxt in zip(ud["x"], ud["y"]):
+                        chunks.append(np.concatenate(
+                            [_char_ids(sent + nxt), [EOS_ID]]).astype(np.int32))
+                        total += len(chunks[-1])
+    if not chunks:
+        return None
+    corpus = np.concatenate(chunks)[:max_len]
+    return corpus if len(corpus) >= min_len else None
+
+
+def _try_load_word_corpus(data_dir: str, vocab: int, min_len: int,
+                          max_len: int = _MAX_CORPUS_IDS) -> np.ndarray | None:
+    """Real StackOverflow token stream (TFF h5 + word_count vocab file)."""
+    base = os.path.join(data_dir, "stackoverflow", "datasets")
+    h5path = os.path.join(base, "stackoverflow_train.h5")
+    wcpath = os.path.join(base, "stackoverflow.word_count")
+    if not (os.path.isfile(h5path) and os.path.isfile(wcpath)):
+        return None
+    # word ids 1..vocab-2 by corpus frequency rank (the reference's
+    # get_most_frequent_words, stackoverflow_lr/utils.py:15-19);
+    # 0 is reserved (pad), vocab-1 is the oov bucket.
+    with open(wcpath) as fh:
+        words = [line.split()[0] for line in fh if line.strip()][: vocab - 2]
+    word_id = {w: i + 1 for i, w in enumerate(words)}
+    import h5py
+    ids: list[int] = []
+    with h5py.File(h5path, "r") as f:
+        for cid in sorted(f["examples"].keys()):
+            if len(ids) >= max_len:
+                break
+            for sent in f["examples"][cid]["tokens"][()]:
+                ids.extend(word_id.get(w, vocab - 1)
+                           for w in sent.decode("utf8").split())
+                if len(ids) >= max_len:
+                    break
+    if len(ids) < min_len:
+        return None
+    return np.asarray(ids[:max_len], np.int32)
+
+
+def _real_text_windows(
+    corpus: np.ndarray,
+    concepts: np.ndarray,
+    num_clients: int,
+    sample_num: int,
+    seq_len: int,
+    vocab: int,
+    rng: np.random.Generator,
+    noise_prob: float,
+    name: str,
+) -> DriftDataset:
+    """Serve (seq_len+1)-char windows of a real corpus; concept k rotates
+    the alphabet (see module docstring)."""
+    T1 = concepts.shape[0]
+    x = np.zeros((num_clients, T1, sample_num, seq_len), np.int32)
+    y = np.zeros((num_clients, T1, sample_num), np.int32)
+    for t in range(T1):
+        for c in range(num_clients):
+            k = int(concepts[t, c])
+            # valid starts: 0 .. len-seq_len-1 inclusive (window is
+            # seq_len+1 ids); integers() high bound is exclusive
+            starts = rng.integers(0, len(corpus) - seq_len,
+                                  size=sample_num)
+            win = corpus[starts[:, None] + np.arange(seq_len + 1)]
+            if k:
+                win = (win + 31 * k) % vocab
+            x[c, t] = win[:, :seq_len]
+            ys = win[:, seq_len].copy()
+            if noise_prob > 0:
+                flip = rng.random(sample_num) < noise_prob
+                ys = np.where(flip, rng.integers(0, vocab, size=sample_num),
+                              ys).astype(np.int32)
+            y[c, t] = ys
+    return DriftDataset(x=x, y=y, num_classes=vocab, concepts=concepts,
+                        name=name, is_sequence=True,
+                        meta={"vocab": vocab, "seq_len": seq_len,
+                              "real_data": True})
 
 
 def _concept_transition(concept: int, vocab: int) -> np.ndarray:
@@ -56,18 +212,30 @@ def generate_word_drift(
     seed: int = 0,
     seq_len: int = 20,
     vocab: int = 10000,
+    data_dir: str = "./data",
 ) -> DriftDataset:
     """Word-level next-word-prediction drift (StackOverflow NWP scale,
     reference fedml_api/data_preprocessing/stackoverflow_nwp/, WordLSTM
     model rnn.py:36-67).
 
-    At 10k vocab a dense Markov matrix would be 800 MB per concept, so each
-    concept k is instead an affine language: next = (a_k * cur + b_k) mod V
-    with per-step uniform noise — a deterministic map the embedding LSTM can
-    learn, whose parameters (the language statistics) change at drift points.
+    Real TFF StackOverflow files under ``data_dir`` are preferred (see
+    module docstring). Hermetic fallback: at 10k vocab a dense Markov
+    matrix would be 800 MB per concept, so each concept k is instead an
+    affine language: next = (a_k * cur + b_k) mod V with per-step uniform
+    noise — a deterministic map the embedding LSTM can learn, whose
+    parameters (the language statistics) change at drift points.
     """
     rng = np.random.default_rng(seed)
     T = train_iterations
+
+    corpus = _try_load_word_corpus(data_dir, vocab, min_len=seq_len + 2)
+    if corpus is not None:
+        concepts = concept_matrix(change_points, T + 1, num_clients,
+                                  time_stretch)
+        return _real_text_windows(corpus, concepts, num_clients, sample_num,
+                                  seq_len, vocab, rng, noise_prob,
+                                  "stackoverflow_nwp")
+
     n_concepts = max(int(change_points.max()) + 1, 2)
     crng = np.random.default_rng(104729)
     a = crng.integers(2, vocab - 1, size=n_concepts)
@@ -107,9 +275,19 @@ def generate_text_drift(
     seed: int = 0,
     seq_len: int = SEQ_LEN,
     vocab: int = VOCAB_SIZE,
+    data_dir: str = "./data",
 ) -> DriftDataset:
     rng = np.random.default_rng(seed)
     T = train_iterations
+
+    corpus = _try_load_char_corpus(data_dir, min_len=seq_len + 2)
+    if corpus is not None:
+        concepts = concept_matrix(change_points, T + 1, num_clients,
+                                  time_stretch)
+        return _real_text_windows(corpus, concepts, num_clients, sample_num,
+                                  seq_len, vocab, rng, noise_prob,
+                                  "shakespeare")
+
     n_concepts = int(change_points.max()) + 1
     chains = [_concept_transition(k, vocab) for k in range(max(n_concepts, 2))]
 

@@ -1,0 +1,56 @@
+#!/usr/bin/env bash
+# Canonical-scale drift-algorithm sweep on one dataset.
+#
+# Shape: fnn, 10 clients, 10 iterations x 200 rounds, 5 local steps,
+# batch 500, sample 500, lr 0.01 — the reference's canonical experiment
+# (README.md:46-50, run_fedavg_distributed_pytorch.sh). One run dir per
+# (algorithm, packed-arg) pair, named like the committed round-2 SEA sweep
+# so scripts/report.py aggregates them uniformly.
+#
+# Usage: scripts/sweep_canonical.sh <dataset> [seed]
+#   PLATFORM=cpu (default) or tpu; runs with an existing metrics.jsonl are
+#   skipped so the sweep is resumable.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+DS=${1:?dataset (sea|sine|circle|MNIST|...)}
+SEED=${2:-0}
+PLAT=${PLATFORM:-cpu}
+
+run() { # algo arg concept_num
+  local algo=$1 arg=$2 m=$3
+  local out="runs/$DS-fnn-$algo-$arg-s$SEED"
+  if [ -f "$out/metrics.jsonl" ]; then echo "=== skip (exists) $out"; return; fi
+  echo "=== $out"
+  python -m feddrift_tpu run --platform "$PLAT" \
+    --dataset "$DS" --model fnn --change_points A \
+    --client_num_in_total 10 --client_num_per_round 10 \
+    --train_iterations 10 --comm_round 200 --epochs 5 --batch_size 500 \
+    --sample_num 500 --lr 0.01 --frequency_of_the_test 50 --seed "$SEED" \
+    --concept_drift_algo "$algo" --concept_drift_algo_arg "$arg" \
+    --concept_num "$m" --out_dir "$out"
+}
+
+# FedDrift family: canonical delta=.1, per-client-init variants, and the
+# detection-sensitive delta=.03 (PARITY.md SEA caveat); pool = C for F-init.
+run softcluster H_A_C_1_10_0 4
+run softcluster H_A_F_1_10_0 10
+run softcluster H_A_F_1_3_0 10
+run softcluster cfl_0.1_win-1 4
+run softclusterwin-1 hard 4
+# Eager + oracle
+run mmacc mmacc_06 4
+run mmgeni H_A_C_1_10_0 4
+# Ensembles (KUE runs on TPU where the Poisson draw is cheap; see
+# scripts/sweep_kue_tpu.sh)
+run aue H_A_C_1_10_0 4
+run auepc H_A_C_1_10_0 4
+# State-machine / adaptive baselines
+run driftsurf H_A_C_1_10_0 4
+run clusterfl H_A_C_1_10_0 4
+run ada win-1_iter 4
+# Single-model recency baselines
+run exp H_A_C_1_10_0 4
+run lin H_A_C_1_10_0 4
+run win-1 H_A_C_1_10_0 4
+run oblivious H_A_C_1_10_0 4

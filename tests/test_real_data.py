@@ -171,3 +171,184 @@ def test_ro_csv_is_loaded_with_header_skipped(tmp_path):
 def test_uci_without_csv_synthesizes(tmp_path):
     ds = make_dataset(_cfg(tmp_path, "susy"))
     assert ds.meta["source"] == "synthetic"
+
+
+# ------------------------------------------------- fed_shakespeare TFF h5
+def _char_corpus(snippet_groups):
+    """Expected id stream for TFF snippets: [bos] ids [eos] per snippet,
+    clients in sorted-key order (mirrors text._try_load_char_corpus)."""
+    from feddrift_tpu.data.text import BOS_ID, EOS_ID, _char_ids
+    parts = []
+    for snips in snippet_groups:
+        for s in snips:
+            parts.extend([[BOS_ID], _char_ids(s), [EOS_ID]])
+    return np.concatenate([np.asarray(p, np.int32) for p in parts])
+
+
+def _write_fed_shakespeare_h5(tmp_path, snippet_groups):
+    import h5py
+    d = os.path.join(tmp_path, "fed_shakespeare", "datasets")
+    os.makedirs(d)
+    with h5py.File(os.path.join(d, "shakespeare_train.h5"), "w") as f:
+        g = f.create_group("examples")
+        for i, snips in enumerate(snippet_groups):
+            g.create_group(f"client_{i}").create_dataset(
+                "snippets", data=[s.encode("utf8") for s in snips])
+
+
+def _assert_windows_from_corpus(ds, corpus, seq_len, vocab):
+    """Every served (x, y) window must be a contiguous corpus slice after
+    undoing the concept's alphabet rotation (text._real_text_windows)."""
+    hay = corpus.astype(np.int32).tobytes()
+    C_, T1 = ds.x.shape[0], ds.x.shape[1]
+    for c in range(C_):
+        for t in range(T1):
+            k = int(ds.concepts[t, c])
+            win = np.concatenate(
+                [np.asarray(ds.x[c, t]),
+                 np.asarray(ds.y[c, t])[:, None]], axis=1).astype(np.int32)
+            win = (win - 31 * k) % vocab
+            for row in win[:: max(1, len(win) // 4)]:
+                assert hay.find(row.tobytes()) >= 0, (c, t, row)
+
+
+def test_fed_shakespeare_h5_is_loaded(tmp_path):
+    groups = [["to be or not to be that is the question",
+               "all the worlds a stage and all the men players"],
+              ["now is the winter of our discontent"]]
+    _write_fed_shakespeare_h5(tmp_path, groups)
+    ds = make_dataset(_cfg(tmp_path, "fed_shakespeare", text_seq_len=8,
+                           concept_num=2))
+    assert ds.meta["real_data"] is True and ds.is_sequence
+    _assert_windows_from_corpus(ds, _char_corpus(groups), 8, 90)
+
+
+def test_fed_shakespeare_without_files_synthesizes(tmp_path):
+    ds = make_dataset(_cfg(tmp_path, "fed_shakespeare", text_seq_len=8))
+    assert not ds.meta.get("real_data", False)
+
+
+# ------------------------------------------------- shakespeare LEAF JSON
+def test_leaf_shakespeare_json_is_loaded(tmp_path):
+    from feddrift_tpu.data.text import EOS_ID, _char_ids
+    d = os.path.join(tmp_path, "shakespeare", "train")
+    os.makedirs(d)
+    users = {"ROMEO": (["but soft what light through yonder window break"],
+                       ["s"]),
+             "JULIET": (["deny thy father and refuse thy nam"], ["e"])}
+    payload = {"users": list(users),
+               "user_data": {u: {"x": x, "y": y}
+                             for u, (x, y) in users.items()}}
+    with open(os.path.join(d, "all_data_train_9.json"), "w") as f:
+        json.dump(payload, f)
+    ds = make_dataset(_cfg(tmp_path, "shakespeare", text_seq_len=8,
+                           concept_num=2))
+    assert ds.meta["real_data"] is True
+    corpus = np.concatenate(
+        [np.concatenate([_char_ids(x[0] + y[0]), [EOS_ID]])
+         for x, y in (users[u] for u in payload["users"])]).astype(np.int32)
+    _assert_windows_from_corpus(ds, corpus, 8, 90)
+
+
+# ------------------------------------------------- stackoverflow NWP h5
+def test_stackoverflow_nwp_h5_is_loaded(tmp_path):
+    import h5py
+    d = os.path.join(tmp_path, "stackoverflow", "datasets")
+    os.makedirs(d)
+    vocab_words = [f"w{i}" for i in range(40)]
+    rng = np.random.default_rng(5)
+    sents = [" ".join(vocab_words[j] for j in rng.integers(0, 40, 30))
+             for _ in range(6)]
+    with h5py.File(os.path.join(d, "stackoverflow_train.h5"), "w") as f:
+        g = f.create_group("examples")
+        g.create_group("c0").create_dataset(
+            "tokens", data=[s.encode("utf8") for s in sents[:3]])
+        g.create_group("c1").create_dataset(
+            "tokens", data=[s.encode("utf8") for s in sents[3:]])
+    with open(os.path.join(d, "stackoverflow.word_count"), "w") as f:
+        for i, w in enumerate(vocab_words):
+            f.write(f"{w} {1000 - i}\n")
+    ds = make_dataset(_cfg(tmp_path, "stackoverflow_nwp", concept_num=2))
+    assert ds.meta["real_data"] is True and ds.is_sequence
+    # expected stream: frequency rank r -> id r+1 (0=pad, V-1=oov)
+    wid = {w: i + 1 for i, w in enumerate(vocab_words)}
+    corpus = np.asarray([wid[w] for s in sents for w in s.split()], np.int32)
+    _assert_windows_from_corpus(ds, corpus, 20, 10000)
+
+
+# ------------------------------------------------- stackoverflow LR h5
+def test_stackoverflow_lr_h5_is_loaded(tmp_path):
+    import h5py
+    d = os.path.join(tmp_path, "stackoverflow", "datasets")
+    os.makedirs(d)
+    vocab_words = [f"w{i}" for i in range(10)]
+    tags = ["python", "jax", "tpu", "xla"]
+    with open(os.path.join(d, "stackoverflow.word_count"), "w") as f:
+        for i, w in enumerate(vocab_words):
+            f.write(f"{w} {100 - i}\n")
+    with open(os.path.join(d, "stackoverflow.tag_count"), "w") as f:
+        json.dump({t: 50 - i for i, t in enumerate(tags)}, f)
+    rows = [("w0 w0 w3", "w5", "python|offvocab"),
+            ("w1 w2", "w1", "jax"),
+            ("w9 w9 w9", "", "tpu|xla"),
+            ("w4", "w4 w4", "xla")]
+    with h5py.File(os.path.join(d, "stackoverflow_train.h5"), "w") as f:
+        g = f.create_group("examples").create_group("c0")
+        g.create_dataset("tokens", data=[r[0].encode() for r in rows])
+        g.create_dataset("title", data=[r[1].encode() for r in rows])
+        g.create_dataset("tags", data=[r[2].encode() for r in rows])
+    ds = make_dataset(_cfg(tmp_path, "stackoverflow_lr",
+                           so_vocab_size=10, so_tag_size=4, concept_num=2))
+    assert ds.meta["real_data"] is True
+    # sample 0 under concept 0: counts w0 x2, w3 x1, w5 x1; tag python=0
+    expect0 = np.zeros(10, np.float32)
+    expect0[[0, 3, 5]] = [2, 1, 1]
+    flat_x = np.asarray(ds.x).reshape(-1, 10)
+    assert any(np.array_equal(r, expect0) for r in flat_x)
+    for c in range(C):
+        for t in range(T + 1):
+            if int(ds.concepts[t, c]) == 0:
+                # identity permutation serves the true principal tags
+                assert set(np.asarray(ds.y[c, t]).tolist()) <= {0, 1, 2, 3}
+
+
+# ------------------------------------------------- FederatedEMNIST h5
+def test_federated_emnist_h5_is_loaded(tmp_path):
+    import h5py
+    rng = np.random.default_rng(17)
+    d = os.path.join(tmp_path, "FederatedEMNIST")
+    os.makedirs(d)
+    px = rng.random((30, 28, 28)).astype(np.float32)
+    lab = rng.integers(0, 62, 30)
+    with h5py.File(os.path.join(d, "emnist_train.h5"), "w") as f:
+        f.create_dataset("pixels", data=px)
+        f.create_dataset("label", data=lab)
+        f.create_dataset("id", data=np.zeros(30, np.int64))
+    ds = make_dataset(_cfg(tmp_path, "femnist", concept_num=2))
+    assert ds.meta["real_data"] is True
+    source = {p.reshape(784).astype(np.float32).tobytes() for p in px}
+    flat = np.asarray(ds.x).reshape(-1, 784)
+    for row in flat[:: max(1, len(flat) // 8)]:
+        assert row.astype(np.float32).tobytes() in source
+
+
+# ------------------------------------------------- fed_cifar100 h5
+def test_fed_cifar100_h5_is_loaded(tmp_path):
+    import h5py
+    rng = np.random.default_rng(19)
+    d = os.path.join(tmp_path, "fed_cifar100")
+    os.makedirs(d)
+    img = rng.integers(0, 256, (24, 32, 32, 3)).astype(np.uint8)
+    lab = rng.integers(0, 100, 24)
+    with h5py.File(os.path.join(d, "cifar100_train.h5"), "w") as f:
+        f.create_dataset("image", data=img)
+        f.create_dataset("label", data=lab)
+        f.create_dataset("id", data=np.zeros(24, np.int64))
+    ds = make_dataset(_cfg(tmp_path, "fed_cifar100", concept_num=2))
+    assert ds.meta["real_data"] is True
+    # uint8 -> [0, 1] float; every served image is one of the fixtures
+    source = {(img[i] / 255.0).astype(np.float32).tobytes()
+              for i in range(len(img))}
+    flat = np.asarray(ds.x).reshape(-1, 32, 32, 3)
+    for row in flat[:: max(1, len(flat) // 8)]:
+        assert row.astype(np.float32).tobytes() in source
