@@ -3,16 +3,26 @@
 The reference's MNIST drift pipeline simulates concept drift by *label
 swapping*: concept 1 swaps labels 1<->2, concept 2 swaps 3<->4, concept 3
 swaps 5<->6 (fedml_api/data_preprocessing/MNIST/data_loader_cont.py:179-214).
-The underlying images come from LEAF-format JSON that must be downloaded; in a
-hermetic environment we synthesize class-conditional images instead: each
-class has a fixed random prototype image (seeded independently of the
-experiment seed) and samples are prototype + Gaussian noise. This preserves
-the *learning problem structure* the drift algorithms see — a classification
-task whose label semantics change at change points — with identical tensor
-shapes (MNIST 784, FEMNIST 784/62-way, CIFAR-10 32x32x3).
+The underlying images come from files that must be downloaded; in a hermetic
+environment we synthesize class-conditional images instead (see
+``PrototypeSampler``). This preserves the *learning problem structure* the
+drift algorithms see — a classification task whose label semantics change at
+change points — with identical tensor shapes (MNIST 784, FEMNIST 784/62-way,
+CIFAR-10 32x32x3).
 
-If real data is available at ``data_dir`` (LEAF JSON for MNIST/FEMNIST, numpy
-batches for CIFAR), it is used instead of prototypes.
+Real files under ``data_dir`` are used instead of prototypes when present:
+
+- ``MNIST/train/*.json`` — LEAF JSON (reference MNIST/data_loader_cont.py);
+- ``FederatedEMNIST/emnist_train.h5`` — TFF h5, pixels/label/id
+  (reference FederatedEMNIST/data_loader.py:16-33);
+- ``fed_cifar100/cifar100_train.h5`` — TFF h5, image/label/id
+  (reference fed_cifar100/data_loader.py:15-32);
+- ``cifar-10-batches-py/data_batch_{1..5}`` / ``cifar-100-python/train`` —
+  the standard CIFAR python pickle batches torchvision downloads (the
+  reference loads CIFAR via torchvision, cifar10/data_loader.py:104).
+
+cinic10 has no real-file loader (an image-folder tree needs a decoder this
+hermetic environment lacks) and always synthesizes.
 """
 
 from __future__ import annotations
@@ -52,16 +62,36 @@ def apply_label_swap(y: np.ndarray, concept: int, num_classes: int) -> np.ndarra
 
 
 class PrototypeSampler:
-    """Class-conditional sampler: fixed per-class prototypes + noise."""
+    """Class-conditional sampler: low-rank class structure + strong noise.
+
+    Round-2 finding: independent full-dimensional random prototypes are
+    nearly linearly separable at any noise level (pairwise prototype
+    distance grows with sqrt(D)), so conv runs saturated at Test/Acc 1.0
+    and accuracy comparisons were meaningless. Classes now live in a
+    shared ``rank``-dimensional subspace, separated by coefficient offsets
+    of scale ``sep`` against sample noise of scale ``noise_scale`` — the
+    class-distance/noise ratio no longer grows with image size, the Bayes
+    accuracy is strictly below 1, and harder datasets (62/100 classes in
+    the same subspace) are genuinely harder, qualitatively matching real
+    MNIST < FEMNIST < CIFAR difficulty ordering.
+    """
 
     def __init__(self, feature_shape: tuple[int, ...], num_classes: int,
-                 noise_scale: float = 0.35, proto_seed: int = 1234) -> None:
+                 noise_scale: float = 0.8, sep: float = 0.7, rank: int = 16,
+                 proto_seed: int = 1234) -> None:
+        # sep=0.7 calibration (subspace linear probe, 8k train samples):
+        # MNIST-10 ~0.89, femnist-62 ~0.60, cifar10 ~0.86, cifar100 ~0.34
+        # — below ceiling, above chance, ordered by class count.
         self.feature_shape = feature_shape
         self.num_classes = num_classes
         self.noise_scale = noise_scale
         proto_rng = np.random.default_rng(proto_seed)
-        # Prototypes in [0, 1], smoothed to look image-like enough for convs.
-        self.prototypes = proto_rng.random((num_classes, *feature_shape)).astype(np.float32)
+        dim = int(np.prod(feature_shape))
+        basis = proto_rng.normal(size=(rank, dim))
+        basis /= np.linalg.norm(basis, axis=1, keepdims=True)
+        coef = proto_rng.normal(size=(num_classes, rank)) * sep
+        self.prototypes = (0.5 + coef @ basis).reshape(
+            num_classes, *feature_shape).astype(np.float32)
 
     def sample(self, rng: np.random.Generator, n: int) -> tuple[np.ndarray, np.ndarray]:
         y = rng.integers(0, self.num_classes, size=n).astype(np.int32)
@@ -94,7 +124,8 @@ def _try_load_leaf_mnist(data_dir: str) -> tuple[np.ndarray, np.ndarray] | None:
 
 
 def _try_load_tff_h5(path: str, x_key: str,
-                     feature_shape: tuple[int, ...]
+                     feature_shape: tuple[int, ...],
+                     max_samples: int = 200_000,
                      ) -> tuple[np.ndarray, np.ndarray] | None:
     """Load a flat TFF-style image h5 (datasets ``<x_key>``/``label``/``id``).
 
@@ -103,7 +134,10 @@ def _try_load_tff_h5(path: str, x_key: str,
     (image/label/id, fed_cifar100/data_loader.py:15-32). The per-sample
     ``id`` client ownership is intentionally not used: the drift pipeline
     re-partitions by (client, time step) with its own change-point matrix,
-    the same way the MNIST LEAF loader pools users before slicing.
+    the same way the MNIST LEAF loader pools users before slicing. Only a
+    ``max_samples`` prefix is read (h5 slicing never materializes the rest)
+    — downstream consumes C*(T+1)*sample_num samples, and the full
+    FederatedEMNIST split would be several float32 GB.
     """
     if not os.path.isfile(path):
         return None
@@ -111,8 +145,8 @@ def _try_load_tff_h5(path: str, x_key: str,
     with h5py.File(path, "r") as f:
         if x_key not in f or "label" not in f:
             return None
-        X = np.asarray(f[x_key][()], np.float32)
-        Y = np.asarray(f["label"][()], np.int32)
+        X = np.asarray(f[x_key][:max_samples], np.float32)
+        Y = np.asarray(f["label"][:max_samples], np.int32)
     if X.size == 0:
         return None
     if X.max() > 1.5:              # uint8-encoded images -> [0, 1]
@@ -121,6 +155,42 @@ def _try_load_tff_h5(path: str, x_key: str,
     rng = np.random.default_rng(100)   # same fixed shuffle as LEAF MNIST
     perm = rng.permutation(len(X))
     return X[perm], Y[perm]
+
+
+def _try_load_cifar_batches(data_dir: str, name: str
+                            ) -> tuple[np.ndarray, np.ndarray] | None:
+    """Load standard CIFAR python pickle batches (the layout torchvision's
+    ``CIFAR10(download=True)`` produces, which is how the reference obtains
+    CIFAR, cifar10/data_loader.py:104): ``cifar-10-batches-py/
+    data_batch_{1..5}`` with b"data" [N, 3072] uint8 (CHW row-major) +
+    b"labels"; ``cifar-100-python/train`` with b"fine_labels"."""
+    import pickle
+    if name == "cifar10":
+        d = os.path.join(data_dir, "cifar-10-batches-py")
+        files = [f"data_batch_{i}" for i in range(1, 6)]
+        label_key = b"labels"
+    else:
+        d = os.path.join(data_dir, "cifar-100-python")
+        files = ["train"]
+        label_key = b"fine_labels"
+    if not os.path.isdir(d):
+        return None
+    X, Y = [], []
+    for fn in files:
+        p = os.path.join(d, fn)
+        if not os.path.isfile(p):
+            continue
+        with open(p, "rb") as fh:
+            batch = pickle.load(fh, encoding="bytes")
+        X.append(np.asarray(batch[b"data"], np.uint8))
+        Y.extend(int(v) for v in batch[label_key])
+    if not X:
+        return None
+    flat = np.concatenate(X).reshape(-1, 3, 32, 32)
+    imgs = (flat.transpose(0, 2, 3, 1) / 255.0).astype(np.float32)
+    rng = np.random.default_rng(100)   # same fixed shuffle as LEAF MNIST
+    perm = rng.permutation(len(imgs))
+    return imgs[perm], np.asarray(Y, np.int32)[perm]
 
 
 def generate_prototype_drift(
@@ -149,6 +219,8 @@ def generate_prototype_drift(
         real = _try_load_tff_h5(
             os.path.join(data_dir, "fed_cifar100", "cifar100_train.h5"),
             "image", feature_shape)
+    elif name in ("cifar10", "cifar100"):
+        real = _try_load_cifar_batches(data_dir, name)
     sampler = PrototypeSampler(feature_shape, num_classes)
     used = 0
 

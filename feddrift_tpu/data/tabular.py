@@ -162,18 +162,17 @@ def _try_load_stackoverflow_lr(
     tcpath = os.path.join(base, "stackoverflow.tag_count")
     if not all(os.path.isfile(p) for p in (h5path, wcpath, tcpath)):
         return None
-    with open(wcpath) as fh:
-        words = [ln.split()[0] for ln in fh if ln.strip()][:vocab_size]
-    word_id = {w: i for i, w in enumerate(words)}
+    from feddrift_tpu.data.text import iter_tff_clients, load_word_ranks
+    word_id = {w: i for i, w in enumerate(load_word_ranks(wcpath, vocab_size))}
     with open(tcpath) as fh:
         tag_id = {t: i for i, t in enumerate(list(json.load(fh))[:tag_size])}
     import h5py
     X, Y = [], []
     with h5py.File(h5path, "r") as f:
-        for cid in sorted(f["examples"].keys()):
+        for ex in iter_tff_clients(f):
             if len(X) >= max_samples:   # the drift pipeline consumes only
                 break                   # C*(T+1)*sample_num samples; a
-            ex = f["examples"][cid]     # bounded prefix avoids OOM on the
+                                        # bounded prefix avoids OOM on the
                                         # full ~135M-example split
             titles = ex["title"][()] if "title" in ex else [b""] * len(ex["tokens"])
             for tok, tit, tag in zip(ex["tokens"][()], titles, ex["tags"][()]):

@@ -65,6 +65,21 @@ def _char_ids(text: str) -> np.ndarray:
     return np.array([_CHAR_TO_ID.get(ch, OOV_ID) for ch in text], np.int32)
 
 
+def load_word_ranks(path: str, k: int) -> list[str]:
+    """Top-k words of a TFF ``word_count`` file ("word count" per line,
+    frequency-ranked — the reference's get_most_frequent_words,
+    stackoverflow_lr/utils.py:15-19). Shared by the NWP and LR loaders."""
+    with open(path) as fh:
+        return [ln.split()[0] for ln in fh if ln.strip()][:k]
+
+
+def iter_tff_clients(h5file):
+    """Yield the ``examples/<client>`` groups of a TFF-layout h5 in sorted
+    client-key order (deterministic corpus identity across runs)."""
+    for cid in sorted(h5file["examples"].keys()):
+        yield h5file["examples"][cid]
+
+
 # Window sampling only ever consumes C * (T+1) * sample_num windows, so a
 # bounded prefix of a huge on-disk corpus (full TFF StackOverflow is ~1.7B
 # tokens) gives identical coverage without materializing the whole stream.
@@ -81,10 +96,10 @@ def _try_load_char_corpus(data_dir: str, min_len: int,
     if os.path.isfile(h5path):
         import h5py
         with h5py.File(h5path, "r") as f:
-            for cid in sorted(f["examples"].keys()):
+            for ex in iter_tff_clients(f):
                 if total >= max_len:
                     break
-                for snip in f["examples"][cid]["snippets"][()]:
+                for snip in ex["snippets"][()]:
                     ids = _char_ids(snip.decode("utf8"))
                     chunks.append(np.concatenate(
                         [[BOS_ID], ids, [EOS_ID]]).astype(np.int32))
@@ -121,19 +136,17 @@ def _try_load_word_corpus(data_dir: str, vocab: int, min_len: int,
     wcpath = os.path.join(base, "stackoverflow.word_count")
     if not (os.path.isfile(h5path) and os.path.isfile(wcpath)):
         return None
-    # word ids 1..vocab-2 by corpus frequency rank (the reference's
-    # get_most_frequent_words, stackoverflow_lr/utils.py:15-19);
+    # word ids 1..vocab-2 by corpus frequency rank;
     # 0 is reserved (pad), vocab-1 is the oov bucket.
-    with open(wcpath) as fh:
-        words = [line.split()[0] for line in fh if line.strip()][: vocab - 2]
-    word_id = {w: i + 1 for i, w in enumerate(words)}
+    word_id = {w: i + 1
+               for i, w in enumerate(load_word_ranks(wcpath, vocab - 2))}
     import h5py
     ids: list[int] = []
     with h5py.File(h5path, "r") as f:
-        for cid in sorted(f["examples"].keys()):
+        for ex in iter_tff_clients(f):
             if len(ids) >= max_len:
                 break
-            for sent in f["examples"][cid]["tokens"][()]:
+            for sent in ex["tokens"][()]:
                 ids.extend(word_id.get(w, vocab - 1)
                            for w in sent.decode("utf8").split())
                 if len(ids) >= max_len:

@@ -352,3 +352,39 @@ def test_fed_cifar100_h5_is_loaded(tmp_path):
     flat = np.asarray(ds.x).reshape(-1, 32, 32, 3)
     for row in flat[:: max(1, len(flat) // 8)]:
         assert row.astype(np.float32).tobytes() in source
+
+
+# ------------------------------------------------- CIFAR pickle batches
+def test_cifar10_pickle_batches_are_loaded(tmp_path):
+    import pickle
+    rng = np.random.default_rng(23)
+    d = os.path.join(tmp_path, "cifar-10-batches-py")
+    os.makedirs(d)
+    imgs = rng.integers(0, 256, (20, 3, 32, 32)).astype(np.uint8)
+    labs = rng.integers(0, 10, 20)
+    for i in range(1, 6):
+        sl = slice((i - 1) * 4, i * 4)
+        with open(os.path.join(d, f"data_batch_{i}"), "wb") as f:
+            pickle.dump({b"data": imgs[sl].reshape(4, 3072),
+                         b"labels": labs[sl].tolist()}, f)
+    ds = make_dataset(_cfg(tmp_path, "cifar10", concept_num=2))
+    assert ds.meta["real_data"] is True
+    source = {(imgs[i].transpose(1, 2, 0) / 255.0).astype(np.float32).tobytes()
+              for i in range(len(imgs))}
+    flat = np.asarray(ds.x).reshape(-1, 32, 32, 3)
+    for row in flat[:: max(1, len(flat) // 8)]:
+        assert row.astype(np.float32).tobytes() in source
+
+
+def test_cifar100_pickle_train_is_loaded(tmp_path):
+    import pickle
+    rng = np.random.default_rng(29)
+    d = os.path.join(tmp_path, "cifar-100-python")
+    os.makedirs(d)
+    imgs = rng.integers(0, 256, (16, 3, 32, 32)).astype(np.uint8)
+    labs = rng.integers(0, 100, 16)
+    with open(os.path.join(d, "train"), "wb") as f:
+        pickle.dump({b"data": imgs.reshape(16, 3072),
+                     b"fine_labels": labs.tolist()}, f)
+    ds = make_dataset(_cfg(tmp_path, "cifar100", concept_num=2))
+    assert ds.meta["real_data"] is True
