@@ -1,0 +1,76 @@
+#!/usr/bin/env bash
+# Keep TPU work flowing across axon-tunnel flakes (round-2 verdict item 1:
+# "keep the background probe loop running all round; when it reports up,
+# immediately run bench").
+#
+# Loop: probe the tunnel in a subprocess (a hung client would wedge this
+# shell's jax forever) -> when up, run the tracked-config queue (resumable;
+# partial dirs from a mid-run flake are cleared so the next pass reruns
+# them) -> when the host CPU is otherwise idle, run the full TPU benchmark
+# and persist it to BENCH_r03_tpu.json on success. Exits when both the
+# bench artifact and all queue targets exist.
+set -uo pipefail
+cd "$(dirname "$0")/.."
+
+TARGETS=(
+  cifar10-resnet-softclusterwin-1-hard-r-s0
+  femnist-cnn-ada-win-1_iter-100c-s0
+  fed_shakespeare-rnn-aue-50c-s0
+  sea-fnn-kue-canonical-s0
+  sine-fnn-kue-canonical-s0
+  circle-fnn-kue-canonical-s0
+)
+
+probe() {
+  timeout 150 python -c "
+import jax, jax.numpy as jnp
+jax.block_until_ready(jnp.ones((8, 8)) @ jnp.ones((8, 8)))
+print(jax.default_backend())" 2>/dev/null | tail -1
+}
+
+have() { compgen -G "runs/$1/*/metrics.jsonl" > /dev/null \
+         || [ -f "runs/$1/metrics.jsonl" ]; }
+
+clear_partials() {   # a dir without metrics.jsonl is a flake casualty
+  for t in "${TARGETS[@]}"; do
+    if [ -d "runs/$t" ] && ! have "$t"; then
+      echo "[sup] clearing partial runs/$t"
+      rm -rf "runs/$t"
+    fi
+  done
+}
+
+all_done() {
+  [ -s BENCH_r03_tpu.json ] || return 1
+  for t in "${TARGETS[@]}"; do have "$t" || return 1; done
+}
+
+# Any feddrift run/test on this 1-core host would contend with the bench's
+# measured CPU baseline and inflate vs_baseline; match broadly (CPU is the
+# default backend, so "--platform cpu" alone is not a reliable marker).
+cpu_quiet() { ! pgrep -f "feddrift_tpu|scaling_bench|pytest" > /dev/null; }
+
+while ! all_done; do
+  b=$(probe || true)
+  if [ "$b" != "tpu" ]; then
+    echo "[sup] $(date +%T) tunnel down (probe: '${b:-none}'); retry in 120s"
+    sleep 120
+    continue
+  fi
+  echo "[sup] $(date +%T) tunnel up"
+  if [ ! -s BENCH_r03_tpu.json ] && cpu_quiet; then
+    echo "[sup] running full benchmark"
+    if python bench.py > /tmp/bench_try.json 2>> /tmp/bench_try.err \
+       && grep -q '"backend": "tpu"' /tmp/bench_try.json \
+       && ! grep -q '"error"' /tmp/bench_try.json; then
+      cp /tmp/bench_try.json BENCH_r03_tpu.json
+      echo "[sup] benchmark captured"
+    else
+      echo "[sup] benchmark attempt failed"
+    fi
+  fi
+  clear_partials
+  bash scripts/run_tracked_tpu.sh || echo "[sup] queue pass ended with failure"
+  sleep 10
+done
+echo "[sup] all TPU work complete"
