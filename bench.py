@@ -148,13 +148,61 @@ def _flops_per_round(exp) -> float:
     return float(train + eval_amortised)
 
 
+def _json_from_subprocess(cmd: list[str], timeout: float, tag: str):
+    """Run cmd, return the last JSON line of its stdout, or None — with the
+    stderr tail surfaced in the warning so a crash is distinguishable from
+    a timeout."""
+    import subprocess
+
+    try:
+        out = subprocess.run(cmd, capture_output=True, text=True,
+                             timeout=timeout,
+                             cwd=os.path.dirname(os.path.abspath(__file__)))
+        for line in reversed(out.stdout.strip().splitlines()):
+            try:
+                return json.loads(line)
+            except json.JSONDecodeError:
+                continue
+        print(json.dumps({"warning": f"{tag} produced no JSON",
+                          "stderr": (out.stderr or "")[-300:]}),
+              file=sys.stderr)
+    except subprocess.TimeoutExpired:
+        print(json.dumps({"warning": f"{tag} timed out after {timeout:.0f}s"}),
+              file=sys.stderr)
+    return None
+
+
+# The two CPU-side baselines are backend-independent and cost tens of
+# minutes on this 1-core host; the supervisor reruns bench.py after every
+# tunnel flake, so they are cached on disk across invocations.
+_BASELINE_CACHE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                               ".bench_baseline_cache.json")
+
+
+def _baseline_cache(key: str, measure):
+    try:
+        with open(_BASELINE_CACHE) as f:
+            cache = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        cache = {}
+    if key in cache:
+        return cache[key]
+    val = measure()
+    if val is not None:
+        cache[key] = val
+        try:
+            with open(_BASELINE_CACHE, "w") as f:
+                json.dump(cache, f)
+        except OSError:
+            pass
+    return val
+
+
 def _measure_cpu_baseline(smoke: bool) -> float | None:
     """Rounds/s of the canonical config on this host's CPU through the
     PER-ROUND dispatch path (chunk_rounds=False) — the measured stand-in
     for the reference's per-round message loop. Runs in a subprocess so the
     main process's backend choice (TPU) is untouched."""
-    import subprocess
-
     code = (
         "import jax, json, time;"
         "jax.config.update('jax_platforms', 'cpu');"
@@ -170,22 +218,9 @@ def _measure_cpu_baseline(smoke: bool) -> float | None:
         "t0 = time.time(); exp.run_iteration(2);"
         "jax.block_until_ready(exp.pool.params);"
         "print(json.dumps({'rps': cfg.comm_round / (time.time() - t0)}))")
-    try:
-        out = subprocess.run([sys.executable, "-c", code],
-                             capture_output=True, text=True, timeout=1200,
-                             cwd=os.path.dirname(os.path.abspath(__file__)))
-        for line in reversed(out.stdout.strip().splitlines()):
-            try:
-                return float(json.loads(line)["rps"])
-            except (json.JSONDecodeError, KeyError):
-                continue
-        print(json.dumps({"warning": "cpu baseline produced no number",
-                          "stderr": (out.stderr or "")[-300:]}),
-              file=sys.stderr)
-    except subprocess.TimeoutExpired:
-        print(json.dumps({"warning": "cpu baseline timed out"}),
-              file=sys.stderr)
-    return None
+    d = _json_from_subprocess([sys.executable, "-c", code], 1200,
+                              "cpu baseline")
+    return float(d["rps"]) if d and "rps" in d else None
 
 
 def _measure_with_retry(cfg, backend: str, attempts: int = 2) -> dict:
@@ -213,6 +248,18 @@ def _measure_with_retry(cfg, backend: str, attempts: int = 2) -> dict:
                               f"{type(e).__name__}: {str(e)[:200]}"}),
                   file=sys.stderr)
     return {"error": f"{type(last).__name__}: {str(last)[:300]}"}
+
+
+def _measure_reference_shape() -> dict | None:
+    """Cross-framework datapoint: the reference's execution shape
+    (per-model torch loops, Adam steps, pickled state_dict transport,
+    weighted averaging — scripts/reference_shape_bench.py) timed on this
+    host's CPU in a subprocess. Complements the intra-framework baseline:
+    same canonical config, same silicon, different framework."""
+    script = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "scripts", "reference_shape_bench.py")
+    return _json_from_subprocess([sys.executable, script], 900,
+                                 "reference-shape baseline")
 
 
 def _measure(cfg, backend: str) -> dict:
@@ -265,9 +312,14 @@ def main() -> None:
         backend, probe_diag = _probe_backend()
     _enable_compile_cache()
 
-    # Measured baseline (see module docstring). Skipped under --smoke (the
+    # Measured baselines (see module docstring). Skipped under --smoke (the
     # CI-sized check must stay fast; vs_baseline is reported null there).
-    baseline_rps = None if smoke else _measure_cpu_baseline(smoke)
+    # Disk-cached: supervisor retries after a tunnel flake must not re-pay
+    # ~35 min of backend-independent single-core work.
+    baseline_rps = None if smoke else _baseline_cache(
+        "cpu_per_round_rps", lambda: _measure_cpu_baseline(smoke))
+    ref_shape = None if smoke else _baseline_cache(
+        "torch_reference_shape", _measure_reference_shape)
 
     baseline_obj = ({"rounds_per_sec": round(baseline_rps, 3),
                      "what": "same config, this host CPU, per-round "
@@ -311,6 +363,10 @@ def main() -> None:
         "vs_baseline": (round(res["value"] / baseline_rps, 3)
                         if baseline_rps else None),
         "baseline": baseline_obj,
+        "baseline_torch_reference_shape": ref_shape,
+        "vs_torch_reference_shape": (
+            round(res["value"] / ref_shape["rounds_per_sec"], 3)
+            if ref_shape and ref_shape.get("rounds_per_sec") else None),
         "backend": backend,
         "probe": probe_diag,
         "conv_bench": conv,

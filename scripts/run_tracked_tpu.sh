@@ -8,23 +8,54 @@
 #   3. cifar10 / resnet IFCA hard-r, 10 clients, 10 x 100 rounds
 #   4. FederatedEMNIST / cnn Adaptive-FedAvg, 100 clients, 10 x 100 rounds
 #   5. fed_shakespeare / rnn AUE, 50 clients, >=1000 samples/client
-# Runs are resumable (skipped when metrics.jsonl exists). A tunnel flake
-# fails ONE run, not the queue: the partial dir is cleared so the next
-# supervisor pass reruns it (scripts/tpu_supervisor.sh).
+# Completion is marked by a .done sentinel written only on zero exit —
+# metrics.jsonl existence is NOT completion (the runner creates and appends
+# it from round one, so a killed run leaves a plausible-looking partial
+# file). A tunnel flake fails ONE run, not the queue: if the run got far
+# enough to write a per-iteration checkpoint it is RESUMED on the next
+# supervisor pass (cli.py resume); otherwise it reruns fresh. Three
+# failures mark the target .giveup so a deterministic breakage can't spin
+# the supervisor forever.
 set -uo pipefail
 cd "$(dirname "$0")/.."
 
 FAIL=0
 run() { # out_dir args...
   local out="runs/$1"; shift
-  if compgen -G "$out/*/metrics.jsonl" > /dev/null || [ -f "$out/metrics.jsonl" ]; then
-    echo "=== skip (exists) $out"; return
+  if [ -f "$out/.done" ]; then echo "=== skip (done) $out"; return; fi
+  if [ -f "$out/.giveup" ]; then echo "=== skip (GIVEN UP) $out"; return; fi
+  local nested
+  nested=$(compgen -G "$out/*/ckpt/MANIFEST.json" | head -1 || true)
+  local -a cmd
+  if [ -n "$nested" ]; then
+    echo "=== resume $out"
+    cmd=(python -m feddrift_tpu resume
+         --out_dir "$(dirname "$(dirname "$nested")")")
+  else
+    echo "=== $out"
+    cmd=(python -m feddrift_tpu run --out_dir "$out" --seed 0 "$@")
   fi
-  echo "=== $out"
-  if ! python -m feddrift_tpu run --out_dir "$out" --seed 0 "$@"; then
-    echo "!!! failed $out (clearing partial dir)"
-    rm -rf "$out"
+  if "${cmd[@]}"; then
+    touch "$out/.done"
+  else
     FAIL=1
+    local n=0
+    [ -f "$out/.fails" ] && n=$(cat "$out/.fails")
+    n=$((n + 1))
+    if [ -z "$nested" ]; then
+      # no checkpoint to resume from: clear so the rerun's metrics append
+      # to a fresh file (duplicated rows otherwise)
+      echo "!!! failed $out (no checkpoint; clearing for fresh rerun)"
+      rm -rf "$out"
+    else
+      echo "!!! failed $out (checkpoint kept; will resume)"
+    fi
+    mkdir -p "$out"
+    echo "$n" > "$out/.fails"
+    if [ "$n" -ge 3 ]; then
+      echo "!!! giving up on $out after $n failures"
+      touch "$out/.giveup"
+    fi
   fi
 }
 
