@@ -1,0 +1,58 @@
+"""Materialize REAL handwritten-digit data in the LEAF MNIST layout.
+
+This hermetic environment has no network egress (BASELINE.md "Real-data
+availability"), so the reference's LEAF MNIST download is unreachable — but
+scikit-learn ships the UCI ML hand-written digits set offline
+(``sklearn.datasets.load_digits``: 1,797 genuine human-written digits,
+8x8 grayscale). This script upsamples them to the MNIST 28x28 geometry
+(4x nearest-neighbor then 2px border crop), scales intensities to [0, 1],
+and writes the LEAF train-JSON layout the MNIST ingestion path consumes
+(reference MNIST/data_loader_cont.py:152-171 — users / num_samples /
+user_data{x: 784-float lists, y: labels}).
+
+Runs that train on this data are REAL-image runs: the label-swap concept
+drift (data_loader_cont.py:179-214) is applied to genuine handwritten
+digits by the normal loader path, exactly as it would be to downloaded
+MNIST. Usage:
+
+    python scripts/make_digits_leaf.py [data_dir]   # default ./data
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+import numpy as np
+
+
+def main() -> None:
+    from sklearn.datasets import load_digits
+
+    data_dir = sys.argv[1] if len(sys.argv) > 1 else "./data"
+    d = load_digits()
+    imgs = np.kron(d.images / 16.0, np.ones((4, 4)))[:, 2:-2, 2:-2]
+    assert imgs.shape[1:] == (28, 28)
+    x = imgs.reshape(len(imgs), 784).round(4)
+
+    out = os.path.join(data_dir, "MNIST", "train")
+    os.makedirs(out, exist_ok=True)
+    # single-writer LEAF file; the loader pools users before its own
+    # fixed-seed shuffle, so one user is equivalent to many
+    payload = {
+        "users": ["sklearn_digits"],
+        "num_samples": [len(x)],
+        "user_data": {"sklearn_digits": {"x": x.tolist(),
+                                         "y": d.target.tolist()}},
+    }
+    path = os.path.join(out, "all_data_digits_train.json")
+    with open(path, "w") as f:
+        json.dump(payload, f)
+    print(json.dumps({"written": path, "samples": len(x),
+                      "source": "sklearn load_digits (UCI ML hand-written "
+                                "digits, real human-written)"}))
+
+
+if __name__ == "__main__":
+    main()
