@@ -49,6 +49,28 @@ import optax
 from feddrift_tpu.core.functional import confusion_matrix, cross_entropy, tree_select
 
 
+def weight_cdf(weights: jnp.ndarray) -> jnp.ndarray:
+    """Normalized inclusive cumsum of non-negative weights, for
+    ``inverse_cdf_draw``."""
+    cdf = jnp.cumsum(weights)
+    return cdf / cdf[-1]
+
+
+def inverse_cdf_draw(key, cdf: jnp.ndarray, batch: int) -> jnp.ndarray:
+    """Sample ``batch`` indices i with P(i) = cdf[i] - cdf[i-1].
+
+    Inverse-CDF sampling: B uniforms + a B*log(K) binary search, replacing
+    the per-draw Gumbel categorical (B*K noise + argmax) that was KUE's
+    measured hot op (round-2 verdict item 7). side="right" maps
+    u in [cdf[i-1], cdf[i]) to i, so zero-weight cells (including leading
+    zeros at u=0) are never selected; the clip is a numerical backstop for
+    u == 1.0 - eps rounding.
+    """
+    u = jax.random.uniform(key, (batch,))
+    return jnp.clip(jnp.searchsorted(cdf, u, side="right"),
+                    0, cdf.shape[0] - 1)
+
+
 def make_optimizer(name: str, lr: float, wd: float) -> optax.GradientTransformation:
     """Client optimizer. Reference: SGD(lr) or Adam(lr, wd, amsgrad=True)
     (FedAvgEnsTrainer.py:28-33)."""
@@ -99,12 +121,19 @@ class TrainStep:
         active = total_w > 0
 
         if self.weighted_sampling:
-            # Per-sample categorical logits over the flattened [T1*N] axis:
-            # p[t, n] ∝ w_t[t] * s_n[n]. Uniform fallback keeps logits finite
-            # for inactive pairs (their result is masked out below).
+            # Per-sample weights over the flattened [T1*N] axis:
+            # p[t, n] ∝ w_t[t] * s_n[n]. Uniform fallback keeps the
+            # distribution proper for inactive pairs (their result is
+            # masked out below). Sampling is inverse-CDF: the cumsum is
+            # computed ONCE per (model, client) round (weights are fixed
+            # across the scan's steps), and each batch draw is B uniforms +
+            # a B*log(T1*N) binary search — versus the per-draw Gumbel
+            # categorical's B*T1*N noise+argmax, which was the measured hot
+            # op of KUE rounds (round-2 verdict item 7). Same distribution,
+            # different RNG realization.
             probs = jnp.where(active, 1.0, 0.0) * (w_t[:, None] * s_n[None, :])
             probs = jnp.where(probs.sum() > 0, probs, jnp.ones_like(probs))
-            logits_flat = jnp.log(probs.reshape(-1) + 1e-30)
+            cdf = weight_cdf(probs.reshape(-1))
         # Time-step-level logits for contiguous-batch mode.
         wt_safe = jnp.where(total_w > 0, w_t, jnp.ones_like(w_t))
         logits_t = jnp.log(wt_safe + 1e-30)
@@ -121,7 +150,7 @@ class TrainStep:
             k1, k2 = jax.random.split(k)
             if self.weighted_sampling:
                 # weighted per-sample batch (with replacement)
-                idx = jax.random.categorical(k1, logits_flat, shape=(B,))
+                idx = inverse_cdf_draw(k1, cdf, B)
             else:
                 # contiguous batch: t ~ Cat(w), slot ~ U[0, nb)
                 t_idx = jax.random.categorical(k1, logits_t)

@@ -41,10 +41,11 @@ run softclusterwin-1 hard 4
 # Eager + oracle
 run mmacc mmacc_06 4
 run mmgeni H_A_C_1_10_0 4
-# Ensembles (KUE runs on TPU where the Poisson draw is cheap; see
-# scripts/sweep_kue_tpu.sh)
+# Ensembles (KUE canonical became CPU-feasible in round 3 after the batch
+# draw moved to inverse-CDF sampling, core/step.py::inverse_cdf_draw)
 run aue H_A_C_1_10_0 4
 run auepc H_A_C_1_10_0 4
+run kue H_A_C_1_10_0 4
 # State-machine / adaptive baselines
 run driftsurf H_A_C_1_10_0 4
 run clusterfl H_A_C_1_10_0 4

@@ -16,9 +16,6 @@ TARGETS=(
   cifar10-resnet-softclusterwin-1-hard-r-s0
   femnist-cnn-ada-win-1_iter-100c-s0
   fed_shakespeare-rnn-aue-50c-s0
-  sea-fnn-kue-canonical-s0
-  sine-fnn-kue-canonical-s0
-  circle-fnn-kue-canonical-s0
 )
 
 probe() {

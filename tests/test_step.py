@@ -130,3 +130,27 @@ class TestEval:
         cm = step.confusion_matrices(pool.params, x[:, 0], y[:, 0], fm)
         assert cm.shape == (3, 4, 2, 2)
         assert np.allclose(np.asarray(cm).sum(axis=(-1, -2)), 40)
+
+
+class TestWeightedSamplingDistribution:
+    def test_inverse_cdf_draw_matches_weights(self):
+        """The KUE batch draw (inverse-CDF over w_t x s_n) must sample each
+        (t, n) cell proportionally to its weight — the semantics of the
+        reference's Poisson-bootstrap batch choice (retrain.py:65-74 +
+        FedAvgEnsTrainerKue), independent of the sampler implementation."""
+        import jax
+        import jax.numpy as jnp
+        from feddrift_tpu.core.step import inverse_cdf_draw, weight_cdf
+
+        T1, N, B = 3, 8, 4096
+        w_t = jnp.asarray([0.0, 1.0, 3.0])
+        s_n = jnp.asarray([1.0, 0.0, 2.0, 1.0, 1.0, 0.0, 1.0, 2.0])
+        probs = (w_t[:, None] * s_n[None, :]).reshape(-1)
+        idx = inverse_cdf_draw(jax.random.PRNGKey(0), weight_cdf(probs), B)
+        counts = np.bincount(np.asarray(idx), minlength=T1 * N)
+        expected = np.asarray(probs / probs.sum()) * B
+        # zero-weight cells must never be drawn; others within 5 sigma
+        assert (counts[np.asarray(probs) == 0] == 0).all()
+        nonzero = np.asarray(probs) > 0
+        sigma = np.sqrt(expected[nonzero].clip(1))
+        assert (np.abs(counts[nonzero] - expected[nonzero]) < 5 * sigma + 5).all()
