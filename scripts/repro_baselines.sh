@@ -10,16 +10,21 @@ set -euo pipefail
 cd "$(dirname "$0")/.."
 
 SMOKE=""
+OUT_ROOT="runs"
 if [[ "${1:-}" == "--smoke" ]]; then
   SMOKE="--train_iterations 2 --comm_round 8 --sample_num 80 --batch_size 32
          --frequency_of_the_test 4 --client_num_in_total 10
          --client_num_per_round 10"
+  # smoke output must NOT land in runs/: the CLI derives the same dir names
+  # as full-length committed artifacts and would APPEND smoke rows to them
+  OUT_ROOT=$(mktemp -d /tmp/repro_smoke.XXXXXX)
+  echo "smoke output -> $OUT_ROOT"
 fi
 
 # PLATFORM=cpu runs on the host CPU (e.g. with
 # XLA_FLAGS=--xla_force_host_platform_device_count=8 for a virtual mesh).
-run() { echo "=== $*"; python -m feddrift_tpu run "$@" $SMOKE \
-        ${PLATFORM:+--platform "$PLATFORM"}; }
+run() { echo "=== $*"; python -m feddrift_tpu run --out_dir "$OUT_ROOT" \
+        "$@" $SMOKE ${PLATFORM:+--platform "$PLATFORM"}; }
 
 # 1. FedDrift (softcluster H_A_F) on SEA-4 — reference README.md:46-50.
 # The F (one-model-per-client) init needs a pool of size C.
@@ -68,7 +73,8 @@ if [[ -n "$SMOKE" ]]; then
   # direct invocation: run() appends $SMOKE last and argparse last-wins,
   # which would undo these smaller-than-$SMOKE sizes
   echo "=== fed_shakespeare rnn aue (smoke)"
-  python -m feddrift_tpu run --dataset fed_shakespeare --model rnn \
+  python -m feddrift_tpu run --out_dir "$OUT_ROOT" \
+      --dataset fed_shakespeare --model rnn \
       --concept_drift_algo aue --concept_num 2 --ensemble_window 2 \
       --change_points rand --client_num_in_total 4 --client_num_per_round 4 \
       --train_iterations 2 --comm_round 4 --epochs 2 --batch_size 16 \
