@@ -179,7 +179,34 @@ _BASELINE_CACHE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                ".bench_baseline_cache.json")
 
 
+def _code_version() -> str:
+    """Content hash of the measured code path (the framework package plus
+    this file), so cached baselines are invalidated by any perf-relevant
+    change (round-3 advisor: a baseline measured before e.g. a sampler
+    restructure must not skew vs_baseline after it) — but survive doc-only
+    commits, which on this 1-core host would otherwise re-pay ~35 min."""
+    import hashlib
+
+    root = os.path.dirname(os.path.abspath(__file__))
+    h = hashlib.sha256()
+    paths = [os.path.join(root, "bench.py")]
+    for dirpath, dirnames, filenames in os.walk(
+            os.path.join(root, "feddrift_tpu")):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        paths.extend(os.path.join(dirpath, f)
+                     for f in filenames if f.endswith((".py", ".cpp")))
+    for p in sorted(paths):
+        try:
+            with open(p, "rb") as f:
+                h.update(os.path.relpath(p, root).encode())
+                h.update(f.read())
+        except OSError:
+            pass
+    return h.hexdigest()[:12]
+
+
 def _baseline_cache(key: str, measure):
+    key = f"{key}@{_code_version()}"
     try:
         with open(_BASELINE_CACHE) as f:
             cache = json.load(f)

@@ -41,6 +41,10 @@ run() { # out_dir args...
     local n=0
     [ -f "$out/.fails" ] && n=$(cat "$out/.fails")
     n=$((n + 1))
+    # Re-glob AFTER the failed attempt: a first run that crashed mid-way may
+    # still have written a checkpoint, which must be kept and resumed — the
+    # pre-launch $nested (empty on a fresh run) must not decide deletion.
+    nested=$(compgen -G "$out/*/ckpt/MANIFEST.json" | head -1 || true)
     if [ -z "$nested" ]; then
       # no checkpoint to resume from: clear so the rerun's metrics append
       # to a fresh file (duplicated rows otherwise)

@@ -20,7 +20,14 @@ PLAT=${PLATFORM:-cpu}
 run() { # algo arg concept_num
   local algo=$1 arg=$2 m=$3
   local out="runs/$DS-fnn-$algo-$arg-s$SEED"
-  if [ -f "$out/metrics.jsonl" ]; then echo "=== skip (exists) $out"; return; fi
+  # Completion markers only — a nested metrics.jsonl alone is NOT one (the
+  # runner appends to it from round one, so a killed run leaves a partial
+  # file; see run_tracked_tpu.sh). Skip on the .done sentinel written below
+  # on zero exit, or on a flattened $out/metrics.jsonl (the committed-run
+  # convention, which is produced only after a completed run).
+  if [ -f "$out/.done" ] || [ -f "$out/metrics.jsonl" ]; then
+    echo "=== skip (done) $out"; return
+  fi
   echo "=== $out"
   python -m feddrift_tpu run --platform "$PLAT" \
     --dataset "$DS" --model fnn --change_points A \
@@ -29,6 +36,7 @@ run() { # algo arg concept_num
     --sample_num 500 --lr 0.01 --frequency_of_the_test 50 --seed "$SEED" \
     --concept_drift_algo "$algo" --concept_drift_algo_arg "$arg" \
     --concept_num "$m" --out_dir "$out"
+  touch "$out/.done"
 }
 
 # FedDrift family: canonical delta=.1, per-client-init variants, and the
