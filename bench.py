@@ -289,6 +289,63 @@ def _measure_reference_shape() -> dict | None:
                                  "reference-shape baseline")
 
 
+def _dispatch_rtt(backend: str) -> dict | None:
+    """Per-dispatch round-trip latency of a trivial compiled op. Over the
+    axon tunnel every dispatch pays network RTT, which dominates tiny-model
+    configs (round-3 weak #1: the 20-round TPU smoke was SLOWER than the
+    host CPU's fused path); this number lets the bench artifact say exactly
+    how much of a round is tunnel, not device."""
+    if not backend.startswith("tpu"):
+        return None
+    try:
+        import jax.numpy as jnp
+
+        f = jax.jit(lambda v: v + 1.0)
+        x = jnp.zeros((8,), jnp.float32)
+        jax.block_until_ready(f(x))           # compile outside the timing
+        ts = []
+        for _ in range(30):
+            t0 = time.time()
+            jax.block_until_ready(f(x))
+            ts.append(time.time() - t0)
+        ts.sort()
+        return {"median_ms": round(1e3 * ts[len(ts) // 2], 3),
+                "p90_ms": round(1e3 * ts[int(len(ts) * 0.9)], 3),
+                "n": len(ts)}
+    except Exception as e:   # diagnostic only: never discard measured results
+        return {"error": f"{type(e).__name__}: {str(e)[:200]}"}
+
+
+def _profile_capture(cfg, profile_dir: str) -> str | None:
+    """Capture a jax.profiler device trace of the config's fused programs on
+    a SHORT replica run (4 time steps, 20 rounds each): the same compiled
+    kernels as the headline measurement (compile cache shared), but trace
+    collection never pollutes the timed steady state and the canonical
+    rounds count keeps its defined scale. Returns the trace dir, or None."""
+    from feddrift_tpu.simulation.runner import Experiment
+
+    try:
+        from dataclasses import replace
+        short = replace(cfg, train_iterations=4, comm_round=20)
+        exp = Experiment(short)
+        exp.run_iteration(0)                  # warm-up / compile (see _measure)
+        exp.run_iteration(1)
+        jax.block_until_ready(exp.pool.params)
+        jax.profiler.start_trace(profile_dir)
+        try:
+            exp.run_iteration(2)
+            exp.run_iteration(3)
+            jax.block_until_ready(exp.pool.params)
+        finally:
+            jax.profiler.stop_trace()
+        return profile_dir
+    except Exception as e:                   # profiling is evidence, not gate
+        print(json.dumps({"warning": f"profiler capture failed: "
+                          f"{type(e).__name__}: {str(e)[:200]}"}),
+              file=sys.stderr)
+        return None
+
+
 def _measure(cfg, backend: str) -> dict:
     """Run one config to steady state and return its measured numbers."""
     from feddrift_tpu.simulation.runner import Experiment
@@ -330,6 +387,39 @@ def _measure(cfg, backend: str) -> dict:
     }
 
 
+def _conv_cfg(smoke: bool, **overrides):
+    return _canonical_cfg(
+        smoke, dataset="cifar10", model="resnet8",
+        concept_drift_algo="win-1", concept_drift_algo_arg="",
+        concept_num=1, change_points="A",
+        batch_size=128, compute_dtype="bfloat16",
+        train_iterations=3 if smoke else 4,
+        comm_round=10 if smoke else 50, **overrides)
+
+
+def _mfu_batch_sweep(backend: str) -> list | None:
+    """MFU vs per-client batch size on the conv config (round-3 verdict
+    item 3: 'sweep batch size ... and report MFU vs batch in the bench
+    output'). The fused round program vmaps C=10 clients, so device batch
+    is 10x the per-client figure. Short runs: the sweep wants the MFU
+    trend, not steady-state wall-clock (the headline conv_bench covers
+    that). Never reached under --smoke (gated at the call site)."""
+    if backend != "tpu":
+        return None
+    out = []
+    for bs in (128, 256, 512, 1024):
+        cfg = _conv_cfg(False, batch_size=bs, train_iterations=3,
+                        comm_round=20)
+        r = _measure_with_retry(cfg, backend)
+        out.append({"batch_per_client": bs, "device_batch": bs * 10,
+                    "rounds_per_sec": r.get("value"),
+                    "mfu": r.get("mfu_estimate"),
+                    **({"error": r["error"]} if "error" in r else {})})
+        print(json.dumps({"partial": f"mfu_sweep@{bs}", **out[-1]}),
+              file=sys.stderr)
+    return out
+
+
 def main() -> None:
     smoke = "--smoke" in sys.argv
     if "--cpu" in sys.argv:       # explicit local run: skip the probe wait
@@ -353,6 +443,11 @@ def main() -> None:
                              "dispatch path (reference-shaped)"}
                     if baseline_rps else None)
 
+    # Optional profiler capture (supervisor sets FEDDRIFT_PROFILE_DIR on
+    # real-TPU runs): device-time traces for the canonical + conv configs,
+    # captured on short replica runs after the timed measurements.
+    prof_root = os.environ.get("FEDDRIFT_PROFILE_DIR") or None
+
     res = _measure_with_retry(_canonical_cfg(smoke), backend)
     if "error" in res:
         # Report what WAS measured (the baseline took minutes), then exit
@@ -366,22 +461,21 @@ def main() -> None:
     # Persist the headline result immediately: a later config's tunnel
     # flake must not cost the already-measured number.
     print(json.dumps({"partial": "canonical", **res}), file=sys.stderr)
+    res["profile"] = (_profile_capture(_canonical_cfg(smoke),
+                                       os.path.join(prof_root, "canonical"))
+                      if prof_root else None)
 
     # Second datapoint on real TPU hardware (or under --conv for local
     # checks): a bf16 conv config where the MXU actually has work — the
     # canonical fnn is ~21k params, so its MFU is noise by construction.
     conv = None
     if backend == "tpu" or "--conv" in sys.argv:
-        conv_cfg = _canonical_cfg(
-            smoke, dataset="cifar10", model="resnet8",
-            concept_drift_algo="win-1", concept_drift_algo_arg="",
-            concept_num=1, change_points="A",
-            batch_size=128, compute_dtype="bfloat16",
-            train_iterations=3 if smoke else 4,
-            comm_round=10 if smoke else 50)
         conv = {"metric": "cifar10 resnet8 bf16 round throughput "
                           "(win-1, 10 clients, batch 128)",
-                **_measure_with_retry(conv_cfg, backend)}
+                **_measure_with_retry(_conv_cfg(smoke), backend)}
+        if prof_root and "error" not in conv:
+            conv["profile"] = _profile_capture(
+                _conv_cfg(smoke), os.path.join(prof_root, "conv"))
 
     out = {
         "metric": "FedDrift SEA-4 round throughput (softcluster, "
@@ -396,7 +490,9 @@ def main() -> None:
             if ref_shape and ref_shape.get("rounds_per_sec") else None),
         "backend": backend,
         "probe": probe_diag,
+        "dispatch_rtt": _dispatch_rtt(backend),
         "conv_bench": conv,
+        "mfu_vs_batch": None if smoke else _mfu_batch_sweep(backend),
     }
     print(json.dumps(out))
     if conv is not None and "error" in conv:

@@ -489,6 +489,10 @@ class Experiment:
         exp.algo.load_state_dict(state["algo_state"])
         exp.global_round = state["global_round"]
         exp.start_iteration = state["iteration"] + 1
+        # A crash may have logged part of iteration start_iteration AFTER
+        # the last checkpoint; that iteration reruns from its start, so its
+        # partial rows must be dropped or metrics.jsonl carries duplicates.
+        exp.logger.truncate_from(exp.start_iteration)
         return exp
 
 

@@ -56,6 +56,34 @@ class MetricsLogger:
         s = self.series(name)
         return s[-1][1] if s else default
 
+    def truncate_from(self, iteration: int) -> None:
+        """Drop rows whose ``iteration`` is >= the given value, in the JSONL
+        file and in memory. Used on resume: a run that crashed after its
+        last checkpoint may have logged part of the iteration that is about
+        to be re-run, and those partial rows would otherwise duplicate."""
+        self.history = [r for r in self.history
+                        if r.get("iteration", -1) < iteration]
+        if not self._fh:
+            return
+        path = self._fh.name
+        self._fh.close()
+        kept = []
+        try:
+            with open(path) as f:
+                for line in f:
+                    try:
+                        rec = json.loads(line)
+                    except json.JSONDecodeError:
+                        continue
+                    if rec.get("iteration", -1) < iteration:
+                        kept.append(line if line.endswith("\n")
+                                    else line + "\n")
+        except OSError:
+            kept = []
+        with open(path, "w") as f:
+            f.writelines(kept)
+        self._fh = open(path, "a")
+
     def close(self) -> None:
         if self._fh:
             self._fh.close()

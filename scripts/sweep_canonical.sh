@@ -28,6 +28,9 @@ run() { # algo arg concept_num
   if [ -f "$out/.done" ] || [ -f "$out/metrics.jsonl" ]; then
     echo "=== skip (done) $out"; return
   fi
+  # Not complete: clear any partial dir from a killed attempt so the rerun
+  # can't append duplicate rows to its nested metrics.jsonl.
+  rm -rf "$out"
   echo "=== $out"
   python -m feddrift_tpu run --platform "$PLAT" \
     --dataset "$DS" --model fnn --change_points A \

@@ -31,10 +31,15 @@ class TestCheckpointResume:
         full.run()
         full_accs = full.logger.series("Test/Acc")
 
-        # run 2 iterations, checkpoint, resume for the third
+        # run 2 iterations, checkpoint, resume for the third. Simulate a
+        # crash that logged part of iteration 2 after the checkpoint: resume
+        # must drop those partial rows, not duplicate them.
         part = Experiment(cfg, out_dir=out)
         part.run_iteration(0)
         part.run_iteration(1)
+        part.logger.log({"iteration": 2, "round": 2 * cfg.comm_round,
+                         "Test/Acc": -1.0})
+        part.logger.close()
 
         resumed = Experiment.resume(cfg, out, use_wandb=False)
         assert resumed.start_iteration == 2
@@ -45,6 +50,14 @@ class TestCheckpointResume:
         tail = [v for r, v in full_accs if r >= 2 * cfg.comm_round]
         tail_resumed = [v for r, v in resumed.logger.series("Test/Acc")]
         np.testing.assert_allclose(tail_resumed, tail, rtol=1e-5)
+
+        # and the on-disk file must hold exactly one row per logged round
+        import json as _json
+        with open(f"{out}/metrics.jsonl") as f:
+            rows = [_json.loads(line) for line in f]
+        seen = [(r["iteration"], r["round"]) for r in rows]
+        assert len(seen) == len(set(seen))
+        assert all(r.get("Test/Acc") != -1.0 for r in rows)
 
     def test_checkpoint_atomic_overwrite(self, tmp_path):
         out = str(tmp_path / "run")

@@ -1,0 +1,53 @@
+"""MetricsLogger unit tests (pure host logic, fast tier).
+
+The logger carries the reference's wandb series names (SURVEY.md §5) into a
+JSONL file; `truncate_from` is the resume-time guard against duplicated
+rows (a run that crashed after its last checkpoint may have logged part of
+the iteration that resume re-runs)."""
+
+import json
+
+from feddrift_tpu.utils.metrics import MetricsLogger
+
+
+def _rows(path):
+    with open(path) as f:
+        return [json.loads(line) for line in f]
+
+
+class TestTruncateFrom:
+    def test_drops_rows_at_and_after_iteration(self, tmp_path):
+        lg = MetricsLogger(str(tmp_path))
+        for it in (0, 0, 1, 1, 2, 2):
+            lg.log({"iteration": it, "round": it * 10, "Test/Acc": 0.5 + it})
+        lg.truncate_from(2)
+
+        path = tmp_path / "metrics.jsonl"
+        assert [r["iteration"] for r in _rows(path)] == [0, 0, 1, 1]
+        assert [r["iteration"] for r in lg.history] == [0, 0, 1, 1]
+
+    def test_appends_cleanly_after_truncation(self, tmp_path):
+        lg = MetricsLogger(str(tmp_path))
+        lg.log({"iteration": 0, "Test/Acc": 0.5})
+        lg.log({"iteration": 1, "Test/Acc": 0.6})
+        lg.truncate_from(1)
+        lg.log({"iteration": 1, "Test/Acc": 0.7})   # the re-run's row
+        lg.close()
+
+        rows = _rows(tmp_path / "metrics.jsonl")
+        assert [(r["iteration"], r["Test/Acc"]) for r in rows] == \
+            [(0, 0.5), (1, 0.7)]
+
+    def test_noop_without_file(self):
+        lg = MetricsLogger(None)
+        lg.log({"iteration": 0, "Test/Acc": 0.5})
+        lg.truncate_from(0)
+        assert lg.history == []
+
+    def test_rows_without_iteration_are_kept(self, tmp_path):
+        lg = MetricsLogger(str(tmp_path))
+        lg.log({"round": 0, "Test/Acc": 0.5})       # e.g. summary-ish rows
+        lg.log({"iteration": 3, "Test/Acc": 0.6})
+        lg.truncate_from(1)
+        rows = _rows(tmp_path / "metrics.jsonl")
+        assert len(rows) == 1 and "iteration" not in rows[0]
