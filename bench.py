@@ -388,13 +388,15 @@ def _measure(cfg, backend: str) -> dict:
 
 
 def _conv_cfg(smoke: bool, **overrides):
-    return _canonical_cfg(
-        smoke, dataset="cifar10", model="resnet8",
+    base = dict(
+        dataset="cifar10", model="resnet8",
         concept_drift_algo="win-1", concept_drift_algo_arg="",
         concept_num=1, change_points="A",
         batch_size=128, compute_dtype="bfloat16",
         train_iterations=3 if smoke else 4,
-        comm_round=10 if smoke else 50, **overrides)
+        comm_round=10 if smoke else 50)
+    base.update(overrides)                    # callers may override any of it
+    return _canonical_cfg(smoke, **base)
 
 
 def _mfu_batch_sweep(backend: str) -> list | None:

@@ -21,8 +21,11 @@ Real files under ``data_dir`` are used instead of prototypes when present:
   the standard CIFAR python pickle batches torchvision downloads (the
   reference loads CIFAR via torchvision, cifar10/data_loader.py:104).
 
-cinic10 has no real-file loader (an image-folder tree needs a decoder this
-hermetic environment lacks) and always synthesizes.
+- ``cinic10/train/<class>/*.png`` — the torchvision-ImageFolder tree the
+  reference mounts for CINIC-10 (cinic10/data_loader.py,
+  datasets.py::ImageFolderTruncated), decoded by the bundled pure-Python
+  PNG reader (``feddrift_tpu/data/png.py``); class index = sorted
+  class-directory order, exactly ImageFolder's rule.
 """
 
 from __future__ import annotations
@@ -193,6 +196,42 @@ def _try_load_cifar_batches(data_dir: str, name: str
     return imgs[perm], np.asarray(Y, np.int32)[perm]
 
 
+def _try_load_image_folder(data_dir: str, feature_shape: tuple[int, ...]
+                           ) -> tuple[np.ndarray, np.ndarray] | None:
+    """Load a torchvision-ImageFolder PNG tree (the reference's CINIC-10
+    layout, cinic10/data_loader.py): ``cinic10/train/<class>/*.png`` with
+    class index assigned by sorted class-directory name. Non-PNG files are
+    ignored; a PNG whose decoded shape doesn't match the dataset spec is a
+    hard error (silent resizing would corrupt accuracy comparisons)."""
+    from feddrift_tpu.data.png import decode_png_rgb
+
+    root = os.path.join(data_dir, "cinic10", "train")
+    if not os.path.isdir(root):
+        return None
+    classes = sorted(d for d in os.listdir(root)
+                     if os.path.isdir(os.path.join(root, d)))
+    X, Y = [], []
+    for ci, cls in enumerate(classes):
+        d = os.path.join(root, cls)
+        for fn in sorted(os.listdir(d)):
+            if not fn.lower().endswith(".png"):
+                continue
+            with open(os.path.join(d, fn), "rb") as fh:
+                img = decode_png_rgb(fh.read())
+            if img.shape != feature_shape:
+                raise ValueError(
+                    f"{os.path.join(cls, fn)}: decoded shape {img.shape} != "
+                    f"dataset spec {feature_shape}")
+            X.append(img)
+            Y.append(ci)
+    if not X:
+        return None
+    imgs = (np.stack(X) / 255.0).astype(np.float32)
+    rng = np.random.default_rng(100)   # same fixed shuffle as the others
+    perm = rng.permutation(len(imgs))
+    return imgs[perm], np.asarray(Y, np.int32)[perm]
+
+
 def generate_prototype_drift(
     name: str,
     change_points: np.ndarray,
@@ -221,6 +260,8 @@ def generate_prototype_drift(
             "image", feature_shape)
     elif name in ("cifar10", "cifar100"):
         real = _try_load_cifar_batches(data_dir, name)
+    elif name == "cinic10":
+        real = _try_load_image_folder(data_dir, feature_shape)
     sampler = PrototypeSampler(feature_shape, num_classes)
     used = 0
 

@@ -55,7 +55,7 @@ while ! all_done; do
   echo "[sup] $(date +%T) tunnel up ($ndev device(s))"
   if [ "${ndev:-1}" -gt 1 ] && [ ! -s SCALING_r04_real.json ]; then
     echo "[sup] POD SLICE VISIBLE: running real-mesh scaling bench first"
-    python scripts/scaling_bench.py > /tmp/scaling_real.json \
+    timeout 3600 python scripts/scaling_bench.py > /tmp/scaling_real.json \
       2>> /tmp/scaling_real.err \
       && cp /tmp/scaling_real.json SCALING_r04_real.json \
       && echo "[sup] real-mesh scaling captured" \

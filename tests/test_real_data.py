@@ -388,3 +388,70 @@ def test_cifar100_pickle_train_is_loaded(tmp_path):
                      b"fine_labels": labs.tolist()}, f)
     ds = make_dataset(_cfg(tmp_path, "cifar100", concept_num=2))
     assert ds.meta["real_data"] is True
+
+
+# ------------------------------------------------- CINIC-10 image folder
+def _write_cinic_tree(tmp_path, per_class=3):
+    """The reference's CINIC-10 layout: a torchvision ImageFolder tree of
+    32x32 PNGs (cinic10/data_loader.py) — class index = sorted dir order."""
+    import io
+    from PIL import Image
+
+    rng = np.random.default_rng(31)
+    classes = ["airplane", "automobile", "bird"]
+    by_class = {}
+    for cls in classes:
+        d = os.path.join(tmp_path, "cinic10", "train", cls)
+        os.makedirs(d)
+        imgs = rng.integers(0, 256, (per_class, 32, 32, 3)).astype(np.uint8)
+        by_class[cls] = imgs
+        for i, img in enumerate(imgs):
+            Image.fromarray(img).save(os.path.join(d, f"img_{i}.png"))
+        # ImageFolder ignores non-images sitting in the tree
+        with open(os.path.join(d, "notes.txt"), "w") as f:
+            f.write("not an image")
+    return classes, by_class
+
+
+def test_cinic10_image_folder_is_loaded(tmp_path):
+    pytest.importorskip("PIL.Image")
+    classes, by_class = _write_cinic_tree(tmp_path)
+    ds = make_dataset(_cfg(tmp_path, "cinic10", concept_num=2))
+    assert ds.meta["real_data"] is True
+    # every served sample must be one of the fixture images, with the class
+    # index implied by sorted directory order
+    source = {}
+    for ci, cls in enumerate(classes):
+        for img in by_class[cls]:
+            source[(img / 255.0).astype(np.float32).tobytes()] = ci
+    flat_x = np.asarray(ds.x).reshape(-1, 32, 32, 3)
+    flat_y = np.asarray(ds.y).reshape(-1)
+    # labels may be drift-swapped; un-swap per the concept of each cell
+    from feddrift_tpu.data.prototype import apply_label_swap
+    concepts = np.broadcast_to(
+        ds.concepts[..., None],
+        (ds.concepts.shape[0], ds.concepts.shape[1], N)).transpose(1, 0, 2)
+    flat_c = concepts.reshape(-1)
+    for i in range(0, len(flat_x), max(1, len(flat_x) // 10)):
+        key = flat_x[i].astype(np.float32).tobytes()
+        assert key in source
+        y_orig = apply_label_swap(np.array([flat_y[i]]), int(flat_c[i]),
+                                  ds.num_classes)[0]
+        assert y_orig == source[key]
+
+
+def test_cinic10_without_tree_synthesizes(tmp_path):
+    ds = make_dataset(_cfg(tmp_path, "cinic10", concept_num=2))
+    assert ds.meta["real_data"] is False
+
+
+def test_cinic10_wrong_resolution_is_rejected(tmp_path):
+    pytest.importorskip("PIL.Image")
+    from PIL import Image
+
+    d = os.path.join(tmp_path, "cinic10", "train", "cat")
+    os.makedirs(d)
+    Image.fromarray(np.zeros((16, 16, 3), np.uint8)).save(
+        os.path.join(d, "small.png"))
+    with pytest.raises(ValueError, match="16"):
+        make_dataset(_cfg(tmp_path, "cinic10", concept_num=2))
