@@ -62,31 +62,68 @@ def main() -> None:
             train_iterations=4, comm_round=args.rounds, epochs=5,
             batch_size=min(500, args.sample_num),
             sample_num=args.sample_num, lr=0.01,
-            frequency_of_the_test=max(1, args.rounds // 2), seed=7)
+            frequency_of_the_test=max(1, args.rounds // 2), seed=7,
+            # honest phase attribution on the virtual-device path: block on
+            # device output inside each traced phase (round-4 diagnosis:
+            # the apparent "4-device cliff" was the HOST-side cluster
+            # phase — a drift-detection merge whose firing depends on the
+            # accuracy dynamics at that client count — not the sharded
+            # train program). On real hardware keep async dispatch: a
+            # per-round block would pay one tunnel RTT per round and
+            # understate the machine.
+            trace_sync=bool(args.virtual))
         exp = Experiment(cfg, mesh=make_mesh(n_dev))
         exp.run_iteration(0)        # compile + cluster_init path
         exp.run_iteration(1)        # compile the steady-state path
+        phases: dict[str, float] = {}
         t0 = time.time()
         for t in range(2, cfg.train_iterations):
             exp.run_iteration(t)
+            for k, v in exp.last_phase_summary.items():
+                phases[k] = phases.get(k, 0.0) + v["total_s"]
         jax.block_until_ready(exp.pool.params)
         dt = time.time() - t0
         rounds = cfg.comm_round * (cfg.train_iterations - 2)
+        # No fallback to dt here: if the tracer ever stops emitting this
+        # phase the field must go null, not silently become the confounded
+        # whole-iteration number.
+        train_s = phases.get("train_round")
         res = {
             "devices": n_dev,
             "clients": C,
             "rounds_per_s": round(rounds / dt, 3),
+            # the mesh-sharded SPMD program alone — what actually scales
+            # over devices; cluster/eval are host-side algorithm state work
+            "train_phase_rounds_per_s": round(rounds / train_s, 3)
+            if train_s else None,
+            "phase_totals_s": {k: round(v, 4) for k, v in sorted(phases.items())},
             "client_rounds_per_s": round(rounds * C / dt, 1),
             "final_test_acc": round(float(exp.logger.last("Test/Acc")), 4),
         }
+        # floor-relative overhead of the train phase, against this pass's
+        # own 1-device point (the reproducible form of SCALING_r04's rows)
+        base_train = results[0]["train_phase_rounds_per_s"] if results else None
+        if base_train and res["train_phase_rounds_per_s"]:
+            res["train_overhead_vs_serialization_floor"] = round(
+                (base_train / n_dev) / res["train_phase_rounds_per_s"], 3)
         results.append(res)
         print(json.dumps(res), flush=True)
 
     if len(results) > 1:
-        base = results[0]["client_rounds_per_s"] / results[0]["devices"]
-        eff = results[-1]["client_rounds_per_s"] / (
-            results[-1]["devices"] * base)
-        print(json.dumps({"weak_scaling_efficiency": round(eff, 3),
+        # efficiency on the TRAIN phase where available (the whole-iteration
+        # number is confounded by C-dependent host-side cluster work — the
+        # round-3 "4-device cliff", diagnosed in SCALING_r04.json); fall
+        # back to whole-iteration only when phases weren't traced.
+        key = ("train_phase_rounds_per_s"
+               if all(r.get("train_phase_rounds_per_s") for r in results)
+               else "rounds_per_s")
+        # per-device client-rounds throughput, last vs first mesh size
+        # (on virtual devices the ideal is 1/N by serialization — compare
+        # against train_overhead_vs_serialization_floor per row)
+        per_dev = [r[key] * r["clients"] / r["devices"]
+                   for r in (results[0], results[-1])]
+        print(json.dumps({"weak_scaling_efficiency": round(per_dev[1] / per_dev[0], 3),
+                          "efficiency_metric": key,
                           "from": results[0]["devices"],
                           "to": results[-1]["devices"]}), flush=True)
 
