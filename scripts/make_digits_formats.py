@@ -1,0 +1,103 @@
+"""Materialize REAL handwritten digits in the reference's remaining
+on-disk image formats, so those ingestion paths get executed END-TO-END
+runs, not just fixture tests (round-3 verdict #35: "no committed run
+exercises the h5/CIFAR-pickle real-file paths end-to-end").
+
+Same data story as scripts/make_digits_leaf.py: no network egress exists,
+but scikit-learn ships the UCI hand-written digits offline (1,797 genuine
+8x8 grayscale digits). This script lays them out as:
+
+- ``FederatedEMNIST/emnist_train.h5`` — TFF flat h5 (pixels/label/id,
+  reference FederatedEMNIST/data_loader.py:16-33), 28x28 geometry;
+- ``fed_cifar100/cifar100_train.h5`` — TFF flat h5 (image/label/id,
+  reference fed_cifar100/data_loader.py:15-32), 32x32 RGB;
+- ``cifar-10-batches-py/data_batch_{1..5}`` — CIFAR python pickles
+  (b"data" [N, 3072] uint8 CHW + b"labels"; the torchvision layout the
+  reference loads, cifar10/data_loader.py:104);
+- ``cinic10/train/<class>/*.png`` — the torchvision-ImageFolder tree
+  (reference cinic10/data_loader.py), encoded with PIL here and decoded
+  by the product's pure-Python reader (feddrift_tpu/data/png.py).
+
+Labels live in each dataset's own class space (digits occupy classes 0-9
+of femnist's 62 / fed_cifar100's 100); accuracy ceilings follow the
+10-class content, which PARITY documents alongside the runs.
+
+Usage: python scripts/make_digits_formats.py [data_dir]  # default ./data
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import sys
+
+import numpy as np
+
+
+def _digits():
+    from sklearn.datasets import load_digits
+
+    d = load_digits()
+    return d.images / 16.0, d.target.astype(np.int64)   # [N, 8, 8] in [0,1]
+
+
+def _up28(imgs):
+    return np.kron(imgs, np.ones((4, 4)))[:, 2:-2, 2:-2]    # 8x8 -> 28x28
+
+
+def _up32rgb(imgs):
+    up = np.kron(imgs, np.ones((4, 4)))                      # 8x8 -> 32x32
+    return np.repeat(up[..., None], 3, axis=3)               # gray -> RGB
+
+
+def main() -> None:
+    import h5py
+
+    data_dir = sys.argv[1] if len(sys.argv) > 1 else "./data"
+    imgs, labels = _digits()
+
+    # TFF flat h5, FederatedEMNIST layout (28x28 float pixels)
+    d = os.path.join(data_dir, "FederatedEMNIST")
+    os.makedirs(d, exist_ok=True)
+    with h5py.File(os.path.join(d, "emnist_train.h5"), "w") as f:
+        f.create_dataset("pixels", data=_up28(imgs).astype(np.float32))
+        f.create_dataset("label", data=labels)
+        f.create_dataset("id", data=np.arange(len(labels)) % 50)
+    print(f"wrote {d}/emnist_train.h5 ({len(labels)} digits)")
+
+    # TFF flat h5, fed_cifar100 layout (32x32x3 uint8)
+    rgb8 = (_up32rgb(imgs) * 255).astype(np.uint8)
+    d = os.path.join(data_dir, "fed_cifar100")
+    os.makedirs(d, exist_ok=True)
+    with h5py.File(os.path.join(d, "cifar100_train.h5"), "w") as f:
+        f.create_dataset("image", data=rgb8)
+        f.create_dataset("label", data=labels)
+        f.create_dataset("id", data=np.arange(len(labels)) % 50)
+    print(f"wrote {d}/cifar100_train.h5")
+
+    # CIFAR python pickle batches (uint8 CHW rows)
+    d = os.path.join(data_dir, "cifar-10-batches-py")
+    os.makedirs(d, exist_ok=True)
+    chw = rgb8.transpose(0, 3, 1, 2).reshape(len(rgb8), 3072)
+    splits = np.array_split(np.arange(len(rgb8)), 5)
+    for i, idx in enumerate(splits, start=1):
+        with open(os.path.join(d, f"data_batch_{i}"), "wb") as f:
+            pickle.dump({b"data": chw[idx],
+                         b"labels": labels[idx].tolist()}, f)
+    print(f"wrote {d}/data_batch_1..5")
+
+    # CINIC-10 ImageFolder PNG tree (class dirs in sorted order = label id)
+    from PIL import Image
+
+    root = os.path.join(data_dir, "cinic10", "train")
+    classes = [f"digit_{k}" for k in range(10)]
+    for k, cls in enumerate(classes):
+        cd = os.path.join(root, cls)
+        os.makedirs(cd, exist_ok=True)
+        for j in np.flatnonzero(labels == k):
+            Image.fromarray(rgb8[j]).save(os.path.join(cd, f"{j:05d}.png"))
+    print(f"wrote {root}/<class>/*.png")
+
+
+if __name__ == "__main__":
+    main()

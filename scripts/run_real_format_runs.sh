@@ -1,0 +1,39 @@
+#!/usr/bin/env bash
+# End-to-end runs through the real on-disk ingestion paths (TFF h5, CIFAR
+# pickle, CINIC-10 PNG tree) on REAL digits laid out by
+# scripts/make_digits_formats.py — closes the round-3 #35 note that those
+# format families had fixture tests but no executed run. fnn at canonical
+# shape: these are ingestion-path evidence; the algorithmic comparisons
+# live in the MNIST-real and sweep sections of PARITY.md.
+set -uo pipefail
+cd "$(dirname "$0")/.."
+
+python scripts/make_digits_formats.py data/real_formats
+
+FAIL=0
+run() { # out_dir dataset algo arg m
+  local out="runs/$1"
+  if [ -f "$out/.done" ]; then echo "=== skip (done) $out"; return; fi
+  rm -rf "$out"
+  echo "=== $(date +%T) $out"
+  if python -m feddrift_tpu run --platform cpu --seed 0 --out_dir "$out" \
+       --dataset "$2" --model fnn \
+       --concept_drift_algo "$3" --concept_drift_algo_arg "$4" \
+       --concept_num "$5" --change_points rand --drift_together 0 \
+       --client_num_in_total 10 --client_num_per_round 10 \
+       --train_iterations 10 --comm_round 200 --epochs 5 --batch_size 500 \
+       --sample_num 500 --lr 0.01 --frequency_of_the_test 50 \
+       --data_dir data/real_formats; then
+    touch "$out/.done"
+  else
+    echo "!!! failed $out"; FAIL=1
+  fi
+}
+
+run femnist-h5-fnn-softcluster-H_A_C_1_10_0-s0  femnist      softcluster H_A_C_1_10_0 4
+run femnist-h5-fnn-win-1-s0                     femnist      win-1       H_A_C_1_10_0 1
+run cifar10-pickle-fnn-softcluster-H_A_C_1_10_0-s0 cifar10   softcluster H_A_C_1_10_0 4
+run fed_cifar100-h5-fnn-softcluster-H_A_C_1_10_0-s0 fed_cifar100 softcluster H_A_C_1_10_0 4
+run cinic10-png-fnn-softcluster-H_A_C_1_10_0-s0 cinic10      softcluster H_A_C_1_10_0 4
+
+exit $FAIL
