@@ -77,15 +77,12 @@ def _probe_backend(attempts: int = 3, timeout_s: float = 120.0):
 
 
 def _enable_compile_cache() -> None:
-    """Persist compiled executables across processes (~20-40s saved per
-    program on repeat benchmark runs; cache is keyed by platform + HLO)."""
-    d = os.path.join(os.path.dirname(os.path.abspath(__file__)), ".jax_cache")
-    try:
-        jax.config.update("jax_compilation_cache_dir", d)
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
-    except Exception as e:                   # cache is an optimization only
-        print(json.dumps({"warning": f"compile cache unavailable: {e}"}),
-              file=sys.stderr)
+    """Shared persistent compile cache (feddrift_tpu/utils/cache.py) —
+    kept as a name here because the subprocess baselines invoke it as
+    ``bench._enable_compile_cache()``."""
+    from feddrift_tpu.utils.cache import enable_compile_cache
+
+    enable_compile_cache()
 
 
 def _canonical_cfg(smoke: bool, **overrides):

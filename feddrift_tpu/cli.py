@@ -105,6 +105,8 @@ def main(argv: list[str] | None = None) -> int:
     if getattr(args, "platform", ""):
         import jax
         jax.config.update("jax_platforms", args.platform)
+    from feddrift_tpu.utils.cache import enable_compile_cache
+    enable_compile_cache()
     _maybe_init_multihost(args)
 
     if args.cmd == "list":

@@ -1,0 +1,31 @@
+"""Persistent XLA compile cache, shared by every entry point.
+
+The conv/LSTM round programs cost tens of minutes of XLA:CPU compile on a
+single host core and are byte-identical across the sweep/queue scripts'
+per-run python invocations — without a persistent cache every process
+re-paid the compile (bench.py enabled it from round 2; the CLI, which
+launches every committed run, only gained it in round 4). Keyed by
+platform + HLO, so CPU and TPU executables coexist in one directory.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+
+
+def enable_compile_cache() -> None:
+    """Point JAX's compilation cache at ``$FEDDRIFT_COMPILE_CACHE`` or the
+    repo-root ``.jax_cache``. Failure is logged, never raised — the cache
+    is an optimization only."""
+    import jax
+
+    d = os.environ.get("FEDDRIFT_COMPILE_CACHE") or os.path.join(
+        os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)))), ".jax_cache")
+    try:
+        jax.config.update("jax_compilation_cache_dir", d)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except Exception as e:
+        logging.getLogger("feddrift_tpu").warning(
+            "compile cache unavailable: %s", e)

@@ -8,7 +8,29 @@
 set -uo pipefail
 cd "$(dirname "$0")/.."
 
-python scripts/make_digits_formats.py data/real_formats
+python scripts/make_digits_formats.py data/real_formats || {
+  echo "!!! materializer failed; refusing to run (the drift loaders would"
+  echo "    silently fall back to synthetic prototypes and the runs would"
+  echo "    record real-file ingestion evidence that never happened)"
+  exit 1
+}
+
+# Assert every family actually resolves to the real files before any run
+# earns a sentinel (meta.real_data is set by generate_prototype_drift).
+python - << 'EOF' || exit 1
+import jax
+jax.config.update("jax_platforms", "cpu")
+from feddrift_tpu.config import ExperimentConfig
+from feddrift_tpu.data.registry import make_dataset
+for ds in ("femnist", "cifar10", "fed_cifar100", "cinic10"):
+    cfg = ExperimentConfig(
+        dataset=ds, model="fnn", concept_drift_algo="win-1",
+        change_points="rand", client_num_in_total=2, client_num_per_round=2,
+        train_iterations=2, comm_round=1, sample_num=5,
+        data_dir="data/real_formats")
+    assert make_dataset(cfg).meta["real_data"] is True, f"{ds}: synthetic!"
+    print(f"{ds}: real files resolved")
+EOF
 
 FAIL=0
 run() { # out_dir dataset algo arg m

@@ -1,0 +1,22 @@
+#!/usr/bin/env bash
+# Round-4 follow-up conv evidence: the round-2-scale resnet8 rerun on the
+# HARDENED task showed memorization without generalization (Train 1.0 /
+# Test ~chance at 64 samples/client — the hardened task is not learnable
+# from that little data by design). This config keeps the CPU-feasible
+# shape but restores the canonical per-client data volume (sample_num
+# 500) so the IFCA hard-r path can show real learning on the hardened
+# task; defined scale (BASELINE config 3) stays on the TPU queue.
+set -uo pipefail
+cd "$(dirname "$0")/.."
+
+out="runs/cifar10-resnet8-hard-r-n500-s0"
+if [ -f "$out/.done" ]; then echo "=== skip (done) $out"; exit 0; fi
+rm -rf "$out"
+echo "=== $(date +%T) $out"
+python -m feddrift_tpu run --platform cpu --seed 0 --out_dir "$out" \
+    --dataset cifar10 --model resnet8 --concept_drift_algo softclusterwin-1 \
+    --concept_drift_algo_arg hard-r --concept_num 2 --change_points rand \
+    --client_num_in_total 4 --client_num_per_round 4 \
+    --train_iterations 2 --comm_round 6 --epochs 5 --batch_size 32 \
+    --sample_num 500 --lr 0.05 --frequency_of_the_test 2 \
+  && touch "$out/.done"
