@@ -79,13 +79,17 @@ run femnist-cnn-ada-win-1_iter-100c-s0 \
     --train_iterations 10 --comm_round 100 --epochs 5 --batch_size 32 \
     --sample_num 500 --lr 0.03 --frequency_of_the_test 25
 
-# 5. AUE on fed_shakespeare/rnn at 50 clients, 1000 samples/client
+# 5. AUE on fed_shakespeare/rnn at 50 clients, 1000 samples/client.
+#    lr 0.03, not 0.1: round-4 CPU calibration at 10 clients showed adam
+#    lr 0.1 freezes on the most-common-char plateau once many-client
+#    averaging shrinks the effective step (Train/Acc pinned at 0.038 for
+#    15 rounds), while 0.03 learns (0.17 by round 5) — PARITY.md.
 run fed_shakespeare-rnn-aue-50c-s0 \
     --dataset fed_shakespeare --model rnn --concept_drift_algo aue \
     --concept_num 3 --change_points rand \
     --client_num_in_total 50 --client_num_per_round 50 \
     --train_iterations 10 --comm_round 100 --epochs 5 --batch_size 32 \
-    --sample_num 1000 --lr 0.1 --frequency_of_the_test 25
+    --sample_num 1000 --lr 0.03 --frequency_of_the_test 25
 
 # (KUE's canonical rows moved OFF this queue in round 3: the batch draw
 # was restructured to inverse-CDF sampling (core/step.py), after which
