@@ -1,15 +1,19 @@
 #!/usr/bin/env bash
-# Round-4 follow-up conv evidence: the round-2-scale resnet8 rerun on the
-# HARDENED task showed memorization without generalization (Train 1.0 /
-# Test ~chance at 64 samples/client — the hardened task is not learnable
-# from that little data by design). This config keeps the CPU-feasible
-# shape but restores the canonical per-client data volume (sample_num
-# 500) so the IFCA hard-r path can show real learning on the hardened
-# task; defined scale (BASELINE config 3) stays on the TPU queue.
+# Round-4 follow-up conv evidence on REAL image content. The round-2-scale
+# resnet8 rerun on the hardened synthetic task showed memorization without
+# generalization, and a direct probe showed WHY conv models cannot learn
+# the synthetic stand-in at any budget: the hardened prototypes' basis is
+# white noise, so the class signal is a GLOBAL rank-16 projection with no
+# local spatial structure for conv kernels to latch onto (a linear probe
+# reaches 0.43 on femnist-62 while CNNFedAvg stays at chance after 500
+# adam steps at any lr). Conv evidence therefore runs on real digits
+# served through the real-format ingestion paths
+# (scripts/make_digits_formats.py); defined scale (BASELINE config 3)
+# stays on the TPU queue.
 set -uo pipefail
 cd "$(dirname "$0")/.."
 
-out="runs/cifar10-resnet8-hard-r-n500-s0"
+out="runs/cifar10-resnet8-hard-r-realdigits-s0"
 if [ -f "$out/.done" ]; then echo "=== skip (done) $out"; exit 0; fi
 rm -rf "$out"
 echo "=== $(date +%T) $out"
@@ -19,4 +23,5 @@ python -m feddrift_tpu run --platform cpu --seed 0 --out_dir "$out" \
     --client_num_in_total 4 --client_num_per_round 4 \
     --train_iterations 2 --comm_round 6 --epochs 5 --batch_size 32 \
     --sample_num 500 --lr 0.05 --frequency_of_the_test 2 \
+    --data_dir data/real_formats \
   && touch "$out/.done"
