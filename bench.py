@@ -214,6 +214,14 @@ def _baseline_cache(key: str, measure):
     val = measure()
     if val is not None:
         cache[key] = val
+        # prune entries from older code versions: each is a multi-minute
+        # measurement keyed by a hash that will never be looked up again,
+        # so without this the cache grows one dead entry per perf-relevant
+        # commit (suffix comes from the already-built key — no second
+        # package-tree hash walk)
+        suffix = "@" + key.rsplit("@", 1)[1]
+        cache = {k: v for k, v in cache.items()
+                 if k.endswith(suffix) or "@" not in k}
         try:
             with open(_BASELINE_CACHE, "w") as f:
                 json.dump(cache, f)
@@ -368,7 +376,7 @@ def _measure(cfg, backend: str) -> dict:
     # for a real TPU backend (ADVICE r2: the old CPU placeholder peaks made
     # the estimate meaningless while sharing the TPU key).
     mfu = None
-    if backend == "tpu":
+    if backend.startswith("tpu"):
         peak = PEAK_FLOPS["tpu"].get(cfg.compute_dtype,
                                      PEAK_FLOPS["tpu"]["float32"])
         mfu = round(_flops_per_round(exp) * rps / peak, 6)
@@ -402,8 +410,10 @@ def _mfu_batch_sweep(backend: str) -> list | None:
     output'). The fused round program vmaps C=10 clients, so device batch
     is 10x the per-client figure. Short runs: the sweep wants the MFU
     trend, not steady-state wall-clock (the headline conv_bench covers
-    that). Never reached under --smoke (gated at the call site)."""
-    if backend != "tpu":
+    that). Never reached under --smoke (gated at the call site). Same
+    predicate as _dispatch_rtt so a qualified backend string ("tpu:v4")
+    can't make the two TPU-only diagnostics disagree."""
+    if not backend.startswith("tpu"):
         return None
     out = []
     for bs in (128, 256, 512, 1024):

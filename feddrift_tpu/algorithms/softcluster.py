@@ -76,6 +76,12 @@ class SoftCluster(DriftAlgorithm):
         if self.kind == "geni":
             self.geni_concepts = ds.concepts[:, : self.C]
         self.rng = np.random.default_rng(cfg.seed + 1009)
+        # Cumulative drift-machinery event counters. The scaling bench reads
+        # these per iteration so throughput cliffs at particular client
+        # counts can be attributed to actual spawn/merge activity (the
+        # host-side work that fires data-dependently) instead of inferred
+        # from phase timings alone (SCALING_r04 weak point).
+        self.event_counts = {"spawns": 0, "merges": 0, "linkage_calls": 0}
         self._tw = None
         # only the CFL variant reads per-client deltas in after_round
         self.needs_client_params = self.kind == "cfl"
@@ -235,6 +241,7 @@ class SoftCluster(DriftAlgorithm):
                 if next_free == -42:
                     next_free = self._find_unused_model_lru(t, original_model=best[c])
                 if next_free != -1:
+                    self.event_counts["spawns"] += 1
                     self.weights[t, :, c] = 0.0
                     self.weights[t, next_free, c] = 1.0
             self.mmacc_acc[c] = newest_acc
@@ -278,6 +285,7 @@ class SoftCluster(DriftAlgorithm):
             if self.mmacc_acc[c] - newest_acc > self.h_delta:
                 next_free = self._find_unused_model_lru(t, original_model=best)
                 if next_free != -1:
+                    self.event_counts["spawns"] += 1
                     self.h_marked[c] = (next_free, t + self.h_w)
                     self.weights[t, :, c] = 0.0
                     self.weights[t, next_free, c] = 1.0
@@ -314,6 +322,7 @@ class SoftCluster(DriftAlgorithm):
         np.fill_diagonal(dist, 0.0)
 
         method = "average" if self.h_cluster == "D" else "complete"  # (:947-950)
+        self.event_counts["linkage_calls"] += 1
         Z = sch.linkage(squareform(dist, checks=False), method=method)
         T = sch.fcluster(Z, t=self.h_deltap, criterion="distance")
 
@@ -333,6 +342,7 @@ class SoftCluster(DriftAlgorithm):
 
     def _merge(self, t: int, base: int, second: int) -> None:
         """Weighted param average + weight union (merge, :1048-1072)."""
+        self.event_counts["merges"] += 1
         w1 = float(self.weights[: t + 1, base, :].sum())
         w2 = float(self.weights[: t + 1, second, :].sum())
         s = w1 + w2

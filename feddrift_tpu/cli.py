@@ -43,6 +43,12 @@ def _add_run_args(p: argparse.ArgumentParser) -> None:
         else:
             p.add_argument(f"--{f.name}", type=str, default=default)
     p.add_argument("--wandb", action="store_true", help="attach wandb if available")
+    p.add_argument("--flat_out_dir", action="store_true",
+                   help="write metrics/ckpt directly under --out_dir instead "
+                        "of nesting an auto-named <dataset>-<model>-... "
+                        "subdirectory (the committed-runs convention is "
+                        "runs/<name>/metrics.jsonl; driver scripts pass this "
+                        "so no post-hoc flattening is needed)")
     p.add_argument("--platform", type=str, default="",
                    help="force a JAX platform (e.g. 'cpu'); must be applied "
                         "before backend init, which env vars can't do when "
@@ -129,9 +135,13 @@ def main(argv: list[str] | None = None) -> int:
     else:
         cfg = _cfg_from_args(args)
         import os
-        out_dir = os.path.join(cfg.out_dir,
-                               f"{cfg.dataset}-{cfg.model}-{cfg.concept_drift_algo}"
-                               f"-{cfg.concept_drift_algo_arg}-s{cfg.seed}")
+        if getattr(args, "flat_out_dir", False):
+            out_dir = cfg.out_dir
+        else:
+            out_dir = os.path.join(
+                cfg.out_dir,
+                f"{cfg.dataset}-{cfg.model}-{cfg.concept_drift_algo}"
+                f"-{cfg.concept_drift_algo_arg}-s{cfg.seed}")
         exp = Experiment(cfg, use_wandb=args.wandb, out_dir=out_dir)
 
     exp.run()

@@ -202,7 +202,15 @@ def _try_load_image_folder(data_dir: str, feature_shape: tuple[int, ...]
     layout, cinic10/data_loader.py): ``cinic10/train/<class>/*.png`` with
     class index assigned by sorted class-directory name. Non-PNG files are
     ignored; a PNG whose decoded shape doesn't match the dataset spec is a
-    hard error (silent resizing would corrupt accuracy comparisons)."""
+    hard error (silent resizing would corrupt accuracy comparisons).
+
+    Preprocessing diverges from the reference pipeline on purpose: images
+    are served scaled to [0, 1] (this repo's convention for every image
+    family), while the reference normalizes per channel with the CINIC
+    mean/std and applies random crop + horizontal flip augmentation
+    (cinic10/data_loader.py:82-143). Accuracy comparisons against
+    reference CINIC-10 numbers are therefore NOT apples-to-apples — see
+    PARITY.md's CINIC-10 note."""
     from feddrift_tpu.data.png import decode_png_rgb
 
     root = os.path.join(data_dir, "cinic10", "train")

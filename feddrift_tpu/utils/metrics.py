@@ -79,7 +79,13 @@ class MetricsLogger:
                         kept.append(line if line.endswith("\n")
                                     else line + "\n")
         except OSError:
-            kept = []
+            # Read-back failed: leave the file untouched rather than
+            # rewriting it from an empty `kept` (which would erase the
+            # run's entire pre-checkpoint history on a transient error).
+            # Worst case some partial rows duplicate — recoverable; an
+            # emptied file is not.
+            self._fh = open(path, "a")
+            return
         with open(path, "w") as f:
             f.writelines(kept)
         self._fh = open(path, "a")

@@ -38,7 +38,7 @@ run() { # out_dir dataset algo arg m
   if [ -f "$out/.done" ]; then echo "=== skip (done) $out"; return; fi
   rm -rf "$out"
   echo "=== $(date +%T) $out"
-  if python -m feddrift_tpu run --platform cpu --seed 0 --out_dir "$out" \
+  if python -m feddrift_tpu run --flat_out_dir --platform cpu --seed 0 --out_dir "$out" \
        --dataset "$2" --model fnn \
        --concept_drift_algo "$3" --concept_drift_algo_arg "$4" \
        --concept_num "$5" --change_points rand --drift_together 0 \

@@ -21,9 +21,10 @@ run() { # out_dir args...
   if [ -f "$out/.done" ]; then echo "=== skip (done) $out"; return; fi
   echo "=== $(date +%T) $out"
   # replace-in-place reruns: clear the superseded artifact so the fresh
-  # nested metrics can't sit beside a stale flattened one
+  # metrics can't sit beside a stale one (--flat_out_dir writes directly
+  # to $out — no nested auto-named dir, no post-hoc flattening)
   rm -rf "$out"
-  if python -m feddrift_tpu run --platform cpu --seed 0 \
+  if python -m feddrift_tpu run --flat_out_dir --platform cpu --seed 0 \
        --out_dir "$out" "$@"; then
     touch "$out/.done"
   else

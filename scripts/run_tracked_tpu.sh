@@ -23,16 +23,19 @@ run() { # out_dir args...
   local out="runs/$1"; shift
   if [ -f "$out/.done" ]; then echo "=== skip (done) $out"; return; fi
   if [ -f "$out/.giveup" ]; then echo "=== skip (GIVEN UP) $out"; return; fi
-  local nested
-  nested=$(compgen -G "$out/*/ckpt/MANIFEST.json" | head -1 || true)
+  # Checkpoints live at $out/ckpt (--flat_out_dir runs) or, for attempts
+  # made before that flag existed, nested one auto-named level down.
+  local ckpt
+  ckpt=$(compgen -G "$out/ckpt/MANIFEST.json" | head -1 || true)
+  [ -z "$ckpt" ] && ckpt=$(compgen -G "$out/*/ckpt/MANIFEST.json" | head -1 || true)
   local -a cmd
-  if [ -n "$nested" ]; then
+  if [ -n "$ckpt" ]; then
     echo "=== resume $out"
     cmd=(python -m feddrift_tpu resume
-         --out_dir "$(dirname "$(dirname "$nested")")")
+         --out_dir "$(dirname "$(dirname "$ckpt")")")
   else
     echo "=== $out"
-    cmd=(python -m feddrift_tpu run --out_dir "$out" --seed 0 "$@")
+    cmd=(python -m feddrift_tpu run --flat_out_dir --out_dir "$out" --seed 0 "$@")
   fi
   if "${cmd[@]}"; then
     touch "$out/.done"
@@ -43,9 +46,10 @@ run() { # out_dir args...
     n=$((n + 1))
     # Re-glob AFTER the failed attempt: a first run that crashed mid-way may
     # still have written a checkpoint, which must be kept and resumed — the
-    # pre-launch $nested (empty on a fresh run) must not decide deletion.
-    nested=$(compgen -G "$out/*/ckpt/MANIFEST.json" | head -1 || true)
-    if [ -z "$nested" ]; then
+    # pre-launch $ckpt (empty on a fresh run) must not decide deletion.
+    ckpt=$(compgen -G "$out/ckpt/MANIFEST.json" | head -1 || true)
+    [ -z "$ckpt" ] && ckpt=$(compgen -G "$out/*/ckpt/MANIFEST.json" | head -1 || true)
+    if [ -z "$ckpt" ]; then
       # no checkpoint to resume from: clear so the rerun's metrics append
       # to a fresh file (duplicated rows otherwise)
       echo "!!! failed $out (no checkpoint; clearing for fresh rerun)"

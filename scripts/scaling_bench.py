@@ -76,11 +76,20 @@ def main() -> None:
         exp.run_iteration(0)        # compile + cluster_init path
         exp.run_iteration(1)        # compile the steady-state path
         phases: dict[str, float] = {}
+        # drift-machinery events per measured iteration (spawns / merges /
+        # linkage calls) — the host-side work whose data-dependent firing
+        # caused the round-3 "C=16 cliff"; recording the events themselves
+        # makes that attribution evidence rather than timing inference
+        ev0 = dict(getattr(exp.algo, "event_counts", {}))
+        events_per_iter = []
         t0 = time.time()
         for t in range(2, cfg.train_iterations):
             exp.run_iteration(t)
             for k, v in exp.last_phase_summary.items():
                 phases[k] = phases.get(k, 0.0) + v["total_s"]
+            ev1 = dict(getattr(exp.algo, "event_counts", {}))
+            events_per_iter.append({k: ev1[k] - ev0.get(k, 0) for k in ev1})
+            ev0 = ev1
         jax.block_until_ready(exp.pool.params)
         dt = time.time() - t0
         rounds = cfg.comm_round * (cfg.train_iterations - 2)
@@ -93,10 +102,17 @@ def main() -> None:
             "clients": C,
             "rounds_per_s": round(rounds / dt, 3),
             # the mesh-sharded SPMD program alone — what actually scales
-            # over devices; cluster/eval are host-side algorithm state work
+            # over devices; cluster/eval are host-side algorithm state work.
+            # Only meaningful when trace_sync blocked on device output
+            # inside the phase: with async dispatch (real hardware) this
+            # would measure host-side dispatch time, not device execution.
             "train_phase_rounds_per_s": round(rounds / train_s, 3)
-            if train_s else None,
+            if (train_s and cfg.trace_sync) else None,
+            "trace_sync": bool(cfg.trace_sync),
             "phase_totals_s": {k: round(v, 4) for k, v in sorted(phases.items())},
+            "events_per_iter": events_per_iter,
+            "events_total": {k: sum(e.get(k, 0) for e in events_per_iter)
+                             for k in (events_per_iter[0] if events_per_iter else {})},
             "client_rounds_per_s": round(rounds * C / dt, 1),
             "final_test_acc": round(float(exp.logger.last("Test/Acc")), 4),
         }
@@ -112,10 +128,15 @@ def main() -> None:
     if len(results) > 1:
         # efficiency on the TRAIN phase where available (the whole-iteration
         # number is confounded by C-dependent host-side cluster work — the
-        # round-3 "4-device cliff", diagnosed in SCALING_r04.json); fall
-        # back to whole-iteration only when phases weren't traced.
+        # round-3 "4-device cliff", diagnosed in SCALING_r04.json). The
+        # train-phase number is used ONLY when every row was traced with
+        # trace_sync (virtual devices): with async dispatch on real
+        # hardware the traced phase measures host dispatch, not device
+        # execution, so the efficiency would silently change meaning —
+        # fall back to whole-iteration rounds_per_s there.
         key = ("train_phase_rounds_per_s"
-               if all(r.get("train_phase_rounds_per_s") for r in results)
+               if all(r.get("trace_sync") and r.get("train_phase_rounds_per_s")
+                      for r in results)
                else "rounds_per_s")
         # per-device client-rounds throughput, last vs first mesh size
         # (on virtual devices the ideal is 1/N by serialization — compare

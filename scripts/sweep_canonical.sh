@@ -20,26 +20,31 @@ PLAT=${PLATFORM:-cpu}
 run() { # algo arg concept_num
   local algo=$1 arg=$2 m=$3
   local out="runs/$DS-fnn-$algo-$arg-s$SEED"
-  # Completion markers only — a nested metrics.jsonl alone is NOT one (the
-  # runner appends to it from round one, so a killed run leaves a partial
-  # file; see run_tracked_tpu.sh). Skip on the .done sentinel written below
-  # on zero exit, or on a flattened $out/metrics.jsonl (the committed-run
-  # convention, which is produced only after a completed run).
+  # Completion markers: the .done sentinel (written below on zero exit
+  # only) or a flat $out/metrics.jsonl (the committed-run convention;
+  # historical completed sweeps have exactly that). A killed run can
+  # never match either: the runner writes into $out.inprogress, which is
+  # renamed to $out only after a zero exit — a SIGKILL mid-run leaves
+  # the partial under the .inprogress name, never a plausible $out.
   if [ -f "$out/.done" ] || [ -f "$out/metrics.jsonl" ]; then
     echo "=== skip (done) $out"; return
   fi
-  # Not complete: clear any partial dir from a killed attempt so the rerun
-  # can't append duplicate rows to its nested metrics.jsonl.
-  rm -rf "$out"
+  rm -rf "$out" "$out.inprogress"
   echo "=== $out"
-  python -m feddrift_tpu run --platform "$PLAT" \
+  if python -m feddrift_tpu run --flat_out_dir --platform "$PLAT" \
     --dataset "$DS" --model fnn --change_points A \
     --client_num_in_total 10 --client_num_per_round 10 \
     --train_iterations 10 --comm_round 200 --epochs 5 --batch_size 500 \
     --sample_num 500 --lr 0.01 --frequency_of_the_test 50 --seed "$SEED" \
     --concept_drift_algo "$algo" --concept_drift_algo_arg "$arg" \
-    --concept_num "$m" --out_dir "$out"
-  touch "$out/.done"
+    --concept_num "$m" --out_dir "$out.inprogress"; then
+    mv "$out.inprogress" "$out"
+    touch "$out/.done"
+  else
+    echo "!!! failed $out (partial kept at $out.failed)"
+    rm -rf "$out.failed"
+    mv "$out.inprogress" "$out.failed" 2>/dev/null || true
+  fi
 }
 
 # FedDrift family: canonical delta=.1, per-client-init variants, and the
