@@ -39,7 +39,7 @@ class DriftSurf(DriftAlgorithm):
         super().__init__(cfg, ds, pool, step)
         assert self.M == 2
         p = cfg.algo_params()
-        self.delta = p.get("delta", DRIFTSURF_DELTAS.get(cfg.dataset, 0.1))
+        self.delta = p.get("delta", DRIFTSURF_DELTAS.get(cfg.base_dataset, 0.1))
         self.reac_len = 3                       # r=3 (DriftSurfState.__init__)
         self.win_len = 10                       # batch-window cap
         self.key_params = {"pred": None, "stab": None, "reac": None}
@@ -185,7 +185,7 @@ class MultiModel(DriftAlgorithm):
 
     def __init__(self, cfg, ds, pool, step) -> None:
         super().__init__(cfg, ds, pool, step)
-        self.delta = DEFAULT_DELTAS.get(cfg.dataset, 0.1)
+        self.delta = DEFAULT_DELTAS.get(cfg.base_dataset, 0.1)
         # train_data[m][c] = list of iterations client c contributed to m
         self.train_data = [[[] for _ in range(self.C)] for _ in range(self.M)]
         self.train_idx = np.zeros((self.C,), dtype=np.int64)

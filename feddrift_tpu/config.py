@@ -41,6 +41,9 @@ class ExperimentConfig:
     batch_size: int = 500
     fnn_hidden_dim: int = 10
     fmow_image_size: int = 32          # fmow partition image resolution
+    smooth_sigma: float = 3.0          # basis smoothing (px) for the
+                                       # "-smooth" conv-learnable synthetic
+                                       # image family (data/prototype.py)
     chunk_rounds: bool = True          # scan rounds between evals as one
                                        # device program when the algorithm
                                        # permits (bitwise-identical results)
@@ -119,6 +122,14 @@ class ExperimentConfig:
 
     # ------------------------------------------------------------------
     @property
+    def base_dataset(self) -> str:
+        """Dataset name with task-family suffixes stripped — the key for
+        per-dataset tables (deltas) that are indexed by the underlying
+        task, not the sampler variant ("MNIST-smooth" uses MNIST's
+        deltas)."""
+        return self.dataset.removesuffix("-smooth")
+
+    @property
     def num_models(self) -> int:
         """Size M of the static model pool (reference caps at concept_num)."""
         if self.concept_drift_algo == "aue" or self.concept_drift_algo == "auepc":
@@ -149,7 +160,7 @@ class ExperimentConfig:
         if self.concept_drift_algo == "driftsurf":
             delta = 0.01 * float(arg) if arg and arg.replace(".", "").isdigit() else 0.0
             if delta == 0:
-                delta = DRIFTSURF_DELTAS.get(self.dataset, 0.1)
+                delta = DRIFTSURF_DELTAS.get(self.base_dataset, 0.1)
             out.update(kind="driftsurf", delta=delta)
             return out
         if self.concept_drift_algo == "ada":
@@ -161,7 +172,7 @@ class ExperimentConfig:
         if "mmacc" in arg:
             delta = 0.01 * float(arg.split("_")[-1])
             if delta == 0:
-                delta = DEFAULT_DELTAS.get(self.dataset, 0.1)
+                delta = DEFAULT_DELTAS.get(self.base_dataset, 0.1)
             out.update(kind="mmacc", mmacc_delta=delta)
         elif "softmax" in arg:
             out.update(kind="softmax", softmax_alpha=int(arg.split("_")[-1]))
@@ -171,7 +182,7 @@ class ExperimentConfig:
             parts = arg.split("_")
             h_delta = 0.01 * float(parts[4])
             if h_delta == 0:
-                h_delta = DEFAULT_DELTAS.get(self.dataset, 0.1)
+                h_delta = DEFAULT_DELTAS.get(self.base_dataset, 0.1)
             h_deltap = 0.01 * float(parts[5])
             if h_deltap == 0:
                 h_deltap = h_delta

@@ -71,12 +71,13 @@ def generate_fmow_drift(
     data_dir: str = "./data",
     image_size: int = 32,
     change_points_name: str = "A",
+    smooth_sigma: float = 0.0,
 ) -> DriftDataset:
     T = train_iterations
     concepts = concept_matrix(change_points, T + 1, num_clients, time_stretch)
     num_concepts = int(concepts.max()) + 1
 
-    real = _try_load_partitions(
+    real = None if smooth_sigma > 0 else _try_load_partitions(
         os.path.join(data_dir, "fmow", "partitions", change_points_name),
         num_clients, T, sample_num, image_size)
     if real is not None:
@@ -96,14 +97,25 @@ def generate_fmow_drift(
     # the covariate/temporal drift real FMoW years exhibit. Prototype seed
     # is independent of the experiment seed so data identity survives
     # reseeding.
-    from feddrift_tpu.data.prototype import PrototypeSampler
+    from feddrift_tpu.data.prototype import PrototypeSampler, _smooth_rows
     proto_rng = np.random.default_rng(4242)
     shape = (image_size, image_size, 3)
-    sampler = PrototypeSampler(shape, NUM_CLASSES, proto_seed=4242)
+    sampler = PrototypeSampler(shape, NUM_CLASSES, proto_seed=4242,
+                               smooth_sigma=smooth_sigma)
     # per-concept global shift: simulates the sensor/season/region covariate
-    # drift of real FMoW years
+    # drift of real FMoW years. Under the -smooth family the shift is
+    # smoothed too, so the drift signal itself lives in frequencies conv
+    # stacks see after pooling.
     concept_shift = proto_rng.normal(0.0, 0.5,
                                      (num_concepts, *shape)).astype(np.float32)
+    if smooth_sigma > 0:
+        flat = concept_shift.reshape(num_concepts, -1)
+        norms = np.linalg.norm(flat, axis=1, keepdims=True)
+        flat = _smooth_rows(flat, shape, smooth_sigma)
+        # keep the original shift magnitude (smoothing attenuates energy)
+        flat *= norms / np.maximum(np.linalg.norm(flat, axis=1, keepdims=True),
+                                   1e-12)
+        concept_shift = flat.reshape(num_concepts, *shape).astype(np.float32)
 
     rng = np.random.default_rng(seed)
     x = np.zeros((num_clients, T + 1, sample_num, *shape), dtype=np.float32)
@@ -117,5 +129,8 @@ def generate_fmow_drift(
                 flip = rng.random(sample_num) < noise_prob
                 ys = np.where(flip, (ys + 1) % NUM_CLASSES, ys)
             x[c, t], y[c, t] = xs.astype(np.float32), ys
+    meta = {"real_data": False}
+    if smooth_sigma > 0:
+        meta["smooth_sigma"] = smooth_sigma
     return DriftDataset(x=x, y=y, num_classes=NUM_CLASSES, concepts=concepts,
-                        name="fmow", meta={"real_data": False})
+                        name="fmow", meta=meta)

@@ -1,0 +1,133 @@
+"""Learnability probe for the "-smooth" conv-friendly synthetic image task.
+
+Round-4 structural finding (BASELINE.md): the hardened prototype task's
+white-noise basis is a GLOBAL rank-16 projection with no local spatial
+structure, so conv models stay at chance at any budget while a linear probe
+learns it — conv evidence had to fall back to real digits. The -smooth
+family (data/prototype.py, smooth_sigma > 0) Gaussian-smooths each basis
+field over the image grid so the class signal lives in low spatial
+frequencies that conv + pooling stacks integrate.
+
+This probe measures, per (dataset, smooth_sigma):
+
+- ``bayes_acc`` — the exact Bayes classifier for this generative model
+  (isotropic Gaussian noise around class prototypes => nearest-prototype
+  rule), sampled on fresh data: the task's measured accuracy CEILING;
+- ``cnn_acc`` — CNNFedAvg trained from scratch with adam for a fixed step
+  budget: the conv-learnability verdict.
+
+Pass criterion (asserted by tests/test_data.py::TestSmoothFamily): at
+sigma=3 the CNN is well above chance and below the Bayes ceiling, while at
+sigma=0 (white-noise control) it stays near chance — the round-4 failure
+reproduced, and fixed, in one table.
+
+Usage: python scripts/probe_smooth_conv.py [--steps 600] [--train 4000]
+Prints one JSON line per row plus a summary line.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def probe_one(name: str, sigma: float, steps: int, n_train: int,
+              n_test: int, lr: float, batch: int, seed: int = 0) -> dict:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from feddrift_tpu.data.prototype import SPECS, PrototypeSampler
+    from feddrift_tpu.models.cnn import CNNFedAvg
+
+    feature_shape, num_classes = SPECS[name]
+    sampler = PrototypeSampler(feature_shape, num_classes, smooth_sigma=sigma)
+    rng = np.random.default_rng(seed)
+    xtr, ytr = sampler.sample(rng, n_train)
+    xte, yte = sampler.sample(rng, n_test)
+
+    # Bayes ceiling: isotropic Gaussian noise around class prototypes =>
+    # the optimal rule is nearest prototype (measured, not assumed)
+    protos = sampler.prototypes.reshape(num_classes, -1)
+    d = ((xte.reshape(n_test, -1)[:, None, :] - protos[None]) ** 2).sum(-1)
+    bayes_acc = float((d.argmin(1) == yte).mean())
+
+    model = CNNFedAvg(num_classes=num_classes)
+    params = model.init(jax.random.PRNGKey(seed), jnp.asarray(xtr[:2]))
+    opt = optax.adam(lr)
+    opt_state = opt.init(params)
+
+    @jax.jit
+    def step(params, opt_state, xb, yb):
+        def loss_fn(p):
+            logits = model.apply(p, xb)
+            return optax.softmax_cross_entropy_with_integer_labels(
+                logits, yb).mean()
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        updates, opt_state = opt.update(grads, opt_state)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    @jax.jit
+    def acc(params, x, y):
+        return (model.apply(params, x).argmax(-1) == y).mean()
+
+    xtr_j, ytr_j = jnp.asarray(xtr), jnp.asarray(ytr)
+    t0 = time.time()
+    for i in range(steps):
+        lo = (i * batch) % max(1, n_train - batch)
+        params, opt_state, loss = step(
+            params, opt_state, xtr_j[lo:lo + batch], ytr_j[lo:lo + batch])
+    cnn_acc = float(acc(params, jnp.asarray(xte), jnp.asarray(yte)))
+    return {
+        "dataset": name, "smooth_sigma": sigma, "num_classes": num_classes,
+        "chance": round(1.0 / num_classes, 4),
+        "bayes_acc": round(bayes_acc, 4),
+        "cnn_acc": round(cnn_acc, 4),
+        "final_train_loss": round(float(loss), 4),
+        "steps": steps, "train_samples": n_train,
+        "train_s": round(time.time() - t0, 1),
+    }
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=600)
+    ap.add_argument("--train", type=int, default=4000)
+    ap.add_argument("--test", type=int, default=2000)
+    ap.add_argument("--lr", type=float, default=3e-3)
+    ap.add_argument("--batch", type=int, default=128)
+    ap.add_argument("--sigma", type=float, default=3.0)
+    args = ap.parse_args()
+
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+    rows = []
+    for name in ("femnist", "cifar10"):
+        for sigma in (0.0, args.sigma):
+            r = probe_one(name, sigma, args.steps, args.train, args.test,
+                          args.lr, args.batch)
+            rows.append(r)
+            print(json.dumps(r), flush=True)
+
+    verdicts = {}
+    for r in rows:
+        key = f"{r['dataset']}@{r['smooth_sigma']}"
+        margin = 3.0 * (r["chance"] * (1 - r["chance"]) / args.test) ** 0.5
+        if r["smooth_sigma"] > 0:
+            verdicts[key] = ("PASS" if r["chance"] + max(0.05, margin)
+                             < r["cnn_acc"] < r["bayes_acc"] else "FAIL")
+        else:
+            verdicts[key] = ("control-chance" if r["cnn_acc"]
+                             < r["chance"] + 0.1 else "control-LEARNED")
+    print(json.dumps({"verdicts": verdicts}), flush=True)
+
+
+if __name__ == "__main__":
+    main()
