@@ -17,7 +17,7 @@ out="runs/cifar10-resnet8-hard-r-realdigits-s0"
 if [ -f "$out/.done" ]; then echo "=== skip (done) $out"; exit 0; fi
 rm -rf "$out"
 echo "=== $(date +%T) $out"
-python -m feddrift_tpu run --platform cpu --seed 0 --out_dir "$out" \
+python -m feddrift_tpu run --flat_out_dir --platform cpu --seed 0 --out_dir "$out" \
     --dataset cifar10 --model resnet8 --concept_drift_algo softclusterwin-1 \
     --concept_drift_algo_arg hard-r --concept_num 2 --change_points rand \
     --client_num_in_total 4 --client_num_per_round 4 \

@@ -10,13 +10,13 @@
 #      real ICI numbers are the scarcest artifact (round-3 verdict #10);
 #   1. run the full TPU benchmark (canonical 1600-round steady state +
 #      conv + dispatch-RTT + MFU-vs-batch sweep, with jax.profiler traces
-#      under profiles/r04/) and persist it to BENCH_r04_tpu.json;
+#      under profiles/r05/) and persist it to BENCH_r05_tpu.json;
 #   2. run the tracked-config queue (resumable, .done/.giveup sentinels).
 # Exits when the bench artifact and all queue targets are settled.
 set -uo pipefail
 cd "$(dirname "$0")/.."
 
-BENCH_OUT=BENCH_r04_tpu.json
+BENCH_OUT=BENCH_r05_tpu.json
 TARGETS=(
   cifar10-resnet-softclusterwin-1-hard-r-s0
   femnist-cnn-ada-win-1_iter-100c-s0
@@ -53,11 +53,11 @@ while ! all_done; do
     continue
   fi
   echo "[sup] $(date +%T) tunnel up ($ndev device(s))"
-  if [ "${ndev:-1}" -gt 1 ] && [ ! -s SCALING_r04_real.json ]; then
+  if [ "${ndev:-1}" -gt 1 ] && [ ! -s SCALING_r05_real.json ]; then
     echo "[sup] POD SLICE VISIBLE: running real-mesh scaling bench first"
     timeout 3600 python scripts/scaling_bench.py > /tmp/scaling_real.json \
       2>> /tmp/scaling_real.err \
-      && cp /tmp/scaling_real.json SCALING_r04_real.json \
+      && cp /tmp/scaling_real.json SCALING_r05_real.json \
       && echo "[sup] real-mesh scaling captured" \
       || echo "[sup] real-mesh scaling attempt failed"
   fi
@@ -67,7 +67,7 @@ while ! all_done; do
     # the canonical or conv measurement failed; an embedded per-point error
     # in the mfu sweep is honest partial evidence, not a reason to re-pay
     # the whole multi-hour benchmark on the next window.
-    if FEDDRIFT_PROFILE_DIR=profiles/r04 \
+    if FEDDRIFT_PROFILE_DIR=profiles/r05 \
        python bench.py > /tmp/bench_try.json 2>> /tmp/bench_try.err \
        && grep -q '"backend": "tpu"' /tmp/bench_try.json; then
       cp /tmp/bench_try.json "$BENCH_OUT"
