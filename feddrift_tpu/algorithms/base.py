@@ -110,9 +110,30 @@ class DriftAlgorithm:
         self.logger = logger
         self.C_pad = c_pad
 
+    def offer_acc_matrix(self, params, offers: "dict[int, np.ndarray]") -> None:
+        """Runner ride-along: the fused iteration program's final eval slot
+        already holds the accuracy of the FINAL params on step t data (the
+        end_iteration consumers) and step t+1 data (the next cluster
+        phase) — exactly what ``acc_matrix_at`` would dispatch fresh device
+        calls to recompute. Caching them saves host<->device round trips
+        (~100 ms each on tunneled TPU links, docs/TPU_BOTTLENECK.md).
+
+        ``params`` must be the EVALUATED params object (the fused program's
+        output), not ``pool.params`` after ``after_round``: an after_round
+        that returns transformed params would otherwise key accuracies of
+        the pre-transform params to the post-transform object. The cache is
+        keyed on that object's identity — any pool mutation rebinds
+        ``pool.params`` and silently invalidates it, so correctness never
+        depends on the cache hitting."""
+        self._acc_offer = (params, dict(offers))
+
     def acc_matrix_at(self, t: int, feat_mask=None) -> np.ndarray:
         """[M, C] accuracy of every model on every client's step-t data
         (reference train_acc_matrix, FedAvgEnsDataLoader.py:1074-1085)."""
+        offer = getattr(self, "_acc_offer", None)
+        if (offer is not None and feat_mask is None
+                and offer[0] is self.pool.params and t in offer[1]):
+            return offer[1][t]
         if self.x is None:
             raise RuntimeError(
                 "full-dataset eval is unavailable under cfg.stream_data")

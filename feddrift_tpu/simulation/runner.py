@@ -454,6 +454,18 @@ class Experiment:
                                corr_te[slot][:, :C], loss_te[slot][:, :C],
                                total[:C])
         self.global_round = g0 + R
+        # The final eval slot holds acc(final params, step t) and
+        # acc(final params, step t+1) — offer both so end_iteration
+        # consumers (MultiModel selection) and the next cluster phase each
+        # skip a device round trip (offer_acc_matrix's params-identity key,
+        # taken from the EVALUATED new_params, makes this a pure
+        # optimisation). Only valid when the chunk ran the algorithm's
+        # plain all-ones feature mask on the resident dataset.
+        if not stream and fm is getattr(self.algo, "_ones_feat_mask", None):
+            tot = np.maximum(total[None, :C], 1)
+            self.algo.offer_acc_matrix(
+                new_params, {t: corr_tr[-1][:, :C] / tot,
+                             t + 1: corr_te[-1][:, :C] / tot})
 
     def run(self) -> MetricsLogger:
         for t in range(self.start_iteration, self.cfg.train_iterations):

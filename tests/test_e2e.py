@@ -51,6 +51,43 @@ class TestEndToEnd:
         b = run_experiment(_cfg(chunk_rounds=False, **kw)).logger.series("Test/Acc")
         assert a == b, (a, b)
 
+    def test_acc_matrix_ride_along_cache(self, monkeypatch):
+        # The fused path offers its final eval slot as next iteration's
+        # cluster-phase acc matrix (runner._run_iteration_fused ->
+        # DriftAlgorithm.offer_acc_matrix): the cache must actually hit
+        # (saving one device round trip per iteration) AND the clustering
+        # trajectory must be identical with the cache defeated.
+        from feddrift_tpu.algorithms.base import DriftAlgorithm
+        from feddrift_tpu.core.step import TrainStep
+
+        kw = dict(concept_drift_algo="softcluster",
+                  concept_drift_algo_arg="H_A_C_1_10_0", concept_num=3,
+                  train_iterations=3, comm_round=8, frequency_of_the_test=4)
+
+        calls = {"n": 0}
+        orig = TrainStep.acc_matrix
+
+        def counting(self, *a, **k):
+            calls["n"] += 1
+            return orig(self, *a, **k)
+
+        monkeypatch.setattr(TrainStep, "acc_matrix", counting)
+        exp_a = run_experiment(_cfg(chunk_rounds=True, **kw))
+        hits = calls["n"]
+
+        monkeypatch.setattr(DriftAlgorithm, "offer_acc_matrix",
+                            lambda self, params, offers: None)
+        calls["n"] = 0
+        exp_b = run_experiment(_cfg(chunk_rounds=True, **kw))
+        misses = calls["n"]
+
+        # cache removes >= (iterations - 1) standalone acc_matrix dispatches
+        assert misses - hits >= kw["train_iterations"] - 1, (hits, misses)
+        # and changes nothing observable
+        assert exp_a.logger.series("Test/Acc") == exp_b.logger.series("Test/Acc")
+        import numpy as np
+        assert np.array_equal(exp_a.algo.weights, exp_b.algo.weights)
+
     def test_fused_iteration_eval_cadence(self):
         # the fully-fused iteration program must log evals at the reference
         # cadence — every frequency_of_the_test rounds plus the final round

@@ -49,6 +49,19 @@ class TestFedDrift:
             col = w[t].sum(axis=0)
             assert np.allclose(col, 1.0), (t, col)
 
+    def test_event_counters_track_drift_machinery(self):
+        # The scaling bench's event ledger (SCALING_r05) relies on these:
+        # a drift run must record its spawns and linkage calls, and the
+        # counters must be consistent with the observable pool state.
+        exp = run_experiment(_cfg())
+        ev = exp.algo.event_counts
+        # golden counts for this deterministic seed (the suite's style):
+        # one drift spawn, linkage evaluated twice once 2 models exist, and
+        # the two models stay separate (distinct concepts -> no merge)
+        assert ev == {"spawns": 1, "merges": 0, "linkage_calls": 2}, ev
+        # every spawned model beyond the initial one is counted
+        assert exp.logger.summary["num_models"] <= 1 + ev["spawns"]
+
     def test_feddrift_f_requires_enough_models(self):
         with pytest.raises(ValueError):
             run_experiment(_cfg(concept_drift_algo_arg="H_A_F_1_10_0"))
