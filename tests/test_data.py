@@ -199,6 +199,15 @@ class TestSmoothFamily:
                                  data_dir=str(tmp_path))
         assert make_dataset(plain).meta["real_data"] is True
 
+    @staticmethod
+    def neighbour_corr(imgs):
+        """Horizontal neighbouring-pixel correlation of [..., H, W] images —
+        high for spatially smooth fields, ~0 for white noise."""
+        a = (imgs[..., :, :-1] - imgs.mean()).ravel()
+        b = (imgs[..., :, 1:] - imgs.mean()).ravel()
+        return float((a * b).mean()
+                     / np.sqrt((a * a).mean() * (b * b).mean() + 1e-12))
+
     def test_basis_is_spatially_smooth(self):
         # neighbouring-pixel correlation of the prototypes must be high
         # under smoothing and near zero for the white-noise basis — the
@@ -206,11 +215,7 @@ class TestSmoothFamily:
         from feddrift_tpu.data.prototype import PrototypeSampler
 
         def neighbour_corr(protos):
-            imgs = protos.reshape(protos.shape[0], 28, 28)
-            a = imgs[:, :, :-1].ravel() - imgs.mean()
-            b = imgs[:, :, 1:].ravel() - imgs.mean()
-            return float((a * b).mean()
-                         / np.sqrt((a * a).mean() * (b * b).mean()))
+            return self.neighbour_corr(protos.reshape(protos.shape[0], 28, 28))
 
         smooth = PrototypeSampler((784,), 10, smooth_sigma=3.0)
         white = PrototypeSampler((784,), 10, smooth_sigma=0.0)
@@ -241,6 +246,27 @@ class TestSmoothFamily:
                                client_num_per_round=2)
         a, b = make_dataset(cfg), make_dataset(cfg)
         assert np.array_equal(a.x, b.x) and np.array_equal(a.y, b.y)
+
+    def test_fmow_smooth_covariate_drift(self):
+        # fmow-smooth keeps fmow's drift semantics (fixed labels, shifted
+        # inputs) with a SMOOTHED concept shift of preserved magnitude
+        cfg = ExperimentConfig(dataset="fmow-smooth", train_iterations=2,
+                               sample_num=8, client_num_in_total=4,
+                               client_num_per_round=4, change_points="A")
+        ds = make_dataset(cfg)
+        assert ds.x.shape == (4, 3, 8, 32, 32, 3)
+        assert ds.num_classes == 62
+        assert ds.meta["smooth_sigma"] > 0
+        k = ds.concepts
+        drifted = [(c, t) for c in range(4) for t in range(3)
+                   if k[t, c] != k[0, c]]
+        assert drifted, "preset A must drift someone in 2 iterations"
+        c, t = drifted[0]
+        assert abs(ds.x[c, t].mean() - ds.x[c, 0].mean()) > 0.01
+        # the shift itself is spatially smooth: neighbouring-pixel corr of
+        # the mean concept difference is high (white shift would be ~0)
+        diff = (ds.x[c, t].mean(0) - ds.x[c, 0].mean(0))[:, :, 0]
+        assert self.neighbour_corr(diff) > 0.5
 
     @pytest.mark.slow
     def test_conv_learnability(self):
