@@ -51,17 +51,19 @@ run femnist-smooth-cnn-ada-win-1_iter-s0 \
 # 3. FMoW-smooth / cnn FedDrift (canonical packed arg, M=4)
 run fmow-smooth-cnn-softcluster-H_A_C_1_10_0-s0 \
     --dataset fmow-smooth --model cnn --concept_drift_algo softcluster \
+    --chunk_rounds false \
     --concept_drift_algo_arg H_A_C_1_10_0 --concept_num 4 --change_points A \
     --client_num_in_total 10 --client_num_per_round 10 \
-    --train_iterations 5 --comm_round 8 --epochs 5 --batch_size 32 \
+    --train_iterations 2 --comm_round 4 --epochs 5 --batch_size 32 \
     --sample_num 500 --lr 0.003 --frequency_of_the_test 4
 
 # 4. FMoW-smooth / cnn win-1 baseline, same shape (M=1)
 run fmow-smooth-cnn-win-1-s0 \
     --dataset fmow-smooth --model cnn --concept_drift_algo win-1 \
+    --chunk_rounds false \
     --concept_num 1 --change_points A \
     --client_num_in_total 10 --client_num_per_round 10 \
-    --train_iterations 5 --comm_round 8 --epochs 5 --batch_size 32 \
+    --train_iterations 2 --comm_round 4 --epochs 5 --batch_size 32 \
     --sample_num 500 --lr 0.003 --frequency_of_the_test 4
 
 # 5. Ada on femnist/cnn at 50 clients, REAL digits (half defined scale)
