@@ -353,6 +353,7 @@ def _profile_capture(cfg, profile_dir: str) -> str | None:
 
 def _measure(cfg, backend: str) -> dict:
     """Run one config to steady state and return its measured numbers."""
+    from feddrift_tpu import obs
     from feddrift_tpu.simulation.runner import Experiment
 
     exp = Experiment(cfg)
@@ -362,6 +363,12 @@ def _measure(cfg, backend: str) -> dict:
     # merge path, so steady-state timing must start at t=2.
     exp.run_iteration(0)
     exp.run_iteration(1)
+
+    # Reset instruments AFTER warm-up so the snapshot attached to the
+    # result covers exactly the timed steady state: compile counts here
+    # mean steady-state retraces (ideally zero), and the phase_seconds
+    # histograms are per-phase latency distributions of the measured rounds.
+    obs.registry().reset()
 
     # Timed steady state: the remaining time steps.
     t0 = time.time()
@@ -389,6 +396,10 @@ def _measure(cfg, backend: str) -> dict:
         "rounds": rounds,
         "mfu_estimate": mfu,
         "phases": getattr(exp, "last_phase_summary", None),
+        # Cross-layer instrument snapshot for the steady state: compile /
+        # recompile counts per program, phase_seconds histograms, comm
+        # counters when a transport is active (obs/instruments.py).
+        "instruments": obs.registry().snapshot(),
     }
 
 

@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import numpy as np
 
+from feddrift_tpu import obs
 from feddrift_tpu.algorithms.base import DriftAlgorithm, EnsembleSpec, register_algorithm
 from feddrift_tpu.comm import multihost
 from feddrift_tpu.data.retrain import poisson_sample_counts, time_weights
@@ -72,6 +73,7 @@ class _AueBase(DriftAlgorithm):
             for m in reversed(range(1, self.model_num)):
                 self.pool.copy_slot(m, m - 1)
             self.pool.reinit_slot(0)
+            obs.emit("model_replaced", model=0, reason="aue_window_shift")
             # Weights shift with the models; fresh model starts "perfect".
             if self.per_client_weights:
                 self.ens_weights[:, 1:] = self.ens_weights[:, :-1]
@@ -202,6 +204,9 @@ class Kue(DriftAlgorithm):
             # (init_kue_state, AggregatorKue.py:47-57).
             self._init_mask(self.worst_idx)
             self.pool.reinit_slot(self.worst_idx)
+            obs.emit("model_replaced", model=int(self.worst_idx),
+                     reason="kue_worst_kappa",
+                     kappa=round(float(self.ens_weights[self.worst_idx]), 4))
         # win-1 time window; per-model Poisson bootstrap sample weights.
         w = time_weights("win-1", self.C, t, self.T1)
         self._tw = jnp.asarray(np.broadcast_to(w[None], (self.M, self.C, self.T1)).copy())

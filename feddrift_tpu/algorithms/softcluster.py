@@ -37,6 +37,7 @@ import scipy.cluster.hierarchy as sch
 from scipy.spatial.distance import squareform
 from scipy.special import softmax as sp_softmax
 
+from feddrift_tpu import obs
 from feddrift_tpu.algorithms.base import DriftAlgorithm, register_algorithm
 from feddrift_tpu.comm import multihost
 
@@ -238,6 +239,9 @@ class SoftCluster(DriftAlgorithm):
         for c in range(self.C):
             newest_acc = acc[best[c], c]
             if self.mmacc_acc[c] - newest_acc > self.mmacc_delta:
+                obs.emit("drift_detected", client=c,
+                         acc_drop=round(float(self.mmacc_acc[c] - newest_acc), 4),
+                         best_model=int(best[c]))
                 if next_free == -42:
                     next_free = self._find_unused_model_lru(t, original_model=best[c])
                 if next_free != -1:
@@ -258,6 +262,8 @@ class SoftCluster(DriftAlgorithm):
                     if mm != keep:
                         self.pool.reinit_slot(mm)
                         self.weights[:, mm, :] = 0.0
+                        obs.emit("cluster_delete", model=int(mm),
+                                 reason="feddrift_c_keep_one")
 
         # clients leave isolation (:852, :1038-1046)
         self.h_marked = {c: (m, tt) for c, (m, tt) in self.h_marked.items()
@@ -283,6 +289,9 @@ class SoftCluster(DriftAlgorithm):
             best = in_use[int(np.argmax(acc[in_use, c]))]
             newest_acc = acc[best, c]
             if self.mmacc_acc[c] - newest_acc > self.h_delta:
+                obs.emit("drift_detected", client=c,
+                         acc_drop=round(float(self.mmacc_acc[c] - newest_acc), 4),
+                         best_model=int(best))
                 next_free = self._find_unused_model_lru(t, original_model=best)
                 if next_free != -1:
                     self.event_counts["spawns"] += 1
@@ -343,6 +352,7 @@ class SoftCluster(DriftAlgorithm):
     def _merge(self, t: int, base: int, second: int) -> None:
         """Weighted param average + weight union (merge, :1048-1072)."""
         self.event_counts["merges"] += 1
+        obs.emit("cluster_merge", base=int(base), merged=int(second))
         w1 = float(self.weights[: t + 1, base, :].sum())
         w2 = float(self.weights[: t + 1, second, :].sum())
         s = w1 + w2
@@ -368,6 +378,8 @@ class SoftCluster(DriftAlgorithm):
             self.weights[:, nxt, :] = 0.0
         # initialise from the drifted client's previous model (:1031-1033)
         self.pool.copy_slot(nxt, original_model)
+        obs.emit("cluster_create", model=int(nxt),
+                 init_from=int(original_model))
         return nxt
 
     # -- softclusterreset ----------------------------------------------
@@ -384,6 +396,8 @@ class SoftCluster(DriftAlgorithm):
                     self.logger.set_summary(f"Reset-{m}", 1)
                 self.weights[:, m, :] = 0.0
                 self.pool.reinit_slot(m)
+                obs.emit("cluster_delete", model=int(m),
+                         reason="noncompetitive_reset")
 
     # -- CFL ------------------------------------------------------------
     def _cluster_cfl_init(self, t: int) -> None:
@@ -441,6 +455,10 @@ class SoftCluster(DriftAlgorithm):
                             self.weights[t, m, participating[i]] = 1.0
                         for i in cl2:
                             self.weights[t, nxt, participating[i]] = 1.0
+                        obs.emit(
+                            "cluster_split", model=int(m), new_model=int(nxt),
+                            clients_kept=[int(participating[i]) for i in cl1],
+                            clients_moved=[int(participating[i]) for i in cl2])
 
         if did_split and self.cfl_retrain == "all":           # (:1219-1221)
             for tt in range(t):
@@ -485,6 +503,12 @@ class SoftCluster(DriftAlgorithm):
             num_models = sum(1 for m in range(self.M)
                              if (self.weights[: t + 1, m, :] > 0).any())
         self.logger.set_summary("num_models", num_models)
+        # The paper's key hidden state, now first-class telemetry: one
+        # cluster_state event per iteration plus a live gauge.
+        obs.registry().gauge("num_models").set(num_models)
+        obs.emit("cluster_state", num_models=int(num_models),
+                 spawns=self.event_counts["spawns"],
+                 merges=self.event_counts["merges"])
 
         trained_by = {m: set(np.nonzero(self.weights[: t + 1, m, :].sum(0))[0])
                       for m in range(self.M)}

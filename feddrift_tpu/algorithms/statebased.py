@@ -15,6 +15,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from feddrift_tpu import obs
 from feddrift_tpu.algorithms.base import DriftAlgorithm, register_algorithm
 from feddrift_tpu.comm import multihost
 from feddrift_tpu.config import DEFAULT_DELTAS, DRIFTSURF_DELTAS
@@ -81,6 +82,9 @@ class DriftSurf(DriftAlgorithm):
             acc_stab = 0.0 if not self.train_data["stab"] else self._score("stab", t)
             if (acc_pred < self.acc_best - self.delta) or \
                (acc_pred < acc_stab - self.delta / 2):
+                obs.emit("drift_detected", detector="driftsurf",
+                         acc_pred=round(acc_pred, 4),
+                         acc_best=round(self.acc_best, 4))
                 self.state = "reac"
                 self.key_params["reac"] = None
                 self.train_data["reac"] = []
@@ -216,6 +220,13 @@ class MultiModel(DriftAlgorithm):
                 if acc[m, c] > best_acc:
                     best_acc, best_model = acc[m, c], m
             if self.acc_dict[c] - best_acc > self.delta and next_free != -1:
+                obs.emit("drift_detected", client=c,
+                         acc_drop=round(float(self.acc_dict[c] - best_acc), 4),
+                         best_model=int(best_model))
+                if not any(self.train_data[next_free][cc]
+                           for cc in range(self.C)):
+                    obs.emit("cluster_create", model=int(next_free),
+                             init_from=None)
                 best_model = next_free
             self.train_data[best_model][c].append(t)
             self.train_idx[c] = best_model

@@ -14,6 +14,10 @@ checkpoint.
 
     python -m feddrift_tpu resume --out_dir runs/my-run
     python -m feddrift_tpu list   # algorithms / datasets / models
+    python -m feddrift_tpu report runs/my-run   # telemetry run report
+
+Logging is configured in exactly one place (obs.setup_logging), driven by
+the ``--log_level`` flag every subcommand accepts.
 """
 
 from __future__ import annotations
@@ -21,7 +25,6 @@ from __future__ import annotations
 import argparse
 import dataclasses
 import json
-import logging
 import sys
 
 
@@ -88,10 +91,10 @@ def _cfg_from_args(args: argparse.Namespace):
 
 
 def main(argv: list[str] | None = None) -> int:
-    logging.basicConfig(
-        level=logging.INFO,
-        format="%(asctime)s %(name)s %(levelname)s %(message)s")
     parser = argparse.ArgumentParser(prog="feddrift_tpu")
+    parser.add_argument("--log_level", type=str, default="info",
+                        help="logging level for the feddrift_tpu loggers "
+                             "(debug|info|warning|error)")
     sub = parser.add_subparsers(dest="cmd", required=True)
 
     run_p = sub.add_parser("run", help="run a drift-FL experiment")
@@ -106,7 +109,27 @@ def main(argv: list[str] | None = None) -> int:
 
     sub.add_parser("list", help="list algorithms / datasets / models")
 
+    rep_p = sub.add_parser(
+        "report", help="render a run report from events.jsonl + metrics.jsonl")
+    rep_p.add_argument("run_dirs", nargs="+")
+    rep_p.add_argument("--json", action="store_true")
+
+    # --log_level is also accepted after the subcommand for convenience
+    # (SUPPRESS default: an absent post-subcommand flag must not clobber a
+    # pre-subcommand one — both write the same namespace attribute)
+    for p in (run_p, res_p, rep_p):
+        p.add_argument("--log_level", type=str, default=argparse.SUPPRESS,
+                       help=argparse.SUPPRESS)
+
     args = parser.parse_args(argv)
+
+    from feddrift_tpu.obs import setup_logging
+    setup_logging(getattr(args, "log_level", None) or "info")
+
+    if args.cmd == "report":
+        # pure host-side: no jax / backend initialisation needed
+        from feddrift_tpu.obs.report import main as report_main
+        return report_main(args.run_dirs + (["--json"] if args.json else []))
 
     if getattr(args, "platform", ""):
         import jax

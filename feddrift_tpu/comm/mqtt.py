@@ -38,6 +38,8 @@ import struct
 import threading
 from collections import defaultdict
 
+from feddrift_tpu import obs
+
 from .netbroker import TcpFanoutServer
 
 # Control packet types (MQTT 3.1.1 §2.2.1)
@@ -153,8 +155,12 @@ class MqttBroker(TcpFanoutServer):
     class is only the MQTT framing."""
 
     _BINARY = True
+    TRANSPORT = "mqtt"
 
     def _handle(self, conn: socket.socket, f) -> None:
+        reg = obs.registry()
+        msgs_in = reg.counter("broker_messages_in", transport=self.TRANSPORT)
+        bytes_in = reg.counter("broker_bytes_in", transport=self.TRANSPORT)
         pkt = read_packet(f)
         if pkt is None or pkt[0] != CONNECT:
             return                           # §3.1: first packet MUST be CONNECT
@@ -164,6 +170,8 @@ class MqttBroker(TcpFanoutServer):
             if pkt is None:
                 return
             ptype, flags, body = pkt
+            msgs_in.inc()
+            bytes_in.inc(len(body) + 2)      # + fixed header approximation
             if ptype == PUBLISH:
                 qos = (flags >> 1) & 0x03
                 if qos == 3:
@@ -248,18 +256,26 @@ class MqttBrokerClient:
     def _send(self, frame: bytes) -> None:
         with self._wlock:
             self._sock.sendall(frame)
+        reg = obs.registry()
+        reg.counter("client_messages_out", transport="mqtt").inc()
+        reg.counter("client_bytes_out", transport="mqtt").inc(len(frame))
 
     def _next_pid(self) -> int:
         self._pid = self._pid % 65535 + 1
         return self._pid
 
     def _read_loop(self) -> None:
+        reg = obs.registry()
+        msgs_in = reg.counter("client_messages_in", transport="mqtt")
+        bytes_in = reg.counter("client_bytes_in", transport="mqtt")
         try:
             while True:
                 pkt = read_packet(self._f)
                 if pkt is None:
                     return
                 ptype, _flags, body = pkt
+                msgs_in.inc()
+                bytes_in.inc(len(body) + 2)
                 if ptype == CONNACK:
                     self._connack_code = body[1] if len(body) > 1 else 0xFF
                     self._connack.set()      # __init__ raises on refusal

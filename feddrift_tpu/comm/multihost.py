@@ -17,6 +17,8 @@ from __future__ import annotations
 
 import jax
 
+from feddrift_tpu import obs
+
 
 def initialize(coordinator_address: str | None = None,
                num_processes: int | None = None,
@@ -51,6 +53,8 @@ def broadcast_from_coordinator(tree):
     """Every host returns process 0's pytree value."""
     if jax.process_count() == 1:
         return tree
+    obs.registry().counter("multihost_collectives",
+                           op="broadcast").inc()
     from jax.experimental import multihost_utils
     return multihost_utils.broadcast_one_to_all(tree)
 
@@ -86,6 +90,7 @@ def fetch(tree):
     every host (the algorithms' host-side clustering logic then runs
     identically everywhere, keeping the SPMD programs in lockstep).
     """
+    obs.registry().counter("multihost_fetches").inc()
     if jax.process_count() == 1:
         return jax.device_get(tree)
     from jax.experimental import multihost_utils

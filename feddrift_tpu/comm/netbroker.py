@@ -30,6 +30,8 @@ import socket
 import threading
 from collections import defaultdict
 
+from feddrift_tpu import obs
+
 
 class TcpFanoutServer:
     """Shared TCP pub/sub broker lifecycle.
@@ -48,6 +50,7 @@ class TcpFanoutServer:
     # Sized for control-plane traffic (coordination messages, not tensors).
     OUT_QUEUE_DEPTH = 256
     _BINARY = False          # subclasses: True for byte-framed protocols
+    TRANSPORT = "netbroker"  # instrument label (MqttBroker: "mqtt")
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0) -> None:
         self._srv = socket.create_server((host, port))
@@ -85,6 +88,8 @@ class TcpFanoutServer:
             with self._lock:
                 self._conns.add(conn)
                 self._out[conn] = outq
+            obs.registry().counter(
+                "broker_conns_opened", transport=self.TRANSPORT).inc()
             threading.Thread(target=self._serve, args=(conn,),
                              daemon=True).start()
             threading.Thread(target=self._write_loop, args=(conn, outq),
@@ -110,11 +115,19 @@ class TcpFanoutServer:
             return
         try:
             outq.put_nowait(frame)
+            reg = obs.registry()
+            reg.counter("broker_messages_out", transport=self.TRANSPORT).inc()
+            reg.counter("broker_bytes_out",
+                        transport=self.TRANSPORT).inc(len(frame))
         except queue.Full:                  # wedged subscriber: drop it
             with self._lock:
                 for subs in self._subs.values():
                     if conn in subs:
                         subs.remove(conn)
+            obs.registry().counter("broker_wedged_drops",
+                                   transport=self.TRANSPORT).inc()
+            obs.emit("conn_wedged_drop", transport=self.TRANSPORT,
+                     queue_depth=self.OUT_QUEUE_DEPTH)
             self._kill(conn)                # unblocks its reader/writer
 
     def _serve(self, conn: socket.socket) -> None:
@@ -138,6 +151,9 @@ class TcpFanoutServer:
                     outq.put_nowait(None)   # stop the writer thread
                 except queue.Full:
                     pass                    # writer dies on the shutdown
+            obs.registry().counter("broker_conn_drops",
+                                   transport=self.TRANSPORT).inc()
+            obs.emit("conn_drop", transport=self.TRANSPORT)
             self._kill(conn)                # aborts a blocked sendall too
 
     def _handle(self, conn: socket.socket, f) -> None:
@@ -159,7 +175,12 @@ class NetworkBroker(TcpFanoutServer):
     """The NDJSON broker: accepts clients, routes topic publishes."""
 
     def _handle(self, conn: socket.socket, f) -> None:
+        reg = obs.registry()
+        msgs_in = reg.counter("broker_messages_in", transport=self.TRANSPORT)
+        bytes_in = reg.counter("broker_bytes_in", transport=self.TRANSPORT)
         for line in f:
+            msgs_in.inc()
+            bytes_in.inc(len(line))
             try:
                 d = json.loads(line)
             except json.JSONDecodeError:
@@ -200,11 +221,19 @@ class NetworkBrokerClient:
         data = (json.dumps(obj) + "\n").encode()
         with self._wlock:
             self._sock.sendall(data)
+        reg = obs.registry()
+        reg.counter("client_messages_out", transport="netbroker").inc()
+        reg.counter("client_bytes_out", transport="netbroker").inc(len(data))
 
     def _read_loop(self) -> None:
         f = self._sock.makefile("r", encoding="utf-8")
+        reg = obs.registry()
+        msgs_in = reg.counter("client_messages_in", transport="netbroker")
+        bytes_in = reg.counter("client_bytes_in", transport="netbroker")
         try:
             for line in f:
+                msgs_in.inc()
+                bytes_in.inc(len(line))
                 try:
                     d = json.loads(line)
                 except json.JSONDecodeError:

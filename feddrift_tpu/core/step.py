@@ -38,7 +38,7 @@ Poisson bootstrap resample, retrain.py:65-74).
 
 from __future__ import annotations
 
-from dataclasses import dataclass
+from dataclasses import dataclass, field
 from functools import partial
 from typing import Callable
 
@@ -46,6 +46,7 @@ import jax
 import jax.numpy as jnp
 import optax
 
+from feddrift_tpu import obs
 from feddrift_tpu.core.functional import confusion_matrix, cross_entropy, tree_select
 
 
@@ -95,6 +96,31 @@ class TrainStep:
     # the B-draw categorical over the flattened [T1*N] axis — by far the most
     # expensive op of a small-model round — is never emitted.
     weighted_sampling: bool = False
+    # Compile tracking: per jitted entry point, the set of argument
+    # signatures (leaf shapes/dtypes + static values) seen so far. jit
+    # retraces exactly when the signature is new, so a second distinct
+    # signature on the same entry point IS a recompile — including the
+    # donated-buffer programs, where a silent recompile also doubles the
+    # transient HBM for the donated args.
+    _signatures: dict = field(default_factory=dict, repr=False)
+
+    def _note_signature(self, fn: str, *trees, static=()) -> None:
+        """Record the call signature; emits jit_compile on first sight and
+        jit_recompile when a DIFFERENT signature was seen before. O(leaves)
+        host work per dispatch — microseconds against a multi-ms round."""
+        sig = tuple(static) + tuple(
+            (leaf.shape, str(getattr(leaf, "dtype", type(leaf).__name__)))
+            if hasattr(leaf, "shape") else repr(leaf)
+            for tree in trees for leaf in jax.tree_util.tree_leaves(tree))
+        seen = self._signatures.setdefault(fn, set())
+        if sig in seen:
+            return
+        kind = "jit_compile" if not seen else "jit_recompile"
+        seen.add(sig)
+        obs.registry().counter("jit_compiles", fn=fn).inc()
+        if kind == "jit_recompile":
+            obs.registry().counter("jit_recompiles", fn=fn).inc()
+        obs.emit(kind, fn=fn, signature_count=len(seen))
 
     # ------------------------------------------------------------------
     def init_opt_states(self, params, num_models: int, num_clients: int):
@@ -213,8 +239,6 @@ class TrainStep:
         new_params = jax.tree_util.tree_map(avg, client_params, params)
         return new_params, new_opt, client_params, n, losses
 
-    @partial(jax.jit, static_argnums=0,
-             static_argnames=("keep_client_params",))
     def train_round(self, params, opt_states, key, x, y, time_w, sample_w,
                     feat_mask, lr_scale, client_mask=None, *,
                     keep_client_params: bool = True):
@@ -227,6 +251,19 @@ class TrainStep:
         buffer is M x C full model copies of HBM the weighted-mean reduction
         can otherwise stream through.
         """
+        self._note_signature(
+            "train_round", params, opt_states, x, y, time_w, sample_w,
+            feat_mask, client_mask,
+            static=(keep_client_params,))
+        return self._train_round_jit(
+            params, opt_states, key, x, y, time_w, sample_w, feat_mask,
+            lr_scale, client_mask, keep_client_params=keep_client_params)
+
+    @partial(jax.jit, static_argnums=0,
+             static_argnames=("keep_client_params",))
+    def _train_round_jit(self, params, opt_states, key, x, y, time_w,
+                         sample_w, feat_mask, lr_scale, client_mask=None, *,
+                         keep_client_params: bool = True):
         out = self._round_body(params, opt_states, key, x, y, time_w,
                                sample_w, feat_mask, lr_scale, client_mask)
         if keep_client_params:
@@ -243,10 +280,29 @@ class TrainStep:
             rounds.append(R - 1)
         return rounds
 
-    @partial(jax.jit, static_argnums=(0, 10, 11), donate_argnums=(1, 2))
     def train_iteration_eval(self, params, opt_states, iter_key, x, y, time_w,
                              sample_w, feat_mask, lr_scale, R: int, freq: int,
                              t, client_masks=None):
+        """ALL R communication rounds of a time step + every scheduled eval
+        as ONE device program (dispatches ``_train_iteration_eval_jit``).
+
+        Argument signatures are tracked per donated-buffer layout: this is
+        the donating program (params/opt_states, argnums 1-2), where an
+        unnoticed retrace both costs a compile and transiently doubles the
+        donated buffers' HBM — exactly the recompile the event stream must
+        surface.
+        """
+        self._note_signature(
+            "train_iteration_eval", params, opt_states, x, y, time_w,
+            sample_w, feat_mask, client_masks, static=(R, freq))
+        return self._train_iteration_eval_jit(
+            params, opt_states, iter_key, x, y, time_w, sample_w, feat_mask,
+            lr_scale, R, freq, t, client_masks)
+
+    @partial(jax.jit, static_argnums=(0, 10, 11), donate_argnums=(1, 2))
+    def _train_iteration_eval_jit(self, params, opt_states, iter_key, x, y,
+                                  time_w, sample_w, feat_mask, lr_scale,
+                                  R: int, freq: int, t, client_masks=None):
         """ALL R communication rounds of a time step + every scheduled eval
         as ONE device program.
 
@@ -310,7 +366,6 @@ class TrainStep:
         return params, opt_states, ns[-1], ls[-1], bufs, total
 
     # ------------------------------------------------------------------
-    @partial(jax.jit, static_argnums=0)
     def acc_matrix(self, params, x, y, feat_mask):
         """Batched [M, C] eval of every model on every client's data.
 
@@ -319,6 +374,11 @@ class TrainStep:
         FedAvgEnsDataLoader.py:1074-1085) — with one [M, C, N] forward.
         x: [C, N, ...]; returns (correct [M, C], loss_sum [M, C], total [C]).
         """
+        self._note_signature("acc_matrix", params, x, y, feat_mask)
+        return self._acc_matrix_jit(params, x, y, feat_mask)
+
+    @partial(jax.jit, static_argnums=0)
+    def _acc_matrix_jit(self, params, x, y, feat_mask):
         return self._acc_matrix_body(params, x, y, feat_mask)
 
     def _acc_matrix_body(self, params, x, y, feat_mask):
@@ -381,8 +441,13 @@ class TrainStep:
         return correct, total, loss_sum
 
     # ------------------------------------------------------------------
-    @partial(jax.jit, static_argnums=0)
     def acc_cells(self, params, x, y, feat_mask):
+        """Tracked dispatch of ``_acc_cells_jit`` (see there)."""
+        self._note_signature("acc_cells", params, x, y, feat_mask)
+        return self._acc_cells_jit(params, x, y, feat_mask)
+
+    @partial(jax.jit, static_argnums=0)
+    def _acc_cells_jit(self, params, x, y, feat_mask):
         """Correct-prediction counts per (model, client, time step).
 
         x: [C, T1, N, ...] -> correct [M, C, T1]. Powers FedDrift's

@@ -1,0 +1,67 @@
+"""Run telemetry: structured events, cross-layer instruments, logging setup.
+
+The reference's only instrumentation is wandb scalar series plus one ad-hoc
+"aggregate time cost" print (SURVEY.md §5); our own early reproduction had a
+wall-clock ``PhaseTracer`` and a flat ``MetricsLogger`` and nothing else —
+the comm brokers, drift/cluster decisions, XLA compiles and injected faults
+were all invisible. This package is the missing observability layer:
+
+- ``obs.events``      — a process-local structured EVENT BUS. Typed events
+  (``kind`` from a closed taxonomy, ``_ts``, current iteration/round
+  context) are appended to ``events.jsonl`` next to ``metrics.jsonl``.
+  Layers emit through the module-level ``emit()``; background threads
+  (comm brokers) share the same bus safely.
+- ``obs.instruments`` — counters / gauges / histograms with
+  bounded-overhead recording and a Prometheus-textfile exporter, for
+  quantities that are too hot to be one-event-per-occurrence
+  (bytes on the comm path, per-phase latency histograms, compile counts).
+- ``obs.report``      — renders a human-readable run report from
+  ``events.jsonl`` + ``metrics.jsonl`` (CLI: ``python -m feddrift_tpu
+  report <run_dir>``).
+
+Event kinds are a CLOSED set (``events.EVENT_KINDS``): ``emit()`` rejects
+unknown kinds, and ``scripts/check_events_schema.py`` statically checks that
+every kind emitted anywhere in the package is documented in
+docs/OBSERVABILITY.md — new events cannot ship undocumented.
+
+See docs/OBSERVABILITY.md for the taxonomy and formats.
+"""
+
+from __future__ import annotations
+
+from feddrift_tpu.obs.events import (  # noqa: F401
+    EVENT_KINDS,
+    EventBus,
+    configure,
+    emit,
+    get_bus,
+    set_context,
+)
+from feddrift_tpu.obs.instruments import (  # noqa: F401
+    Registry,
+    registry,
+)
+
+_LOG_FORMAT = "%(asctime)s %(name)s %(levelname)s %(message)s"
+
+
+def setup_logging(level: str | int = "info") -> None:
+    """The single logging configuration path for the package.
+
+    Called from the CLI (``--log_level``) and usable from scripts; repeated
+    calls reconfigure (``force=True``) so tests and multi-run processes can
+    change verbosity. Configures the root handler AND pins the
+    ``feddrift_tpu`` logger level, so ``--log_level debug`` surfaces the
+    package's debug output without drowning in third-party debug noise
+    (third-party loggers stay at the root level only).
+    """
+    import logging
+
+    if isinstance(level, str):
+        lvl = getattr(logging, level.upper(), None)
+        if lvl is None:
+            raise ValueError(f"unknown log level {level!r}")
+    else:
+        lvl = level
+    logging.basicConfig(level=lvl, format=_LOG_FORMAT, force=True)
+    logging.getLogger("feddrift_tpu").setLevel(lvl)

@@ -1,0 +1,166 @@
+"""Process-local structured event bus.
+
+One event = one JSON object on one line of ``<run_dir>/events.jsonl``,
+written next to ``metrics.jsonl``. Every event carries:
+
+    _ts        float   unix seconds, stamped at emit time
+    kind       str     one of EVENT_KINDS (closed taxonomy, validated)
+    iteration  int     current time step, when set via set_context
+    round      int     current global round, when set via set_context
+    ...        any     kind-specific JSON-serializable fields
+
+The bus is process-local and shared: the runner configures the sink once
+per run (``configure(path)``), and every layer — including the comm
+brokers' background threads and the fault injector — emits through the
+module-level ``emit()``. Emission is thread-safe (one lock around the
+in-memory ring append and the file write) and bounded: the in-memory
+ring keeps the last ``RING_SIZE`` events for tests/diagnostics, the file
+is append-only.
+
+Unknown kinds raise ``ValueError`` at emit time, and
+``scripts/check_events_schema.py`` statically cross-checks the emitted
+kinds against docs/OBSERVABILITY.md — the two halves of the "no
+undocumented events" guarantee.
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import os
+import threading
+import time
+from typing import Any
+
+# ----------------------------------------------------------------------
+# The closed event taxonomy. Documented one-per-row in
+# docs/OBSERVABILITY.md; scripts/check_events_schema.py enforces the
+# code <-> docs correspondence.
+EVENT_KINDS = frozenset({
+    # run / iteration lifecycle (simulation/runner.py)
+    "run_start",            # config summary at Experiment construction
+    "run_end",              # end of Experiment.run
+    "iteration_start",      # time step begins
+    "iteration_end",        # time step done: wall s, examples/s, phase totals
+    "eval",                 # one eval point (round, Train/Test acc+loss)
+    "checkpoint_save",      # atomic checkpoint written
+    # XLA compile tracking (core/step.py)
+    "jit_compile",          # first time a program sees an argument signature
+    "jit_recompile",        # a NEW signature on an already-compiled program
+    # drift / cluster decisions (algorithms/*)
+    "drift_detected",       # per-client accuracy-drop trigger
+    "cluster_create",       # a pool slot is (re)allocated for a new cluster
+    "cluster_merge",        # hierarchical merge of two cluster models
+    "cluster_delete",       # a model is deleted / reset out of use
+    "cluster_split",        # CFL gradient bipartition fired
+    "cluster_state",        # per-iteration summary: models in use etc.
+    "model_replaced",       # ensemble rotation (AUE window, KUE worst model)
+    # comm transports (comm/netbroker.py, comm/mqtt.py)
+    "conn_drop",            # a broker connection closed / was cleaned up
+    "conn_wedged_drop",     # bounded outbound queue overflow -> force-drop
+    # fault injection / failure detection (platform/faults.py)
+    "fault_injected",       # injected dropout this round, with client mask
+    "client_killed",        # permanent kill
+    "client_revived",
+    "failure_suspected",    # detector's suspect set changed
+})
+
+RING_SIZE = 4096
+
+
+class EventBus:
+    """Appends typed events to an optional JSONL sink + an in-memory ring."""
+
+    def __init__(self, path: str | None = None) -> None:
+        self._lock = threading.Lock()
+        self._context: dict[str, Any] = {}
+        self.ring: collections.deque = collections.deque(maxlen=RING_SIZE)
+        self._fh = None
+        self.path = path
+        if path:
+            os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+            self._fh = open(path, "a")
+
+    # -- emission -------------------------------------------------------
+    def emit(self, kind: str, **fields: Any) -> dict:
+        """Record one event; returns the record (mostly for tests)."""
+        if kind not in EVENT_KINDS:
+            raise ValueError(
+                f"unknown event kind {kind!r}; add it to obs.events.EVENT_KINDS "
+                "and document it in docs/OBSERVABILITY.md")
+        with self._lock:
+            rec = {"_ts": time.time(), "kind": kind, **self._context, **fields}
+            self.ring.append(rec)
+            if self._fh is not None:
+                self._fh.write(json.dumps(rec, default=_json_default) + "\n")
+                self._fh.flush()
+        return rec
+
+    def set_context(self, **ctx: Any) -> None:
+        """Merge ambient fields (iteration=..., round=...) into every
+        subsequent event; a value of None removes the key."""
+        with self._lock:
+            for k, v in ctx.items():
+                if v is None:
+                    self._context.pop(k, None)
+                else:
+                    self._context[k] = v
+
+    # -- queries (tests / diagnostics) ---------------------------------
+    def events(self, kind: str | None = None) -> list[dict]:
+        with self._lock:
+            evs = list(self.ring)
+        return evs if kind is None else [e for e in evs if e["kind"] == kind]
+
+    # -- lifecycle ------------------------------------------------------
+    def close(self) -> None:
+        with self._lock:
+            if self._fh is not None:
+                self._fh.close()
+                self._fh = None
+
+    def __enter__(self) -> "EventBus":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+def _json_default(o):
+    """numpy scalars/arrays show up in event fields; store plain JSON."""
+    tolist = getattr(o, "tolist", None)
+    if tolist is not None:
+        return tolist()
+    return str(o)
+
+
+# ----------------------------------------------------------------------
+# The process-local default bus. Layers emit through these module-level
+# helpers so they need no handle on the Experiment; the runner re-points
+# the sink per run via configure().
+_bus = EventBus(None)
+_bus_lock = threading.Lock()
+
+
+def get_bus() -> EventBus:
+    return _bus
+
+
+def configure(path: str | None) -> EventBus:
+    """Install a fresh default bus writing to ``path`` (None = memory-only).
+
+    Closes the previous bus's sink. Returns the new bus.
+    """
+    global _bus
+    with _bus_lock:
+        old, _bus = _bus, EventBus(path)
+        old.close()
+    return _bus
+
+
+def emit(kind: str, **fields: Any) -> dict:
+    return _bus.emit(kind, **fields)
+
+
+def set_context(**ctx: Any) -> None:
+    _bus.set_context(**ctx)

@@ -1,0 +1,210 @@
+"""Counters, gauges, histograms with a Prometheus-textfile exporter.
+
+For quantities too hot to be one-event-per-occurrence (bytes on the comm
+path, per-phase latencies, compile counts) the event bus is the wrong
+tool; these instruments record in O(1) with a per-instrument lock and no
+allocation on the hot path:
+
+    reg = obs.registry()
+    reg.counter("comm_bytes_out", transport="netbroker").inc(len(frame))
+    reg.gauge("num_models").set(3)
+    reg.histogram("phase_seconds", phase="train_round").observe(dt)
+
+A time series is keyed by (name, sorted label pairs), Prometheus-style.
+``Registry.snapshot()`` returns a plain-dict snapshot (hooked into
+bench.py / scripts/scaling_bench.py so BENCH_*.json carry compile counts
+and phase histograms); ``Registry.to_prometheus_text()`` renders the
+node-exporter textfile-collector format and ``write_textfile(path)``
+writes it atomically for a textfile collector to scrape.
+
+Histograms use fixed cumulative buckets (Prometheus semantics: ``le``
+upper bounds, +Inf implicit) — recording is two integer increments and a
+float add, never sample retention, so overhead stays bounded regardless
+of run length. The default bounds span 100 µs .. 100 s, wide enough for
+both per-phase wall times and per-round latencies.
+"""
+
+from __future__ import annotations
+
+import bisect
+import os
+import threading
+from typing import Any
+
+DEFAULT_BUCKETS = (1e-4, 1e-3, 1e-2, 0.1, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0,
+                   100.0)
+
+
+class Counter:
+    """Monotonically increasing count."""
+
+    __slots__ = ("_lock", "value")
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self.value = 0.0
+
+    def inc(self, n: float = 1.0) -> None:
+        if n < 0:
+            raise ValueError("counters only go up")
+        with self._lock:
+            self.value += n
+
+
+class Gauge:
+    """A value that goes up and down."""
+
+    __slots__ = ("_lock", "value")
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self.value = 0.0
+
+    def set(self, v: float) -> None:
+        with self._lock:
+            self.value = float(v)
+
+    def inc(self, n: float = 1.0) -> None:
+        with self._lock:
+            self.value += n
+
+    def dec(self, n: float = 1.0) -> None:
+        self.inc(-n)
+
+
+class Histogram:
+    """Fixed-bucket histogram (cumulative ``le`` semantics on export)."""
+
+    __slots__ = ("_lock", "bounds", "bucket_counts", "count", "sum")
+
+    def __init__(self, buckets: tuple = DEFAULT_BUCKETS) -> None:
+        self._lock = threading.Lock()
+        self.bounds = tuple(sorted(float(b) for b in buckets))
+        self.bucket_counts = [0] * (len(self.bounds) + 1)   # last = +Inf
+        self.count = 0
+        self.sum = 0.0
+
+    def observe(self, v: float) -> None:
+        idx = bisect.bisect_left(self.bounds, v)
+        with self._lock:
+            self.bucket_counts[idx] += 1
+            self.count += 1
+            self.sum += v
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {"count": self.count, "sum": self.sum,
+                    "buckets": {("+Inf" if i == len(self.bounds)
+                                 else repr(self.bounds[i])): c
+                                for i, c in enumerate(self.bucket_counts)
+                                if c}}
+
+
+def _series_key(name: str, labels: dict[str, str]) -> tuple:
+    return (name, tuple(sorted(labels.items())))
+
+
+def _label_str(labels: tuple) -> str:
+    if not labels:
+        return ""
+    return "{" + ",".join(f'{k}="{v}"' for k, v in labels) + "}"
+
+
+class Registry:
+    """Process-local instrument registry, one time series per
+    (name, labels). Get-or-create accessors are idempotent and
+    type-checked: asking for an existing name with a different instrument
+    type is a programming error and raises."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._series: dict[tuple, Any] = {}
+
+    def _get(self, cls, name: str, labels: dict[str, str], **kw):
+        key = _series_key(name, labels)
+        with self._lock:
+            inst = self._series.get(key)
+            if inst is None:
+                inst = self._series[key] = cls(**kw)
+            elif not isinstance(inst, cls):
+                raise TypeError(
+                    f"instrument {name}{labels} already registered as "
+                    f"{type(inst).__name__}, not {cls.__name__}")
+            return inst
+
+    def counter(self, name: str, **labels: str) -> Counter:
+        return self._get(Counter, name, labels)
+
+    def gauge(self, name: str, **labels: str) -> Gauge:
+        return self._get(Gauge, name, labels)
+
+    def histogram(self, name: str, buckets: tuple = DEFAULT_BUCKETS,
+                  **labels: str) -> Histogram:
+        return self._get(Histogram, name, labels, buckets=buckets)
+
+    def reset(self) -> None:
+        """Drop every series (benchmarks reset between measurements so
+        snapshots are per-measurement, not cumulative)."""
+        with self._lock:
+            self._series.clear()
+
+    # -- export ---------------------------------------------------------
+    def snapshot(self) -> dict:
+        """{"name{label=...}": value-or-histogram-dict}, JSON-ready."""
+        with self._lock:
+            items = sorted(self._series.items())
+        out: dict[str, Any] = {}
+        for (name, labels), inst in items:
+            key = name + _label_str(labels)
+            if isinstance(inst, Histogram):
+                out[key] = inst.snapshot()
+            else:
+                out[key] = inst.value
+        return out
+
+    def to_prometheus_text(self) -> str:
+        """node-exporter textfile-collector format (untyped TYPE lines are
+        omitted for gauges/counters whose kind is in the name; histograms
+        render the standard _bucket/_sum/_count triplet)."""
+        with self._lock:
+            items = sorted(self._series.items())
+        lines: list[str] = []
+        typed: set[str] = set()
+        for (name, labels), inst in items:
+            if isinstance(inst, Histogram):
+                if name not in typed:
+                    lines.append(f"# TYPE {name} histogram")
+                    typed.add(name)
+                cum = 0
+                for i, bound in enumerate(inst.bounds):
+                    cum += inst.bucket_counts[i]
+                    ls = _label_str(labels + (("le", repr(bound)),))
+                    lines.append(f"{name}_bucket{ls} {cum}")
+                cum += inst.bucket_counts[-1]
+                ls = _label_str(labels + (("le", "+Inf"),))
+                lines.append(f"{name}_bucket{ls} {cum}")
+                lines.append(f"{name}_sum{_label_str(labels)} {inst.sum}")
+                lines.append(f"{name}_count{_label_str(labels)} {inst.count}")
+            else:
+                kind = "counter" if isinstance(inst, Counter) else "gauge"
+                if name not in typed:
+                    lines.append(f"# TYPE {name} {kind}")
+                    typed.add(name)
+                lines.append(f"{name}{_label_str(labels)} {inst.value}")
+        return "\n".join(lines) + ("\n" if lines else "")
+
+    def write_textfile(self, path: str) -> None:
+        """Atomic write (tmp + rename) — a textfile collector must never
+        read a half-written snapshot."""
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            f.write(self.to_prometheus_text())
+        os.replace(tmp, path)
+
+
+_registry = Registry()
+
+
+def registry() -> Registry:
+    return _registry

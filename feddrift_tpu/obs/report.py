@@ -1,0 +1,249 @@
+"""Render a human-readable run report from a run directory.
+
+Consumes ``events.jsonl`` (the structured event bus stream) plus
+``metrics.jsonl`` (the wandb-schema scalar series) and prints the view a
+BENCH/PARITY debugging session previously reconstructed by re-reading
+logs: phase breakdown, drift/cluster timeline, throughput, fault summary,
+final accuracy.
+
+    python -m feddrift_tpu report runs/sea-fnn-softcluster-H_A_C_1_10_0-s0
+    python -m feddrift_tpu report --json <run_dir>
+
+Runs that predate the telemetry subsystem (committed ``runs/*`` contain
+only ``metrics.jsonl``) degrade gracefully: the metrics-derived sections
+render, event-derived sections report their absence.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any
+
+# Event kinds rendered on the drift/cluster timeline, in one place so the
+# renderer and its tests agree.
+TIMELINE_KINDS = ("drift_detected", "cluster_create", "cluster_merge",
+                  "cluster_delete", "cluster_split", "model_replaced")
+FAULT_KINDS = ("fault_injected", "client_killed", "client_revived",
+               "failure_suspected")
+
+
+def _load_jsonl(path: str) -> list[dict]:
+    records = []
+    if not os.path.isfile(path):
+        return records
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                records.append(json.loads(line))
+            except json.JSONDecodeError:
+                continue                     # tolerate a torn tail line
+    return records
+
+
+def summarize(run_dir: str) -> dict[str, Any]:
+    """Machine-readable run summary (the --json output and the renderer's
+    single source)."""
+    events = _load_jsonl(os.path.join(run_dir, "events.jsonl"))
+    metrics = _load_jsonl(os.path.join(run_dir, "metrics.jsonl"))
+
+    out: dict[str, Any] = {
+        "run_dir": run_dir,
+        "has_events": bool(events),
+        "has_metrics": bool(metrics),
+    }
+
+    # -- accuracy trajectory (metrics.jsonl) ---------------------------
+    test = [(r.get("iteration", 0), r.get("round", 0), r["Test/Acc"])
+            for r in metrics if "Test/Acc" in r]
+    if test:
+        per_iter: dict[int, float] = {}
+        for it, _, acc in test:
+            per_iter[it] = acc
+        out["accuracy"] = {
+            "final_test_acc": test[-1][2],
+            "best_test_acc": max(a for _, _, a in test),
+            "iterations": len(per_iter),
+            "rounds": test[-1][1] + 1,
+            "per_iteration": [round(per_iter[k], 4) for k in sorted(per_iter)],
+        }
+
+    # -- phase breakdown + throughput (iteration_end events) -----------
+    ends = [e for e in events if e["kind"] == "iteration_end"]
+    phases: dict[str, dict[str, float]] = {}
+    for e in ends:
+        for name, s in (e.get("phases") or {}).items():
+            agg = phases.setdefault(name, {"total_s": 0.0, "count": 0})
+            agg["total_s"] += s.get("total_s", 0.0)
+            agg["count"] += s.get("count", 0)
+    if phases:
+        out["phases"] = {k: {"total_s": round(v["total_s"], 4),
+                             "count": int(v["count"])}
+                         for k, v in sorted(phases.items())}
+    if ends:
+        wall = sum(e.get("wall_s", 0.0) for e in ends)
+        examples = sum(e.get("examples", 0) for e in ends)
+        rounds = sum(e.get("rounds", 0) for e in ends)
+        out["throughput"] = {
+            "wall_s": round(wall, 3),
+            "rounds": rounds,
+            "rounds_per_s": round(rounds / wall, 3) if wall else None,
+            "examples_per_s": round(examples / wall, 1) if wall else None,
+        }
+    elif len(test) > 1 and metrics:
+        # metrics-only fallback: wall-clock between first/last logged rows
+        ts = [r["_ts"] for r in metrics if "_ts" in r]
+        if len(ts) > 1 and ts[-1] > ts[0]:
+            out["throughput"] = {
+                "wall_s": round(ts[-1] - ts[0], 3),
+                "rounds": test[-1][1] + 1,
+                "rounds_per_s": round((test[-1][1] + 1) / (ts[-1] - ts[0]), 3),
+                "examples_per_s": None,
+            }
+
+    # -- drift / cluster timeline --------------------------------------
+    timeline = [e for e in events if e["kind"] in TIMELINE_KINDS]
+    out["timeline"] = timeline
+    states = [e for e in events if e["kind"] == "cluster_state"]
+    if states:
+        out["model_count"] = {
+            "per_iteration": [(e.get("iteration"), e.get("num_models"))
+                              for e in states],
+            "final": states[-1].get("num_models"),
+        }
+
+    # -- faults ---------------------------------------------------------
+    faults = [e for e in events if e["kind"] in FAULT_KINDS]
+    if faults:
+        injected = [e for e in faults if e["kind"] == "fault_injected"]
+        dropped: set[int] = set()
+        for e in injected:
+            dropped.update(e.get("clients", []))
+        suspects = [e for e in faults if e["kind"] == "failure_suspected"]
+        out["faults"] = {
+            "injected_rounds": len(injected),
+            "clients_ever_dropped": sorted(dropped),
+            "kills": sum(1 for e in faults if e["kind"] == "client_killed"),
+            "last_suspected": (suspects[-1].get("clients") if suspects
+                               else []),
+        }
+
+    # -- compiles --------------------------------------------------------
+    compiles = [e for e in events if e["kind"] in ("jit_compile",
+                                                   "jit_recompile")]
+    if compiles:
+        by_fn: dict[str, dict[str, int]] = {}
+        for e in compiles:
+            d = by_fn.setdefault(e.get("fn", "?"),
+                                 {"compiles": 0, "recompiles": 0})
+            d["compiles" if e["kind"] == "jit_compile" else "recompiles"] += 1
+        out["compiles"] = by_fn
+
+    return out
+
+
+def _fmt_event(e: dict) -> str:
+    skip = {"_ts", "kind", "iteration", "round"}
+    detail = ", ".join(f"{k}={v}" for k, v in e.items() if k not in skip)
+    where = f"t={e.get('iteration', '?')}"
+    if "round" in e:
+        where += f" r={e['round']}"
+    return f"  {where:<12} {e['kind']:<16} {detail}"
+
+
+def render(summary: dict[str, Any]) -> str:
+    """The human-readable report, one section per telemetry dimension."""
+    L: list[str] = [f"run: {summary['run_dir']}"]
+
+    acc = summary.get("accuracy")
+    if acc:
+        L.append(f"  Test/Acc final={acc['final_test_acc']:.4f} "
+                 f"best={acc['best_test_acc']:.4f} "
+                 f"({acc['iterations']} iterations, {acc['rounds']} rounds)")
+        traj = ", ".join(f"{a:.3f}" for a in acc["per_iteration"])
+        L.append(f"  per-iteration: {traj}")
+    elif not summary.get("has_metrics"):
+        L.append("  (no metrics.jsonl)")
+
+    tp = summary.get("throughput")
+    L.append("")
+    L.append("throughput:")
+    if tp:
+        ex = (f", {tp['examples_per_s']} examples/s"
+              if tp.get("examples_per_s") else "")
+        L.append(f"  {tp['rounds']} rounds in {tp['wall_s']}s "
+                 f"= {tp['rounds_per_s']} rounds/s{ex}")
+    else:
+        L.append("  (unavailable — run predates events.jsonl)")
+
+    L.append("")
+    L.append("phase breakdown:")
+    phases = summary.get("phases")
+    if phases:
+        total = sum(v["total_s"] for v in phases.values()) or 1.0
+        for name, v in sorted(phases.items(), key=lambda kv: -kv[1]["total_s"]):
+            L.append(f"  {name:<14} {v['total_s']:>9.3f}s "
+                     f"({100 * v['total_s'] / total:5.1f}%)  n={v['count']}")
+    else:
+        L.append("  (unavailable — run predates events.jsonl)")
+
+    L.append("")
+    mc = summary.get("model_count")
+    timeline = summary.get("timeline") or []
+    L.append("drift/cluster timeline:")
+    if mc:
+        L.append(f"  models in use, final: {mc['final']}")
+    if timeline:
+        L.extend(_fmt_event(e) for e in timeline)
+    elif not mc:
+        L.append("  (no drift/cluster events recorded)")
+
+    faults = summary.get("faults")
+    L.append("")
+    L.append("faults:")
+    if faults:
+        L.append(f"  {faults['injected_rounds']} rounds with injected "
+                 f"dropout; clients ever dropped: "
+                 f"{faults['clients_ever_dropped']}; "
+                 f"kills: {faults['kills']}; "
+                 f"suspected now: {faults['last_suspected']}")
+    else:
+        L.append("  none recorded")
+
+    comp = summary.get("compiles")
+    if comp:
+        L.append("")
+        L.append("XLA programs:")
+        for fn, d in sorted(comp.items()):
+            L.append(f"  {fn:<24} compiles={d['compiles']} "
+                     f"recompiles={d['recompiles']}")
+    return "\n".join(L)
+
+
+def main(argv: list[str] | None = None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        prog="feddrift_tpu report",
+        description="render a run report from events.jsonl + metrics.jsonl")
+    ap.add_argument("run_dirs", nargs="+", help="run directories")
+    ap.add_argument("--json", action="store_true", help="machine-readable")
+    args = ap.parse_args(argv)
+
+    summaries = []
+    for d in args.run_dirs:
+        s = summarize(d)
+        if not s["has_metrics"] and not s["has_events"]:
+            print(f"{d}: no metrics.jsonl or events.jsonl found")
+            return 1
+        summaries.append(s)
+
+    if args.json:
+        print(json.dumps(summaries if len(summaries) > 1 else summaries[0],
+                         indent=2))
+        return 0
+    print("\n\n".join(render(s) for s in summaries))
+    return 0

@@ -27,6 +27,8 @@ from __future__ import annotations
 
 import numpy as np
 
+from feddrift_tpu import obs
+
 
 class FaultInjector:
     """Deterministic per-round client dropout masks.
@@ -47,9 +49,11 @@ class FaultInjector:
     def kill(self, client: int) -> None:
         """Permanently fail a client (process gone, not coming back)."""
         self.dead[client] = True
+        obs.emit("client_killed", client=int(client))
 
     def revive(self, client: int) -> None:
         self.dead[client] = False
+        obs.emit("client_revived", client=int(client))
 
     def mask(self, round_idx: int) -> np.ndarray:
         """[C] float32 0/1 participation mask for one global round."""
@@ -63,6 +67,15 @@ class FaultInjector:
         # client up (a quorum-of-one floor).
         if not up.any() and (~self.dead).any():
             up[np.argmax(~self.dead)] = True
+        # One event per round WITH injected transient faults (permanently
+        # dead clients are reported at kill() time, not every round): the
+        # affected client mask is the debugging payload.
+        transient = ~up & ~self.dead
+        if transient.any():
+            obs.emit("fault_injected", fault_round=int(round_idx),
+                     clients=np.nonzero(transient)[0].tolist())
+            obs.registry().counter("faults_injected").inc(
+                int(transient.sum()))
         return up.astype(np.float32)
 
     def masks(self, rounds) -> np.ndarray:
@@ -86,6 +99,7 @@ class FailureDetector:
         self.patience = patience
         self.absent_streak = np.zeros(num_clients, dtype=np.int64)
         self.rounds_seen = 0
+        self._last_suspected: tuple = ()
 
     def observe(self, participation: np.ndarray,
                 observed: np.ndarray | None = None) -> None:
@@ -98,10 +112,18 @@ class FailureDetector:
         part = np.asarray(participation).astype(bool)[: self.C]
         new_streak = np.where(part, 0, self.absent_streak + 1)
         if observed is not None:
-            obs = np.asarray(observed).astype(bool)[: self.C]
-            new_streak = np.where(obs, new_streak, self.absent_streak)
+            seen = np.asarray(observed).astype(bool)[: self.C]
+            new_streak = np.where(seen, new_streak, self.absent_streak)
         self.absent_streak = new_streak
         self.rounds_seen += 1
+        # Emit only on suspect-set CHANGE: per-round emission would make a
+        # long outage one event per round instead of one per transition.
+        now = tuple(self.suspected.tolist())
+        if now != self._last_suspected:
+            obs.emit("failure_suspected", clients=list(now),
+                     rounds_seen=self.rounds_seen)
+            obs.registry().gauge("suspected_clients").set(len(now))
+            self._last_suspected = now
 
     def observe_many(self, masks: np.ndarray) -> None:
         for row in np.asarray(masks):

@@ -28,6 +28,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from feddrift_tpu import obs
 from feddrift_tpu.algorithms import algorithm_class, make_algorithm
 from feddrift_tpu.comm import multihost
 from feddrift_tpu.config import ExperimentConfig
@@ -104,6 +105,13 @@ class Experiment:
         self.is_coordinator = multihost.is_coordinator()
         self.logger = MetricsLogger(out_dir if self.is_coordinator else None,
                                     use_wandb and self.is_coordinator)
+        # Structured event bus: events.jsonl next to metrics.jsonl. The bus
+        # is process-local so comm-broker threads and the fault injector
+        # reach it without a handle on this object.
+        import os
+        self.events = obs.configure(
+            os.path.join(out_dir, "events.jsonl")
+            if (out_dir and self.is_coordinator) else None)
         self.algo.bind(self.x, self.y, self.logger, self.C_pad)
         from feddrift_tpu.platform.faults import FailureDetector, FaultInjector
         self.fault_injector = (
@@ -116,7 +124,13 @@ class Experiment:
         self.global_round = 0
         self.start_iteration = 0
         self.out_dir = out_dir
-        self.tracer = PhaseTracer()
+        self.tracer = PhaseTracer(registry=obs.registry())
+        self.events.emit(
+            "run_start", dataset=cfg.dataset, model=cfg.model,
+            algo=cfg.concept_drift_algo, algo_arg=cfg.concept_drift_algo_arg,
+            clients=self.C_, num_models=self.pool.num_models,
+            comm_round=cfg.comm_round, train_iterations=cfg.train_iterations,
+            backend=jax.default_backend(), seed=cfg.seed)
         if cfg.debug_checks:
             from feddrift_tpu.utils.invariants import enable_nan_debugging
             enable_nan_debugging()
@@ -240,6 +254,10 @@ class Experiment:
                 metrics[f"Test/Acc-CL-{c}"] = float(tcorrect[c] / ttotal[c])
                 metrics[f"Plurality/CL-{c}"] = int(idx[c])
         self.logger.log(metrics)
+        self.events.emit("eval", round=self.global_round,
+                         test_acc=metrics["Test/Acc"],
+                         train_acc=metrics["Train/Acc"],
+                         test_loss=metrics["Test/Loss"])
         return metrics
 
     @property
@@ -260,6 +278,8 @@ class Experiment:
     def run_iteration(self, t: int) -> None:
         cfg = self.cfg
         t0 = time.time()
+        self.events.set_context(iteration=t, round=self.global_round)
+        self.events.emit("iteration_start")
         with self.tracer.phase("cluster"):   # drift detection / clustering
             self.algo.begin_iteration(t)
         if cfg.debug_checks:
@@ -288,11 +308,35 @@ class Experiment:
             self.algo.end_iteration(t)
         if self.cfg.checkpoint_every_iteration and self.out_dir:
             self.save_checkpoint(t)
+            self.events.emit("checkpoint_save", path=self.ckpt_path())
+        wall = time.time() - t0
         log.info("iteration %d done in %.1fs (Test/Acc=%.4f)", t,
-                 time.time() - t0, self.logger.last("Test/Acc", -1))
+                 wall, self.logger.last("Test/Acc", -1))
         self.tracer.log_summary(prefix=f"iter {t}: ")
         self.last_phase_summary = self.tracer.summary()
         self.tracer.reset()   # per-iteration deltas, not cumulative totals
+        # Round throughput in examples/s: every comm round each sampled
+        # client runs `epochs` local steps on one `batch_size` batch —
+        # client-examples, the FL-semantics unit (multiply by models for
+        # device examples: the pool trains M x C pairs).
+        B = min(cfg.batch_size, self.ds.samples_per_step)
+        examples = cfg.comm_round * cfg.epochs * B * \
+            min(cfg.client_num_per_round, self.C_)
+        self.events.emit(
+            "iteration_end", wall_s=round(wall, 4), rounds=cfg.comm_round,
+            examples=examples,
+            examples_per_s=round(examples / max(wall, 1e-9), 1),
+            rounds_per_s=round(cfg.comm_round / max(wall, 1e-9), 3),
+            test_acc=self.logger.last("Test/Acc"),
+            phases={k: {"total_s": round(v["total_s"], 4),
+                        "count": v["count"]}
+                    for k, v in self.last_phase_summary.items()})
+        if self.out_dir and self.is_coordinator:
+            # Prometheus textfile-collector snapshot, refreshed per
+            # iteration (atomic replace; scrape-safe).
+            import os
+            obs.registry().write_textfile(
+                os.path.join(self.out_dir, "metrics.prom"))
 
     def _client_masks(self, t: int, rounds) -> "np.ndarray | None":
         """[len(rounds), C_pad] 0/1 participation masks, or None when every
@@ -347,6 +391,7 @@ class Experiment:
         """Per-round host loop: algorithms that steer every round."""
         cfg = self.cfg
         for r in range(cfg.comm_round):
+            self.events.set_context(round=self.global_round)
             tw, sw, fm, lr_scale = self.algo.round_inputs(t, r)
             tw = self._pad_clients(tw)                  # phantom clients: w=0
             sw = self._pad_clients(sw, value=1.0)
@@ -468,8 +513,13 @@ class Experiment:
                              t + 1: corr_te[-1][:, :C] / tot})
 
     def run(self) -> MetricsLogger:
-        for t in range(self.start_iteration, self.cfg.train_iterations):
-            self.run_iteration(t)
+        # Context managers so a raising iteration cannot leak the JSONL
+        # handles; the in-memory history/ring stay readable after close.
+        with self.logger, self.events:
+            for t in range(self.start_iteration, self.cfg.train_iterations):
+                self.run_iteration(t)
+            self.events.emit("run_end", global_round=self.global_round,
+                             test_acc=self.logger.last("Test/Acc"))
         return self.logger
 
     # ------------------------------------------------------------------

@@ -4,8 +4,17 @@ The reference's system of record is wandb (Train/Acc, Test/Acc, Train/Loss,
 Test/Loss, per-client *-CL-{c}, Plurality/CL-{c}, summary num_models /
 local_models / Contribute/CL-{c} / Merge — see SURVEY.md §5). Here the same
 names flow to an in-memory history plus an optional JSONL file, so runs are
-diffable against reference wandb exports. wandb itself is attached if
-importable and enabled (zero-egress environments simply skip it).
+diffable against reference wandb exports.
+
+wandb attach semantics (``use_wandb=True``): if a ``wandb.run`` already
+exists it is mirrored into; otherwise a run is initialised here, in
+offline mode by default (``WANDB_MODE=offline`` unless the environment
+overrides it) so zero-egress environments record locally instead of
+hanging on network. Environments without wandb installed simply skip it.
+
+The logger is a context manager — ``with MetricsLogger(...) as lg`` —
+and ``close()`` is idempotent, so a crashing runner cannot leak the JSONL
+file handle.
 """
 
 from __future__ import annotations
@@ -26,12 +35,25 @@ class MetricsLogger:
             self._fh = open(os.path.join(out_dir, "metrics.jsonl"), "a")
         self._wandb = None
         if use_wandb:
+            self._wandb = self._attach_wandb(out_dir)
+
+    @staticmethod
+    def _attach_wandb(out_dir: str | None):
+        """Mirror into an existing wandb run, or initialise one (offline by
+        default). Returns the wandb module with a live run, or None."""
+        try:
+            import wandb  # type: ignore
+        except ImportError:
+            return None
+        if wandb.run is None:
             try:
-                import wandb  # type: ignore
-                if wandb.run is not None:
-                    self._wandb = wandb
-            except ImportError:
-                pass
+                os.environ.setdefault("WANDB_MODE", "offline")
+                wandb.init(project=os.environ.get("WANDB_PROJECT",
+                                                  "feddrift-tpu"),
+                           dir=out_dir or None)
+            except Exception:
+                return None          # init failure must never kill the run
+        return wandb if wandb.run is not None else None
 
     def log(self, metrics: dict[str, Any]) -> None:
         rec = {"_ts": time.time(), **metrics}
@@ -91,6 +113,13 @@ class MetricsLogger:
         self._fh = open(path, "a")
 
     def close(self) -> None:
+        """Idempotent: safe to call from both an exit path and __exit__."""
         if self._fh:
             self._fh.close()
             self._fh = None
+
+    def __enter__(self) -> "MetricsLogger":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

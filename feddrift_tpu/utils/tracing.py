@@ -16,12 +16,21 @@ Usage:
 
     with xla_trace("/tmp/trace"):   # TensorBoard-loadable XLA trace
         run_step()
+
+PhaseTracer is thread-safe (the comm brokers run background threads that
+may record phases) and nestable/re-entrant: each ``phase()`` entry keeps
+its own start time on the context-manager frame, so overlapping phases on
+one thread and concurrent phases across threads both accumulate
+correctly. Pass ``registry=obs.registry()`` to additionally record each
+phase duration into a ``phase_seconds{phase=...}`` histogram instrument
+(bench snapshots read those).
 """
 
 from __future__ import annotations
 
 import contextlib
 import logging
+import threading
 import time
 from collections import defaultdict
 from typing import Iterator
@@ -30,11 +39,14 @@ log = logging.getLogger("feddrift_tpu")
 
 
 class PhaseTracer:
-    """Accumulates wall-clock per named phase; nestable and re-entrant."""
+    """Accumulates wall-clock per named phase; nestable, re-entrant, and
+    thread-safe."""
 
-    def __init__(self) -> None:
+    def __init__(self, registry=None) -> None:
         self.totals: dict[str, float] = defaultdict(float)
         self.counts: dict[str, int] = defaultdict(int)
+        self._lock = threading.Lock()
+        self._registry = registry
 
     @contextlib.contextmanager
     def phase(self, name: str) -> Iterator[None]:
@@ -43,14 +55,19 @@ class PhaseTracer:
             yield
         finally:
             dt = time.perf_counter() - t0
-            self.totals[name] += dt
-            self.counts[name] += 1
+            with self._lock:
+                self.totals[name] += dt
+                self.counts[name] += 1
+            if self._registry is not None:
+                self._registry.histogram("phase_seconds",
+                                         phase=name).observe(dt)
 
     def summary(self) -> dict[str, dict[str, float]]:
-        return {name: {"total_s": self.totals[name],
-                       "count": self.counts[name],
-                       "mean_s": self.totals[name] / max(self.counts[name], 1)}
-                for name in self.totals}
+        with self._lock:
+            return {name: {"total_s": self.totals[name],
+                           "count": self.counts[name],
+                           "mean_s": self.totals[name] / max(self.counts[name], 1)}
+                    for name in self.totals}
 
     def log_summary(self, prefix: str = "") -> None:
         for name, s in sorted(self.summary().items()):
@@ -58,8 +75,9 @@ class PhaseTracer:
                      prefix, name, s["total_s"], s["mean_s"], s["count"])
 
     def reset(self) -> None:
-        self.totals.clear()
-        self.counts.clear()
+        with self._lock:
+            self.totals.clear()
+            self.counts.clear()
 
 
 @contextlib.contextmanager

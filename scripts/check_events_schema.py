@@ -1,0 +1,101 @@
+"""Static event-taxonomy check: emitted kinds <-> documented kinds.
+
+Three-way consistency pass, run by the tier-1 suite (tests/test_obs.py)
+and usable standalone:
+
+1. every ``emit("<kind>", ...)`` literal in ``feddrift_tpu/`` must be a
+   member of ``obs.events.EVENT_KINDS`` (the runtime also enforces this,
+   but only on the code paths a given run happens to execute);
+2. every member of ``EVENT_KINDS`` must appear as a ``| `kind` |`` row in
+   docs/OBSERVABILITY.md's taxonomy table;
+3. every kind documented in that table must still exist in
+   ``EVENT_KINDS`` (no stale docs).
+
+Together with ``emit()``'s runtime validation this makes it impossible to
+ship a new event kind that is undocumented, or documentation for an event
+that no longer exists.
+
+    python scripts/check_events_schema.py        # exit 0 = consistent
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+# emit("kind", ...) / .emit("kind", ...) with a string literal first arg
+_EMIT_RE = re.compile(r"""\bemit\(\s*\n?\s*["']([a-z_]+)["']""")
+# taxonomy rows: | `kind` | layer | ...
+_DOC_ROW_RE = re.compile(r"^\|\s*`([a-z_]+)`\s*\|", re.MULTILINE)
+
+
+def emitted_kinds(pkg_dir: str) -> dict[str, list[str]]:
+    """{kind: [file:line, ...]} for every emit() string literal."""
+    found: dict[str, list[str]] = {}
+    for dirpath, dirnames, filenames in os.walk(pkg_dir):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for fn in filenames:
+            if not fn.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fn)
+            with open(path, encoding="utf-8") as f:
+                text = f.read()
+            for m in _EMIT_RE.finditer(text):
+                line = text.count("\n", 0, m.start()) + 1
+                rel = os.path.relpath(path, ROOT)
+                found.setdefault(m.group(1), []).append(f"{rel}:{line}")
+    return found
+
+
+def documented_kinds(doc_path: str) -> set[str]:
+    with open(doc_path, encoding="utf-8") as f:
+        return set(_DOC_ROW_RE.findall(f.read()))
+
+
+def check() -> list[str]:
+    """Returns a list of problem strings; empty = consistent."""
+    from feddrift_tpu.obs.events import EVENT_KINDS
+
+    problems: list[str] = []
+    emitted = emitted_kinds(os.path.join(ROOT, "feddrift_tpu"))
+    doc = os.path.join(ROOT, "docs", "OBSERVABILITY.md")
+    if not os.path.isfile(doc):
+        return [f"missing taxonomy doc: {doc}"]
+    documented = documented_kinds(doc)
+
+    for kind, sites in sorted(emitted.items()):
+        if kind not in EVENT_KINDS:
+            problems.append(
+                f"emitted kind {kind!r} not in EVENT_KINDS ({sites[0]})")
+    for kind in sorted(EVENT_KINDS - documented):
+        problems.append(
+            f"kind {kind!r} in EVENT_KINDS but undocumented in "
+            "docs/OBSERVABILITY.md")
+    for kind in sorted(documented - EVENT_KINDS):
+        problems.append(
+            f"kind {kind!r} documented in docs/OBSERVABILITY.md but "
+            "missing from EVENT_KINDS (stale docs?)")
+    # sanity: the scan itself must see emission sites, otherwise a regex
+    # rot would make this check pass vacuously
+    if not emitted:
+        problems.append("scan found no emit() sites — checker regex broken?")
+    return problems
+
+
+def main() -> int:
+    problems = check()
+    for p in problems:
+        print(f"check_events_schema: {p}", file=sys.stderr)
+    if not problems:
+        print(f"check_events_schema: OK "
+              f"({len(emitted_kinds(os.path.join(ROOT, 'feddrift_tpu')))} "
+              "distinct kinds emitted, taxonomy consistent)")
+    return 1 if problems else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -75,6 +75,11 @@ def main() -> None:
         exp = Experiment(cfg, mesh=make_mesh(n_dev))
         exp.run_iteration(0)        # compile + cluster_init path
         exp.run_iteration(1)        # compile the steady-state path
+        from feddrift_tpu import obs
+        # per-mesh-size snapshot of the measured iterations only: a
+        # steady-state recompile at some client count is exactly the kind
+        # of cliff this bench exists to attribute
+        obs.registry().reset()
         phases: dict[str, float] = {}
         # drift-machinery events per measured iteration (spawns / merges /
         # linkage calls) — the host-side work whose data-dependent firing
@@ -115,6 +120,7 @@ def main() -> None:
                              for k in (events_per_iter[0] if events_per_iter else {})},
             "client_rounds_per_s": round(rounds * C / dt, 1),
             "final_test_acc": round(float(exp.logger.last("Test/Acc")), 4),
+            "instruments": obs.registry().snapshot(),
         }
         # floor-relative overhead of the train phase, against this pass's
         # own 1-device point (the reproducible form of SCALING_r04's rows)
