@@ -109,6 +109,10 @@ class DriftAlgorithm:
         self.y = y
         self.logger = logger
         self.C_pad = c_pad
+        # Belt-and-braces alongside the params-identity cache key: a rebind
+        # with a different dataset must never serve accuracies computed on
+        # the previous one.
+        self._acc_offer = None
 
     def offer_acc_matrix(self, params, offers: "dict[int, np.ndarray]") -> None:
         """Runner ride-along: the fused iteration program's final eval slot
@@ -124,8 +128,17 @@ class DriftAlgorithm:
         the pre-transform params to the post-transform object. The cache is
         keyed on that object's identity — any pool mutation rebinds
         ``pool.params`` and silently invalidates it, so correctness never
-        depends on the cache hitting."""
-        self._acc_offer = (params, dict(offers))
+        depends on the cache hitting.
+
+        Offered matrices are frozen (read-only) because a cache hit hands
+        the SAME ndarray to every consumer; an in-place edit by one would
+        silently corrupt every later cluster decision this iteration."""
+        frozen = {}
+        for t, arr in offers.items():
+            arr = np.asarray(arr)
+            arr.setflags(write=False)
+            frozen[t] = arr
+        self._acc_offer = (params, frozen)
 
     def acc_matrix_at(self, t: int, feat_mask=None) -> np.ndarray:
         """[M, C] accuracy of every model on every client's step-t data

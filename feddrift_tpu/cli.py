@@ -56,6 +56,11 @@ def _add_run_args(p: argparse.ArgumentParser) -> None:
                    help="force a JAX platform (e.g. 'cpu'); must be applied "
                         "before backend init, which env vars can't do when "
                         "jax was pre-imported (tests/conftest.py note)")
+    p.add_argument("--auto_resume", action="store_true",
+                   help="if the run dir already holds a checkpoint (ckpt/ or "
+                        "ckpt.old/), resume from it instead of clobbering — "
+                        "the restart half of preemption handling "
+                        "(docs/RESILIENCE.md); a no-op on a fresh dir")
     _add_multihost_args(p)
 
 
@@ -165,12 +170,18 @@ def main(argv: list[str] | None = None) -> int:
                 cfg.out_dir,
                 f"{cfg.dataset}-{cfg.model}-{cfg.concept_drift_algo}"
                 f"-{cfg.concept_drift_algo_arg}-s{cfg.seed}")
-        exp = Experiment(cfg, use_wandb=args.wandb, out_dir=out_dir)
+        ckpt = os.path.join(out_dir, "ckpt")
+        if (getattr(args, "auto_resume", False)
+                and (os.path.isdir(ckpt) or os.path.isdir(ckpt + ".old"))):
+            exp = Experiment.resume(cfg, out_dir, use_wandb=args.wandb)
+        else:
+            exp = Experiment(cfg, use_wandb=args.wandb, out_dir=out_dir)
 
     exp.run()
     print(json.dumps({"Test/Acc": exp.logger.last("Test/Acc"),
                       "Train/Acc": exp.logger.last("Train/Acc"),
-                      "rounds": exp.global_round}))
+                      "rounds": exp.global_round,
+                      "preempted": exp.preempted}))
     return 0
 
 

@@ -228,7 +228,9 @@ class MqttBrokerClient:
     Works against ``MqttBroker`` or any compliant MQTT 3.1.1 broker."""
 
     def __init__(self, host: str, port: int, client_id: str = "",
-                 timeout: float = 10.0) -> None:
+                 timeout: float = 10.0, on_disconnect=None) -> None:
+        self._closed = False
+        self.on_disconnect = on_disconnect   # fires once on UNEXPECTED death
         self._sock = socket.create_connection((host, port), timeout=timeout)
         # clear the connect timeout BEFORE the reader starts: an inherited
         # per-socket timeout would make the reader's first long idle recv
@@ -299,10 +301,16 @@ class MqttBrokerClient:
             # struct.error: truncated PUBLISH body — treat like a closed
             # socket rather than silently killing only the reader thread
             pass
+        finally:
+            cb = self.on_disconnect
+            if (cb is not None and not self._closed
+                    and self._connack.is_set() and not self._connack_code):
+                cb()                        # established session died, not
+                                            # close() nor a refused CONNECT
 
     # -- Broker interface ----------------------------------------------
-    def subscribe(self, topic: str) -> queue.Queue:
-        q: queue.Queue = queue.Queue()
+    def subscribe(self, topic: str, sink: "queue.Queue | None" = None) -> queue.Queue:
+        q: queue.Queue = sink if sink is not None else queue.Queue()
         with self._qlock:
             first = not self._queues[topic]
             self._queues[topic].append(q)
@@ -329,6 +337,7 @@ class MqttBrokerClient:
         self._send(make_packet(PINGREQ, 0, b""))
 
     def close(self) -> None:
+        self._closed = True                 # suppress on_disconnect
         try:
             self._send(make_packet(DISCONNECT, 0, b""))
         except OSError:

@@ -13,8 +13,17 @@ MQTT 3.1.1 wire framing see `comm/mqtt.py`, which shares this module's
 broker lifecycle):
 
     client -> broker:  {"op": "sub"|"unsub", "topic": str}
-                       {"op": "pub", "topic": str, "payload": str}
+                       {"op": "pub", "topic": str, "payload": str[, "seq": int]}
     broker -> client:  {"topic": str, "payload": str}
+                       {"op": "puback", "seq": int}
+
+A publish carrying a ``seq`` is acknowledged with a ``puback`` after the
+broker routes it; publishes without one are fire-and-forget (the original
+wire, still accepted). The client tracks unacked sequence numbers
+(``unacked()``/``resend()``) so a retry layer (resilience/reconnect.py) can
+re-send publishes the broker never processed — lost on the wire, dropped by
+an injected chaos policy (resilience/chaos.py via ``NetworkBroker(chaos=...)``),
+or swallowed by a broker crash.
 
 This is control-plane transport only: array state rides XLA collectives
 (comm/multihost.py); like the reference's MQTT path, this exists for
@@ -86,6 +95,13 @@ class TcpFanoutServer:
                 return                      # server socket closed
             outq: queue.Queue = queue.Queue(maxsize=self.OUT_QUEUE_DEPTH)
             with self._lock:
+                if self._closed:
+                    # handshake raced close(): the kernel completed it while
+                    # close() was tearing down — without this check the late
+                    # conn would be fully serviced by a zombie broker that
+                    # close()'s kill sweep (same lock) can no longer see
+                    self._kill(conn)
+                    return
                 self._conns.add(conn)
                 self._out[conn] = outq
             obs.registry().counter(
@@ -160,19 +176,33 @@ class TcpFanoutServer:
         raise NotImplementedError
 
     def close(self) -> None:
-        self._closed = True
+        with self._lock:
+            # _closed is set under the lock so the accept loop's late-conn
+            # check and this kill sweep cannot both miss a racing handshake
+            self._closed = True
+            conns = list(self._conns)
         try:
             self._srv.close()
         except OSError:
             pass
-        with self._lock:
-            conns = list(self._conns)
         for c in conns:                     # unblock blocked reads/writes
             self._kill(c)
 
 
 class NetworkBroker(TcpFanoutServer):
-    """The NDJSON broker: accepts clients, routes topic publishes."""
+    """The NDJSON broker: accepts clients, routes topic publishes.
+
+    ``chaos`` (optional): a ``resilience.chaos.ChaosPolicy`` (or anything
+    with its ``draw(topic) -> (copies, delay_s)`` contract) consulted once
+    per publish at the routing point. A dropped message is neither routed
+    nor acked — to the publisher it is indistinguishable from wire loss,
+    which is exactly what makes publish-retry paths testable.
+    """
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 chaos=None) -> None:
+        self._chaos = chaos
+        super().__init__(host, port)
 
     def _handle(self, conn: socket.socket, f) -> None:
         reg = obs.registry()
@@ -195,25 +225,68 @@ class NetworkBroker(TcpFanoutServer):
                     if conn in self._subs.get(topic, ()):
                         self._subs[topic].remove(conn)
             elif op == "pub":
-                frame = (json.dumps({"topic": topic,
-                                     "payload": d.get("payload", "")})
-                         + "\n").encode()
-                with self._lock:
-                    targets = list(self._subs.get(topic, ()))
-                for c in targets:
-                    self._enqueue(c, frame)
+                copies, delay = (self._chaos.draw(topic)
+                                 if self._chaos is not None else (1, 0.0))
+                if copies == 0:
+                    continue                # dropped: no route, no ack
+                if delay > 0:
+                    t = threading.Timer(
+                        delay, self._route_and_ack,
+                        (conn, topic, d.get("payload", ""),
+                         d.get("seq"), copies))
+                    t.daemon = True
+                    t.start()
+                    continue
+                self._route_and_ack(conn, topic, d.get("payload", ""),
+                                    d.get("seq"), copies)
+
+    def _route_and_ack(self, conn: socket.socket, topic: str, payload: str,
+                       seq, copies: int = 1) -> None:
+        frame = (json.dumps({"topic": topic, "payload": payload})
+                 + "\n").encode()
+        with self._lock:
+            targets = list(self._subs.get(topic, ()))
+        for _ in range(copies):
+            for c in targets:
+                self._enqueue(c, frame)
+        if seq is not None:                 # acked publish: confirm routing
+            self._enqueue(conn, (json.dumps({"op": "puback", "seq": seq})
+                                 + "\n").encode())
 
 
 class NetworkBrokerClient:
     """Client-side endpoint exposing the in-process ``Broker`` interface
-    (pubsub.Broker): subscribe(topic) -> Queue, publish, unsubscribe."""
+    (pubsub.Broker): subscribe(topic) -> Queue, publish, unsubscribe.
 
-    def __init__(self, host: str, port: int, timeout: float = 10.0) -> None:
+    Resilience hooks (consumed by ``resilience.reconnect``):
+
+    - publishes carry a sequence number the broker acks after routing;
+      ``unacked()`` lists still-unconfirmed seqs and ``resend(seq)``
+      re-sends one (bounded tracking: oldest entries beyond
+      ``PENDING_MAX`` are evicted).
+    - ``on_disconnect`` (callable) fires exactly once when the read loop
+      dies with the session NOT explicitly closed — the broker crashed or
+      the link broke. A clean ``close()`` never fires it.
+
+    A bare client still fails fast — publish raises ``OSError`` into the
+    caller once the socket is dead. Auto-reconnect, subscription replay and
+    publish retry live one layer up in
+    ``resilience.reconnect.ReconnectingBrokerClient``.
+    """
+
+    PENDING_MAX = 512      # unacked publishes tracked before oldest evicted
+
+    def __init__(self, host: str, port: int, timeout: float = 10.0,
+                 on_disconnect=None) -> None:
         self._sock = socket.create_connection((host, port), timeout=timeout)
         self._sock.settimeout(None)
         self._wlock = threading.Lock()
         self._queues: dict[str, list[queue.Queue]] = defaultdict(list)
         self._qlock = threading.Lock()
+        self._seq = 0
+        self._pending: dict[int, tuple[str, str]] = {}   # seq -> (topic, payload)
+        self._closed = False
+        self.on_disconnect = on_disconnect
         self._reader = threading.Thread(target=self._read_loop, daemon=True)
         self._reader.start()
 
@@ -238,20 +311,30 @@ class NetworkBrokerClient:
                     d = json.loads(line)
                 except json.JSONDecodeError:
                     continue
+                if d.get("op") == "puback":
+                    with self._qlock:
+                        self._pending.pop(d.get("seq"), None)
+                    continue
                 with self._qlock:
                     qs = list(self._queues.get(d.get("topic"), ()))
                 for q in qs:
                     q.put(d.get("payload", ""))
         except (OSError, ValueError):
             pass                            # socket closed
+        finally:
+            cb = self.on_disconnect
+            if cb is not None and not self._closed:
+                cb()                        # unexpected death, not close()
     # -- Broker interface ----------------------------------------------
     # sub/unsub hold _qlock ACROSS the state change and the frame write:
     # releasing between them would let a racing subscribe/unsubscribe pair
     # reorder their frames and leave the broker unsubscribed while a live
     # local queue exists. Lock order is always _qlock -> _wlock; the read
     # loop takes only _qlock, so no cycle.
-    def subscribe(self, topic: str) -> queue.Queue:
-        q: queue.Queue = queue.Queue()
+    def subscribe(self, topic: str, sink: "queue.Queue | None" = None) -> queue.Queue:
+        """Subscribe; ``sink`` lets a reconnect layer re-attach a stable
+        caller-held queue to a fresh session instead of getting a new one."""
+        q: queue.Queue = sink if sink is not None else queue.Queue()
         with self._qlock:
             first = not self._queues[topic]
             self._queues[topic].append(q)
@@ -259,8 +342,36 @@ class NetworkBrokerClient:
                 self._send({"op": "sub", "topic": topic})
         return q
 
-    def publish(self, topic: str, payload: str) -> None:
-        self._send({"op": "pub", "topic": topic, "payload": payload})
+    def publish(self, topic: str, payload: str) -> int:
+        """Acked publish; returns the sequence number being tracked."""
+        with self._qlock:
+            self._seq += 1
+            seq = self._seq
+            self._pending[seq] = (topic, payload)
+            while len(self._pending) > self.PENDING_MAX:
+                self._pending.pop(next(iter(self._pending)))
+        try:
+            self._send({"op": "pub", "topic": topic,
+                        "payload": payload, "seq": seq})
+        except OSError:
+            # keep the pending entry: a retry layer resends it on reconnect
+            raise
+        return seq
+
+    def unacked(self) -> "dict[int, tuple[str, str]]":
+        """{seq: (topic, payload)} of publishes the broker has not acked."""
+        with self._qlock:
+            return dict(self._pending)
+
+    def resend(self, seq: int) -> bool:
+        """Re-send one still-pending publish (same seq). False if acked."""
+        with self._qlock:
+            entry = self._pending.get(seq)
+        if entry is None:
+            return False
+        self._send({"op": "pub", "topic": entry[0],
+                    "payload": entry[1], "seq": seq})
+        return True
 
     def unsubscribe(self, topic: str, q: queue.Queue) -> None:
         with self._qlock:
@@ -274,6 +385,7 @@ class NetworkBrokerClient:
                 except OSError:
                     pass                    # broker already gone
     def close(self) -> None:
+        self._closed = True                 # suppress on_disconnect
         try:
             self._sock.close()
         except OSError:

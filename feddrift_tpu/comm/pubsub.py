@@ -53,8 +53,11 @@ class Broker:
         self._subs: dict[str, list[queue.Queue]] = defaultdict(list)
         self._lock = threading.Lock()
 
-    def subscribe(self, topic: str) -> queue.Queue:
-        q: queue.Queue = queue.Queue()
+    def subscribe(self, topic: str, sink: Optional[queue.Queue] = None) -> queue.Queue:
+        """``sink``: optionally reuse a caller-held queue (the resilience
+        wrappers re-attach stable queues across sessions; every Broker
+        implementation accepts it)."""
+        q: queue.Queue = sink if sink is not None else queue.Queue()
         with self._lock:
             self._subs[topic].append(q)
         return q

@@ -114,11 +114,29 @@ class ExperimentConfig:
     # kill()-based permanent-failure / elastic-membership experiments.
     fault_enabled: bool = False
 
+    # --- resilience (feddrift_tpu/resilience/; docs/RESILIENCE.md) -------
+    # SIGTERM/SIGINT -> checkpoint at the next iteration boundary + clean
+    # exit (preemptible TPU VMs). Main-thread only; harmless elsewhere.
+    preempt_signals: bool = True
+    # Numeric divergence guard: NaN/Inf or loss-spike detection on the
+    # fetched round losses, rollback to pre-round params, abort after
+    # divergence_max_rollbacks CONSECUTIVE rollbacks. The guard never
+    # alters a healthy trajectory — it only adds a small per-round host
+    # fetch on the per-round execution path.
+    divergence_guard: bool = True
+    divergence_spike_factor: float = 10.0  # x window-peak loss that counts as a spike
+    divergence_max_rollbacks: int = 3      # consecutive rollbacks before abort
+    divergence_warmup_rounds: int = 5      # healthy rounds before spike arms
+
     def __post_init__(self) -> None:
         if self.client_num_per_round > self.client_num_in_total:
             raise ValueError("client_num_per_round > client_num_in_total")
         if self.time_stretch < 1:
             raise ValueError("time_stretch must be >= 1")
+        if self.divergence_spike_factor <= 1.0:
+            raise ValueError("divergence_spike_factor must be > 1")
+        if self.divergence_max_rollbacks < 1:
+            raise ValueError("divergence_max_rollbacks must be >= 1")
 
     # ------------------------------------------------------------------
     @property

@@ -58,6 +58,14 @@ EVENT_KINDS = frozenset({
     # comm transports (comm/netbroker.py, comm/mqtt.py)
     "conn_drop",            # a broker connection closed / was cleaned up
     "conn_wedged_drop",     # bounded outbound queue overflow -> force-drop
+    # resilience layer (feddrift_tpu/resilience/)
+    "conn_reconnect",       # reconnecting client re-established its session
+    "publish_retry",        # unacked/unsent publish re-sent
+    "heartbeat_missed",     # liveness loopback silent past the timeout
+    "chaos_injected",       # chaos policy dropped/delayed/duplicated a message
+    "preempt_checkpoint",   # SIGTERM/SIGINT -> checkpointed at iteration boundary
+    "divergence_detected",  # NaN/Inf or loss spike -> params rolled back
+    "checkpoint_corrupt",   # checksum/deserialization failure in a generation
     # fault injection / failure detection (platform/faults.py)
     "fault_injected",       # injected dropout this round, with client mask
     "client_killed",        # permanent kill

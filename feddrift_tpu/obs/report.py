@@ -26,6 +26,9 @@ TIMELINE_KINDS = ("drift_detected", "cluster_create", "cluster_merge",
                   "cluster_delete", "cluster_split", "model_replaced")
 FAULT_KINDS = ("fault_injected", "client_killed", "client_revived",
                "failure_suspected")
+RESILIENCE_KINDS = ("conn_reconnect", "publish_retry", "heartbeat_missed",
+                    "chaos_injected", "preempt_checkpoint",
+                    "divergence_detected", "checkpoint_corrupt")
 
 
 def _load_jsonl(path: str) -> list[dict]:
@@ -131,6 +134,22 @@ def summarize(run_dir: str) -> dict[str, Any]:
                                else []),
         }
 
+    # -- resilience ------------------------------------------------------
+    # transport healing / preemption / divergence / checkpoint integrity
+    # (feddrift_tpu/resilience/, docs/RESILIENCE.md)
+    res_counts = {k: sum(1 for e in events if e["kind"] == k)
+                  for k in RESILIENCE_KINDS}
+    if any(res_counts.values()):
+        res: dict[str, Any] = {k: v for k, v in res_counts.items() if v}
+        div = [e for e in events if e["kind"] == "divergence_detected"]
+        if div:
+            res["divergence_reasons"] = sorted(
+                {e.get("reason", "?") for e in div})
+        pre = [e for e in events if e["kind"] == "preempt_checkpoint"]
+        if pre:
+            res["preempted_at_iteration"] = pre[-1].get("iteration")
+        out["resilience"] = res
+
     # -- compiles --------------------------------------------------------
     compiles = [e for e in events if e["kind"] in ("jit_compile",
                                                    "jit_recompile")]
@@ -212,6 +231,19 @@ def render(summary: dict[str, Any]) -> str:
                  f"suspected now: {faults['last_suspected']}")
     else:
         L.append("  none recorded")
+
+    res = summary.get("resilience")
+    if res:
+        L.append("")
+        L.append("resilience:")
+        counts = ", ".join(f"{k}={v}" for k, v in sorted(res.items())
+                           if k in RESILIENCE_KINDS)
+        L.append(f"  {counts}")
+        if "divergence_reasons" in res:
+            L.append(f"  divergence reasons: {res['divergence_reasons']}")
+        if "preempted_at_iteration" in res:
+            L.append(f"  preempted at iteration "
+                     f"{res['preempted_at_iteration']} (resumable)")
 
     comp = summary.get("compiles")
     if comp:

@@ -45,6 +45,21 @@ class FaultInjector:
         self.p = dropout_prob
         self.seed = seed
         self.dead = np.zeros(num_clients, dtype=bool)   # permanent failures
+        self._outages: list[tuple[int, int, np.ndarray]] = []
+
+    def schedule_outage(self, start_round: int, end_round: int,
+                        clients) -> None:
+        """Deterministic planned outage: the listed clients fail every
+        round in ``[start_round, end_round)`` — correlated-failure modeling
+        (an AZ outage, broker maintenance, a preempted host taking several
+        clients down together) for chaos experiments, where independent
+        per-client dropout is the wrong failure shape. Composes with the
+        random transient dropout and with permanent kills; the quorum
+        floor still applies."""
+        if end_round <= start_round:
+            raise ValueError("end_round must be > start_round")
+        self._outages.append((int(start_round), int(end_round),
+                              np.asarray(clients, dtype=int)))
 
     def kill(self, client: int) -> None:
         """Permanently fail a client (process gone, not coming back)."""
@@ -62,6 +77,9 @@ class FaultInjector:
             rng = np.random.RandomState((self.seed * 1_000_003 + round_idx)
                                         % (2 ** 31 - 1))
             up = up & (rng.random_sample(self.C) >= self.p)
+        for start, end, clients in self._outages:
+            if start <= round_idx < end:
+                up[clients] = False
         # Never fail every client at once: if all drop, the round would be a
         # no-op that still advances RNG state; keep the lowest-index live
         # client up (a quorum-of-one floor).

@@ -1,0 +1,34 @@
+"""Resilience layer: chaos-tested transport, preemption-safe runs,
+divergence rollback.
+
+Three failure domains, each injectable, survivable and visible through the
+event bus (`feddrift_tpu/obs/`):
+
+- **transport** (`retry`, `chaos`, `reconnect`): a shared ``RetryPolicy``
+  (exponential backoff + jitter + deadline), a seeded ``ChaosPolicy`` /
+  ``ChaosBroker`` that deterministically drops/delays/duplicates/
+  partitions pub/sub messages, and ``ReconnectingBrokerClient`` — auto
+  reconnect, subscription replay, bounded publish retry, heartbeat
+  liveness — over any Broker-interface session factory.
+- **process** (`preempt`): ``PreemptionHandler`` turns SIGTERM/SIGINT
+  into checkpoint-at-iteration-boundary + clean exit; paired with the
+  checksummed checkpoint store (`utils/checkpoint.py`) and the CLI's
+  ``--auto_resume``.
+- **numeric** (`divergence`): ``DivergenceGuard`` — NaN/Inf and
+  loss-spike detection on the fetched round losses, rollback to the
+  pre-round pool params, abort after K consecutive rollbacks.
+
+Event kinds emitted here: ``conn_reconnect``, ``publish_retry``,
+``heartbeat_missed``, ``chaos_injected``, ``preempt_checkpoint``,
+``divergence_detected`` (plus ``checkpoint_corrupt`` from the checkpoint
+store). See docs/RESILIENCE.md for the operator runbook.
+"""
+
+from feddrift_tpu.resilience.chaos import ChaosBroker, ChaosPolicy  # noqa: F401
+from feddrift_tpu.resilience.divergence import (  # noqa: F401
+    DivergenceError,
+    DivergenceGuard,
+)
+from feddrift_tpu.resilience.preempt import PreemptionHandler  # noqa: F401
+from feddrift_tpu.resilience.reconnect import ReconnectingBrokerClient  # noqa: F401
+from feddrift_tpu.resilience.retry import RetryPolicy  # noqa: F401

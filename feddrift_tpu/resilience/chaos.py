@@ -1,0 +1,134 @@
+"""Deterministic message-level chaos for the pub/sub transports.
+
+Two composable pieces:
+
+- ``ChaosPolicy`` — a seeded per-message decision source: for each publish
+  it draws (copies, delay_s) where copies 0 = dropped, 2 = duplicated, and
+  records a ``chaos_injected`` event for every non-default outcome. It also
+  holds the partition set: publishes to partitioned topics are blackholed
+  until ``heal()``. The policy is transport-agnostic; ``NetworkBroker``
+  accepts one directly (``NetworkBroker(chaos=policy)``) and applies it at
+  the routing point, *before* the publish ack — so a dropped message looks
+  to the publisher exactly like a message lost on the wire: no ack, retry
+  fires (reconnect.py).
+
+- ``ChaosBroker`` — a wrapper implementing the in-process ``Broker``
+  interface (`comm/pubsub.py:48`: subscribe/publish/unsubscribe) around any
+  other Broker-interface object (in-process ``Broker``, a
+  ``NetworkBrokerClient``, a reconnecting client). Chaos is applied on the
+  publish path; subscriptions pass through untouched.
+
+Everything is seeded: the same (seed, message sequence) produces the same
+drops/delays/duplicates, so chaos e2e tests are reproducible.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+from typing import Iterable, Optional
+
+from feddrift_tpu import obs
+
+
+class ChaosPolicy:
+    """Seeded drop/delay/duplicate/partition decisions, one per publish."""
+
+    def __init__(self, *, seed: int = 0, drop_prob: float = 0.0,
+                 dup_prob: float = 0.0, delay_prob: float = 0.0,
+                 delay_s: float = 0.05, transport: str = "chaos") -> None:
+        for name, p in (("drop_prob", drop_prob), ("dup_prob", dup_prob),
+                        ("delay_prob", delay_prob)):
+            if not 0.0 <= p <= 1.0:
+                raise ValueError(f"{name} must be in [0, 1], got {p}")
+        self.drop_prob = drop_prob
+        self.dup_prob = dup_prob
+        self.delay_prob = delay_prob
+        self.delay_s = delay_s
+        self.transport = transport
+        self._rng = random.Random(seed)
+        self._partitioned: set[str] = set()
+        self._lock = threading.Lock()
+        self.counts = {"drop": 0, "dup": 0, "delay": 0, "partition": 0}
+
+    # -- partitions -----------------------------------------------------
+    def partition(self, topics: Iterable[str]) -> None:
+        """Blackhole publishes to ``topics`` until heal()."""
+        with self._lock:
+            self._partitioned.update(topics)
+
+    def heal(self, topics: Optional[Iterable[str]] = None) -> None:
+        with self._lock:
+            if topics is None:
+                self._partitioned.clear()
+            else:
+                self._partitioned.difference_update(topics)
+
+    # -- per-message decision ------------------------------------------
+    def draw(self, topic: str) -> tuple[int, float]:
+        """(copies, delay_s) for one publish; emits chaos_injected when the
+        outcome differs from plain immediate single delivery."""
+        with self._lock:
+            if topic in self._partitioned:
+                self.counts["partition"] += 1
+                action, copies, delay = "partition", 0, 0.0
+            else:
+                r = self._rng.random()
+                if r < self.drop_prob:
+                    self.counts["drop"] += 1
+                    action, copies, delay = "drop", 0, 0.0
+                elif r < self.drop_prob + self.dup_prob:
+                    self.counts["dup"] += 1
+                    action, copies, delay = "dup", 2, 0.0
+                elif r < self.drop_prob + self.dup_prob + self.delay_prob:
+                    self.counts["delay"] += 1
+                    action, copies, delay = "delay", 1, self.delay_s
+                else:
+                    return 1, 0.0
+        obs.emit("chaos_injected", action=action, topic=topic,
+                 transport=self.transport)
+        obs.registry().counter("chaos_injections", action=action,
+                               transport=self.transport).inc()
+        return copies, delay
+
+
+class ChaosBroker:
+    """Broker-interface wrapper applying a ChaosPolicy on the publish path.
+
+    Wraps anything with the ``Broker`` contract (`comm/pubsub.py:48`) —
+    the in-process broker, a network client, or a reconnecting client —
+    so the same manager/message stack runs under injected faults.
+    """
+
+    def __init__(self, inner, policy: Optional[ChaosPolicy] = None,
+                 **policy_kw) -> None:
+        self.inner = inner
+        self.policy = policy if policy is not None else ChaosPolicy(**policy_kw)
+
+    def subscribe(self, topic: str, sink=None):
+        if sink is not None:
+            return self.inner.subscribe(topic, sink=sink)
+        return self.inner.subscribe(topic)
+
+    def publish(self, topic: str, payload: str) -> None:
+        copies, delay = self.policy.draw(topic)
+        if copies == 0:
+            return
+        if delay > 0:
+            t = threading.Timer(delay, self._deliver, (topic, payload, copies))
+            t.daemon = True
+            t.start()
+            return
+        self._deliver(topic, payload, copies)
+
+    def _deliver(self, topic: str, payload: str, copies: int) -> None:
+        for _ in range(copies):
+            self.inner.publish(topic, payload)
+
+    def unsubscribe(self, topic: str, q) -> None:
+        self.inner.unsubscribe(topic, q)
+
+    def close(self) -> None:
+        close = getattr(self.inner, "close", None)
+        if close is not None:
+            close()

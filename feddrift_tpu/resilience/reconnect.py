@@ -1,0 +1,379 @@
+"""Self-healing Broker-interface client: reconnect, replay, retry, liveness.
+
+``ReconnectingBrokerClient`` wraps a *session factory* — any zero-arg
+callable returning a Broker-interface client (``NetworkBrokerClient``,
+``MqttBrokerClient``) — and turns the bare fail-fast client into a session
+that survives broker death:
+
+- **auto-reconnect**: when the inner session dies (read-loop EOF via the
+  client's ``on_disconnect`` hook, or a publish raising ``OSError``) a
+  background thread re-dials under the ``RetryPolicy`` (exponential
+  backoff + jitter + deadline) and emits ``conn_reconnect`` on success.
+- **subscription replay**: subscriber queues are owned by this wrapper and
+  survive sessions; each new session re-subscribes every topic with the
+  same queue objects (``subscribe(topic, sink=q)``), so a
+  ``PubSubCommManager`` holding a queue never notices the swap.
+- **bounded publish retry buffer**: publishes enter a bounded pending
+  table first. Entries are confirmed by broker acks when the transport
+  supports them (netbroker seq/puback) and retried — on an ack timeout,
+  and on every reconnect — emitting ``publish_retry`` per resend.
+  Transports without publish acks (MQTT QoS 0) still get crash coverage:
+  unconfirmed recent publishes are replayed on reconnect. Delivery is
+  at-least-once; consumers must tolerate duplicates (the FedAvg manager
+  state machines do — receipt is keyed by sender/round).
+- **heartbeat liveness**: the wrapper subscribes to a private per-client
+  topic and publishes a beat every ``heartbeat_interval``; the broker
+  loops it back, so a silent link is detected even when TCP keeps the
+  socket "open" (half-open connection after a broker VM is preempted).
+  A beat gap over ``heartbeat_timeout`` emits ``heartbeat_missed`` and
+  forces a reconnect.
+
+The wrapper exposes the same ``Broker`` interface, so
+``PubSubCommManager(ReconnectingBrokerClient(...), rank)`` is a drop-in
+swap for the bare client.
+"""
+
+from __future__ import annotations
+
+import collections
+import logging
+import queue
+import threading
+import time
+from typing import Callable, Optional
+
+from feddrift_tpu import obs
+from feddrift_tpu.resilience.retry import RetryPolicy
+
+log = logging.getLogger("feddrift_tpu")
+
+
+class _Pending:
+    __slots__ = ("topic", "payload", "attempts", "last_send", "inner_seq",
+                 "session")
+
+    def __init__(self, topic: str, payload: str) -> None:
+        self.topic = topic
+        self.payload = payload
+        self.attempts = 0
+        self.last_send = 0.0
+        self.inner_seq: Optional[int] = None
+        self.session = -1          # session generation of the last send
+
+
+class ReconnectingBrokerClient:
+    """Broker interface over a re-dialable session (see module docstring)."""
+
+    def __init__(self, connect: Callable[[], object], *,
+                 retry: Optional[RetryPolicy] = None,
+                 ack_timeout: float = 0.5,
+                 pending_max: int = 256,
+                 redeliver_window: Optional[float] = None,
+                 heartbeat_interval: float = 0.0,
+                 heartbeat_timeout: float = 0.0,
+                 verify_timeout: float = 2.0,
+                 client_id: str = "",
+                 transport: str = "netbroker") -> None:
+        self._connect = connect
+        self._retry = retry if retry is not None else RetryPolicy()
+        self._ack_timeout = ack_timeout
+        self._pending_max = pending_max
+        # The broker acks after ROUTING, not delivery: a message can be
+        # acked yet die in the broker's outbound queues when the broker is
+        # killed. On reconnect, publishes acked within this window are
+        # replayed too — closing the ack-vs-delivery gap around a crash.
+        self._redeliver_window = (redeliver_window if redeliver_window
+                                  is not None else 4 * ack_timeout)
+        self._recent: "collections.deque[tuple[float, _Pending]]" = \
+            collections.deque(maxlen=pending_max)
+        self._hb_interval = heartbeat_interval
+        self._hb_timeout = heartbeat_timeout or (3 * heartbeat_interval)
+        self._verify_timeout = verify_timeout
+        self._transport = transport
+        self._lock = threading.RLock()
+        self._subs: dict[str, list[queue.Queue]] = {}
+        self._pending: "collections.OrderedDict[int, _Pending]" = \
+            collections.OrderedDict()
+        self._next_id = 0
+        self._session = 0            # bumped on every successful (re)connect
+        self._inner = None
+        self._closed = False
+        self._dead = False           # retry schedule exhausted
+        self._reconnecting = False
+        self._hb_topic = f"__hb__/{client_id or hex(id(self))}"
+        self._hb_queue: queue.Queue = queue.Queue()
+        self._hb_last_rx = time.monotonic()
+        self.reconnects = 0
+
+        self._inner = self._dial_first()
+        with self._lock:
+            self._subs[self._hb_topic] = [self._hb_queue]
+            self._inner.subscribe(self._hb_topic, sink=self._hb_queue)
+        self._maintenance = threading.Thread(target=self._maintenance_loop,
+                                             daemon=True)
+        self._maintenance.start()
+
+    # -- session management --------------------------------------------
+    def _verify_session(self, inner) -> None:
+        """Round-trip probe: prove the broker actually SERVICES this
+        session. A dial can complete its TCP handshake against a listener
+        that is mid-shutdown (the kernel finishes the handshake before the
+        app ever accepts) and leave a half-open socket that blocks forever;
+        connect() succeeding proves nothing. Publish to a private topic and
+        wait for the broker's loopback; re-publish inside the window so a
+        chaos-dropped probe doesn't fail a healthy session.
+        Raises ``OSError`` on a silent session."""
+        if self._verify_timeout <= 0:
+            return
+        probe = f"__sync__/{id(inner):x}"
+        q: queue.Queue = queue.Queue()
+        try:
+            inner.subscribe(probe, sink=q)
+            deadline = time.monotonic() + self._verify_timeout
+            while time.monotonic() < deadline:
+                inner.publish(probe, "ping")
+                try:
+                    q.get(timeout=min(0.25, self._verify_timeout))
+                    return
+                except queue.Empty:
+                    continue
+            raise OSError("session verification timed out "
+                          f"({self._verify_timeout}s): broker not servicing")
+        finally:
+            try:
+                inner.unsubscribe(probe, q)
+            except OSError:
+                pass
+
+    def _dial(self):
+        """One verified connect attempt (retried by RetryPolicy.run)."""
+        inner = self._connect()
+        try:
+            self._verify_session(inner)
+        except BaseException:
+            try:
+                inner.close()
+            except OSError:
+                pass
+            raise
+        return inner
+
+    def _dial_first(self):
+        """Initial connect, already under the retry policy (a client booting
+        before its broker is a normal race on preemptible fleets)."""
+        inner = self._retry.run(self._dial)
+        inner.on_disconnect = self._on_disconnect
+        return inner
+
+    def _on_disconnect(self) -> None:
+        """Inner read loop died unexpectedly -> heal in the background."""
+        self._schedule_reconnect()
+
+    def _schedule_reconnect(self) -> None:
+        with self._lock:
+            if self._closed or self._dead or self._reconnecting:
+                return
+            self._reconnecting = True
+        threading.Thread(target=self._reconnect, daemon=True).start()
+
+    def _reconnect(self) -> None:
+        old = self._inner
+        if old is not None:
+            try:
+                old.on_disconnect = None     # a dying old session must not
+                old.close()                  # re-trigger reconnection
+            except OSError:
+                pass
+        try:
+            inner = self._retry.run(self._dial)
+        except OSError as exc:
+            with self._lock:
+                self._dead = True
+                self._reconnecting = False
+            log.error("reconnect: retry schedule exhausted (%s); "
+                      "client is dead", exc)
+            return
+        inner.on_disconnect = self._on_disconnect
+        with self._lock:
+            self._inner = inner
+            self._session += 1
+            self._reconnecting = False
+            topics = {t: list(qs) for t, qs in self._subs.items()}
+            stale = list(self._pending.values())
+            cutoff = time.monotonic() - self._redeliver_window
+            stale += [p for ts, p in self._recent if ts >= cutoff]
+        self.reconnects += 1
+        self._hb_last_rx = time.monotonic()  # fresh grace period
+        for topic, qs in topics.items():     # subscription replay
+            for q in qs:
+                try:
+                    inner.subscribe(topic, sink=q)
+                except OSError:
+                    self._schedule_reconnect()
+                    return
+        obs.emit("conn_reconnect", transport=self._transport,
+                 resubscribed=len(topics), pending=len(stale))
+        obs.registry().counter("client_reconnects",
+                               transport=self._transport).inc()
+        for p in stale:                      # replay unconfirmed publishes
+            self._resend(p)
+
+    # -- publish path ---------------------------------------------------
+    def publish(self, topic: str, payload: str) -> None:
+        """Never raises on a dead broker: the publish is buffered (bounded)
+        and re-sent once the session heals — unlike the bare client, which
+        surfaces a raw ``OSError`` to the caller."""
+        if self._closed:
+            raise RuntimeError("publish on closed client")
+        p = _Pending(topic, payload)
+        with self._lock:
+            self._next_id += 1
+            self._pending[self._next_id] = p
+            while len(self._pending) > self._pending_max:
+                self._pending.popitem(last=False)   # evict oldest
+                obs.registry().counter(
+                    "publish_buffer_evictions",
+                    transport=self._transport).inc()
+        self._send(p, first=True)
+
+    def _send(self, p: _Pending, first: bool = False) -> None:
+        with self._lock:
+            inner, session = self._inner, self._session
+        if inner is None:
+            return
+        try:
+            seq = inner.publish(p.topic, p.payload)
+        except OSError:
+            self._schedule_reconnect()
+            return
+        p.inner_seq = seq if isinstance(seq, int) else None
+        p.session = session
+        p.attempts += 1
+        p.last_send = time.monotonic()
+        if not first:
+            obs.emit("publish_retry", transport=self._transport,
+                     topic=p.topic, attempts=p.attempts)
+            obs.registry().counter("publish_retries",
+                                   transport=self._transport).inc()
+
+    def _resend(self, p: _Pending) -> None:
+        self._send(p, first=False)
+
+    # -- maintenance: ack reaping, retry pacing, heartbeat --------------
+    def _maintenance_loop(self) -> None:
+        tick = min(self._ack_timeout / 2,
+                   self._hb_interval or self._ack_timeout) or 0.1
+        next_beat = 0.0
+        while not self._closed and not self._dead:
+            time.sleep(tick)
+            now = time.monotonic()
+            self._reap_and_retry(now)
+            if self._hb_interval and now >= next_beat:
+                next_beat = now + self._hb_interval
+                self._heartbeat(now)
+
+    def _reap_and_retry(self, now: float) -> None:
+        with self._lock:
+            inner, session = self._inner, self._session
+            entries = list(self._pending.items())
+        if inner is None or self._reconnecting:
+            return
+        unacked = None
+        if hasattr(inner, "unacked"):
+            try:
+                unacked = inner.unacked()
+            except OSError:
+                return
+        for key, p in entries:
+            if p.session == session and p.inner_seq is not None \
+                    and unacked is not None:
+                if p.inner_seq not in unacked:       # broker confirmed it
+                    with self._lock:
+                        self._pending.pop(key, None)
+                        self._recent.append((now, p))   # crash-replay window
+                    continue
+            elif p.session == session and unacked is None:
+                # no-ack transport: one successful send is all the
+                # confirmation we will ever get; keep nothing to retry
+                # within a session (reconnect replay still covers crashes)
+                continue
+            if now - p.last_send < self._ack_timeout:
+                continue
+            if p.attempts > self._retry.max_attempts:
+                with self._lock:
+                    self._pending.pop(key, None)
+                log.warning("publish to %r dropped after %d attempts",
+                            p.topic, p.attempts)
+                continue
+            self._resend(p)
+
+    def _heartbeat(self, now: float) -> None:
+        while True:                      # drain loopback beats
+            try:
+                self._hb_queue.get_nowait()
+                self._hb_last_rx = now
+            except queue.Empty:
+                break
+        if now - self._hb_last_rx > self._hb_timeout:
+            obs.emit("heartbeat_missed", transport=self._transport,
+                     silent_s=round(now - self._hb_last_rx, 3))
+            obs.registry().counter("heartbeats_missed",
+                                   transport=self._transport).inc()
+            self._hb_last_rx = now       # one event per silent window
+            self._schedule_reconnect()
+            return
+        with self._lock:
+            inner = self._inner
+        if inner is not None and not self._reconnecting:
+            try:
+                inner.publish(self._hb_topic, str(now))
+            except OSError:
+                self._schedule_reconnect()
+
+    # -- Broker interface ----------------------------------------------
+    def subscribe(self, topic: str, sink: "queue.Queue | None" = None) -> queue.Queue:
+        q: queue.Queue = sink if sink is not None else queue.Queue()
+        with self._lock:
+            self._subs.setdefault(topic, []).append(q)
+            inner = self._inner
+        if inner is not None:
+            try:
+                inner.subscribe(topic, sink=q)
+            except OSError:
+                self._schedule_reconnect()   # replay will cover this topic
+        return q
+
+    def unsubscribe(self, topic: str, q: queue.Queue) -> None:
+        with self._lock:
+            subs = self._subs.get(topic, [])
+            if q in subs:
+                subs.remove(q)
+            if not subs:
+                self._subs.pop(topic, None)
+            inner = self._inner
+        if inner is not None:
+            try:
+                inner.unsubscribe(topic, q)
+            except OSError:
+                pass
+
+    @property
+    def pending_count(self) -> int:
+        with self._lock:
+            return len(self._pending)
+
+    @property
+    def is_dead(self) -> bool:
+        """True once the retry schedule was exhausted without a session."""
+        return self._dead
+
+    def close(self) -> None:
+        self._closed = True
+        with self._lock:
+            inner, self._inner = self._inner, None
+        if inner is not None:
+            inner.on_disconnect = None
+            try:
+                inner.close()
+            except OSError:
+                pass

@@ -1,0 +1,85 @@
+"""Shared retry schedule: exponential backoff + jitter + deadline.
+
+One policy object describes *when* to retry; the callers decide *what*.
+It is used by the reconnecting broker wrapper (reconnect.py) for both
+connection re-establishment and unacked-publish resends, and is available
+to any other caller that needs bounded, reproducible retry pacing.
+
+Jitter is drawn from a policy-owned seeded PRNG so tests (and chaos runs,
+which care about reproducibility end-to-end) get deterministic schedules;
+production callers can leave ``seed=None`` for entropy-seeded jitter.
+"""
+
+from __future__ import annotations
+
+import random
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Iterator, Optional
+
+
+@dataclass
+class RetryPolicy:
+    """Exponential backoff with decorrelating jitter and a total deadline.
+
+    Attempt ``k`` (0-based) sleeps ``min(max_delay, base_delay * mult**k)``
+    scaled by a uniform jitter in ``[1 - jitter, 1 + jitter]``. Iteration
+    stops after ``max_attempts`` delays or once ``deadline_s`` of wall time
+    has elapsed since ``delays()`` was entered, whichever comes first.
+    """
+
+    base_delay: float = 0.05
+    max_delay: float = 2.0
+    multiplier: float = 2.0
+    jitter: float = 0.5            # +/- fraction of the nominal delay
+    max_attempts: int = 8          # number of *retries* (not first tries)
+    deadline_s: Optional[float] = 30.0
+    seed: Optional[int] = None     # None = entropy-seeded jitter
+    _rng: random.Random = field(init=False, repr=False, compare=False,
+                                default=None)  # type: ignore[assignment]
+
+    def __post_init__(self) -> None:
+        if self.base_delay < 0 or self.max_delay < 0:
+            raise ValueError("delays must be >= 0")
+        if not 0.0 <= self.jitter <= 1.0:
+            raise ValueError(f"jitter must be in [0, 1], got {self.jitter}")
+        if self.max_attempts < 1:
+            raise ValueError("max_attempts must be >= 1")
+        self._rng = random.Random(self.seed)
+
+    def delay(self, attempt: int) -> float:
+        """Jittered delay before retry number ``attempt`` (0-based)."""
+        nominal = min(self.max_delay,
+                      self.base_delay * self.multiplier ** attempt)
+        lo = 1.0 - self.jitter
+        return nominal * (lo + self._rng.random() * 2 * self.jitter)
+
+    def delays(self) -> Iterator[float]:
+        """Yield successive delays, honoring max_attempts and deadline."""
+        start = time.monotonic()
+        for k in range(self.max_attempts):
+            if (self.deadline_s is not None
+                    and time.monotonic() - start >= self.deadline_s):
+                return
+            yield self.delay(k)
+
+    def run(self, fn: Callable, *, retry_on: tuple = (OSError,),
+            on_retry: Optional[Callable[[int, BaseException], None]] = None):
+        """Call ``fn`` until it returns, sleeping per the schedule between
+        failures. Raises the last exception once the schedule is exhausted.
+        ``on_retry(attempt, exc)`` fires before each sleep."""
+        import itertools
+        last: Optional[BaseException] = None
+        # chain lazily: materializing delays() up front would evaluate the
+        # deadline once at t=0 instead of between attempts
+        for attempt, pause in enumerate(itertools.chain([0.0], self.delays())):
+            if pause:
+                time.sleep(pause)
+            try:
+                return fn()
+            except retry_on as exc:          # type: ignore[misc]
+                last = exc
+                if on_retry is not None:
+                    on_retry(attempt, exc)
+        assert last is not None
+        raise last

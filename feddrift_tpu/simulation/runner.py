@@ -124,6 +124,13 @@ class Experiment:
         self.global_round = 0
         self.start_iteration = 0
         self.out_dir = out_dir
+        self.preempted = False
+        from feddrift_tpu.resilience.divergence import DivergenceGuard
+        self.divergence_guard = (
+            DivergenceGuard(spike_factor=cfg.divergence_spike_factor,
+                            max_rollbacks=cfg.divergence_max_rollbacks,
+                            warmup=cfg.divergence_warmup_rounds)
+            if cfg.divergence_guard else None)
         self.tracer = PhaseTracer(registry=obs.registry())
         self.events.emit(
             "run_start", dataset=cfg.dataset, model=cfg.model,
@@ -280,6 +287,10 @@ class Experiment:
         t0 = time.time()
         self.events.set_context(iteration=t, round=self.global_round)
         self.events.emit("iteration_start")
+        if self.divergence_guard is not None:
+            # the time step changes the training window/concept: losses
+            # legitimately re-spike, so the spike baseline starts fresh
+            self.divergence_guard.new_window()
         with self.tracer.phase("cluster"):   # drift detection / clustering
             self.algo.begin_iteration(t)
         if cfg.debug_checks:
@@ -387,6 +398,30 @@ class Experiment:
                                     self.failure_detector.suspected.tolist())
         return masks
 
+    def _check_divergence(self, losses, n) -> bool:
+        """Guard one round's fetched losses; True = diverged (caller rolls
+        back). Fetch goes through multihost so every process of a
+        multi-controller run sees identical arrays and stays in lockstep."""
+        if self.divergence_guard is None:
+            return False
+        l_host, n_host = multihost.fetch((losses, n))
+        diverged, reason, observed = self.divergence_guard.check(
+            np.asarray(l_host), np.asarray(n_host))
+        if not diverged:
+            return False
+        g = self.divergence_guard
+        self.events.emit(
+            "divergence_detected", reason=reason,
+            observed_loss=(round(observed, 6) if np.isfinite(observed)
+                           else None),
+            baseline=(round(g.baseline, 6) if g.baseline is not None
+                      else None),
+            consecutive=g.consecutive_rollbacks + 1)
+        obs.registry().counter("divergence_rollbacks").inc()
+        log.warning("divergence (%s) at round %d: rolling back pool params",
+                    reason, self.global_round)
+        return True
+
     def _run_rounds(self, t: int, opt_states) -> None:
         """Per-round host loop: algorithms that steer every round."""
         cfg = self.cfg
@@ -407,6 +442,16 @@ class Experiment:
                     # attribute device time to this phase instead of letting
                     # async dispatch spill it into whichever phase blocks next
                     jax.block_until_ready(new_params)
+                if self._check_divergence(losses, n):
+                    # rollback: pre-round params, fresh optimizer state (the
+                    # diverged step contaminated both); skip after_round and
+                    # this round's eval — its numbers would be garbage
+                    self.pool.params = prev_params
+                    opt_states = self.step.init_opt_states(
+                        self.pool.params, self.pool.num_models, self.C_pad)
+                    self.divergence_guard.record_rollback()
+                    self.global_round += 1
+                    continue
                 self.pool.params = self.algo.after_round(
                     t, r, prev_params, new_params, client_params, n)
             if r % cfg.frequency_of_the_test == 0 or r == cfg.comm_round - 1:
@@ -479,6 +524,12 @@ class Experiment:
             t_idx = t
         g0 = self.global_round
         cms = self._client_masks(t, range(R))
+        # The fused program DONATES its params input (HBM economy), so the
+        # divergence rollback target must live on host: a numpy snapshot of
+        # the iteration-start pool — the same D2H the default per-iteration
+        # checkpoint already pays, taken only when the guard is armed.
+        host_prev = (jax.tree_util.tree_map(np.asarray, self.pool.params)
+                     if self.divergence_guard is not None else None)
         with self.tracer.phase("train_round"):
             new_params, opt_states, n, losses, bufs, total = \
                 self.step.train_iteration_eval(
@@ -487,6 +538,15 @@ class Experiment:
                     None if cms is None else jnp.asarray(cms))
             if cfg.trace_sync:
                 jax.block_until_ready(new_params)
+            if self._check_divergence(losses, n):
+                # fused granularity is the whole time step: restore the
+                # iteration-start params, skip after_round and the eval
+                # logging — the buffers hold diverged numbers
+                self.pool.params = jax.tree_util.tree_map(jnp.asarray,
+                                                          host_prev)
+                self.divergence_guard.record_rollback()
+                self.global_round = g0 + R
+                return
             self.pool.params = self.algo.after_round(
                 t, R - 1, None, new_params, None, n)
         with self.tracer.phase("eval"):
@@ -515,12 +575,35 @@ class Experiment:
     def run(self) -> MetricsLogger:
         # Context managers so a raising iteration cannot leak the JSONL
         # handles; the in-memory history/ring stay readable after close.
+        from feddrift_tpu.resilience.preempt import PreemptionHandler
         with self.logger, self.events:
-            for t in range(self.start_iteration, self.cfg.train_iterations):
-                self.run_iteration(t)
+            with PreemptionHandler(enabled=self.cfg.preempt_signals) as pre:
+                for t in range(self.start_iteration,
+                               self.cfg.train_iterations):
+                    self.run_iteration(t)
+                    if pre.requested:
+                        # preemption: iteration t just completed — persist
+                        # it and exit cleanly; --auto_resume continues here
+                        self._preempt_stop(t, pre.signal_name)
+                        break
             self.events.emit("run_end", global_round=self.global_round,
-                             test_acc=self.logger.last("Test/Acc"))
+                             test_acc=self.logger.last("Test/Acc"),
+                             preempted=self.preempted)
         return self.logger
+
+    def _preempt_stop(self, completed_iteration: int, signal_name) -> None:
+        """Checkpoint at the iteration boundary after a SIGTERM/SIGINT."""
+        if self.out_dir and not self.cfg.checkpoint_every_iteration:
+            # not already checkpointed by run_iteration: write one now
+            self.save_checkpoint(completed_iteration)
+        self.preempted = True
+        self.events.emit(
+            "preempt_checkpoint", iteration=completed_iteration,
+            signal=signal_name,
+            path=self.ckpt_path() if self.out_dir else None)
+        log.warning("preempted by %s: checkpointed through iteration %d, "
+                    "exiting cleanly (resume with --auto_resume)",
+                    signal_name, completed_iteration)
 
     # ------------------------------------------------------------------
     # checkpoint / resume (iteration-granular, like the reference's CWD state

@@ -7,18 +7,24 @@ FedAvgEnsServerManager.py:84-86) with one atomic directory per experiment
 holding everything needed for iteration-granular resume:
 
     ckpt/
-      MANIFEST.json     {version, iteration, global_round, config}
+      MANIFEST.json     {version, iteration, global_round, config, checksums}
       pool.msgpack      flax-serialized [M]-stacked parameter pytree
       algo.npz          the algorithm's state_dict (numpy-converted)
 
-Writes are atomic (tmp dir + os.replace), so a run killed mid-save resumes
-from the previous complete checkpoint — strictly stronger than the
-reference's unversioned overwrite-in-place pickles.
+Writes are atomic (tmp dir + os.replace) and every payload file's sha256 is
+recorded in the manifest, so ``load_checkpoint`` detects truncated/corrupt
+files *before* flax deserialization can fail cryptically. The previous
+complete generation is kept at ``<path>.old``: a corrupt or torn primary
+falls back to it with a loud ``checkpoint_corrupt`` event instead of
+killing the resume. Only when every generation is unreadable does loading
+raise, with a message naming each generation and why it was rejected.
 """
 
 from __future__ import annotations
 
+import hashlib
 import json
+import logging
 import os
 import pickle
 import shutil
@@ -30,65 +36,137 @@ import jax.numpy as jnp
 import numpy as np
 from flax import serialization
 
+from feddrift_tpu import obs
+
+log = logging.getLogger("feddrift_tpu")
+
 CKPT_VERSION = 1
+_PAYLOAD_FILES = ("pool.msgpack", "algo.pkl")
+
+
+class CheckpointCorruptError(RuntimeError):
+    """A checkpoint generation failed verification or deserialization."""
 
 
 def _to_numpy_tree(tree):
     return jax.tree_util.tree_map(np.asarray, tree)
 
 
+def _sha256(path: str) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for block in iter(lambda: f.read(1 << 20), b""):
+            h.update(block)
+    return h.hexdigest()
+
+
 def save_checkpoint(path: str, *, config_json: str, iteration: int,
                     global_round: int, pool_params: Any,
                     algo_state: dict) -> None:
-    """Atomically write a complete checkpoint to ``path``."""
+    """Atomically write a complete checkpoint to ``path``; the previous
+    generation survives at ``path + '.old'`` as the corruption fallback."""
     parent = os.path.dirname(os.path.abspath(path)) or "."
     os.makedirs(parent, exist_ok=True)
     tmp = tempfile.mkdtemp(prefix=".ckpt-tmp-", dir=parent)
     try:
-        with open(os.path.join(tmp, "MANIFEST.json"), "w") as f:
-            json.dump({"version": CKPT_VERSION, "iteration": iteration,
-                       "global_round": global_round,
-                       "config": json.loads(config_json)}, f, indent=2)
         with open(os.path.join(tmp, "pool.msgpack"), "wb") as f:
             f.write(serialization.to_bytes(_to_numpy_tree(pool_params)))
         # Algorithm states are numpy/scalars/lists (reference pickles the
         # same content); pickle keeps nested dict/list structure intact.
         with open(os.path.join(tmp, "algo.pkl"), "wb") as f:
             pickle.dump(_to_numpy_tree(algo_state), f)
+        checksums = {name: _sha256(os.path.join(tmp, name))
+                     for name in _PAYLOAD_FILES}
+        with open(os.path.join(tmp, "MANIFEST.json"), "w") as f:
+            json.dump({"version": CKPT_VERSION, "iteration": iteration,
+                       "global_round": global_round,
+                       "checksums": checksums,
+                       "config": json.loads(config_json)}, f, indent=2)
         old = path + ".old"
-        if os.path.isdir(old):        # stale from an earlier crash mid-swap
-            shutil.rmtree(old)
         if os.path.isdir(path):
+            if os.path.isdir(old):
+                shutil.rmtree(old)
             os.replace(path, old)
-            os.replace(tmp, path)
-            shutil.rmtree(old, ignore_errors=True)
-        else:
-            os.replace(tmp, path)
+        os.replace(tmp, path)
     finally:
         shutil.rmtree(tmp, ignore_errors=True)
 
 
-def load_checkpoint(path: str, pool_params_template: Any) -> dict:
-    """Read a checkpoint; returns manifest fields + restored pytrees.
+def verify_checkpoint(path: str) -> dict:
+    """Read + verify one generation's manifest; returns the manifest.
 
-    ``pool_params_template`` supplies the pytree structure/shapes for flax
-    deserialization (the [M]-stacked pool from a freshly built Experiment).
+    Raises ``CheckpointCorruptError`` on an unreadable manifest, a missing
+    payload file, or a sha256 mismatch (truncated / bit-flipped payload).
+    Manifests written before checksums existed (no ``checksums`` key) are
+    accepted as-is — verification is best-effort for them.
     """
-    if not os.path.isdir(path) and os.path.isdir(path + ".old"):
-        # crash happened between the two os.replace calls in save_checkpoint;
-        # the previous complete checkpoint lives in '.old'
-        path = path + ".old"
-    with open(os.path.join(path, "MANIFEST.json")) as f:
-        manifest = json.load(f)
+    manifest_path = os.path.join(path, "MANIFEST.json")
+    try:
+        with open(manifest_path) as f:
+            manifest = json.load(f)
+    except (OSError, json.JSONDecodeError, UnicodeDecodeError) as exc:
+        raise CheckpointCorruptError(
+            f"unreadable manifest {manifest_path}: {exc}") from exc
+    for name, want in manifest.get("checksums", {}).items():
+        fpath = os.path.join(path, name)
+        if not os.path.isfile(fpath):
+            raise CheckpointCorruptError(f"missing payload file {fpath}")
+        got = _sha256(fpath)
+        if got != want:
+            raise CheckpointCorruptError(
+                f"sha256 mismatch for {fpath}: manifest {want[:12]}..., "
+                f"file {got[:12]}... (truncated or corrupted write)")
+    return manifest
+
+
+def _load_generation(path: str, pool_params_template: Any) -> dict:
+    """Load one verified generation; corruption raises, not segfault-adjacent
+    flax errors — verify_checkpoint runs BEFORE deserialization."""
+    manifest = verify_checkpoint(path)
     if manifest["version"] != CKPT_VERSION:
-        raise ValueError(f"checkpoint version {manifest['version']} != {CKPT_VERSION}")
-    with open(os.path.join(path, "pool.msgpack"), "rb") as f:
-        params = serialization.from_bytes(_to_numpy_tree(pool_params_template),
-                                          f.read())
-    with open(os.path.join(path, "algo.pkl"), "rb") as f:
-        algo_state = pickle.load(f)
+        raise ValueError(
+            f"checkpoint version {manifest['version']} != {CKPT_VERSION}")
+    try:
+        with open(os.path.join(path, "pool.msgpack"), "rb") as f:
+            params = serialization.from_bytes(
+                _to_numpy_tree(pool_params_template), f.read())
+        with open(os.path.join(path, "algo.pkl"), "rb") as f:
+            algo_state = pickle.load(f)
+    except ValueError as exc:
+        # unchecksummed legacy generation with a torn payload: flax/pickle
+        # failures still classify as corruption, with the real cause attached
+        raise CheckpointCorruptError(
+            f"deserialization failed in {path}: {exc}") from exc
     return {"iteration": int(manifest["iteration"]),
             "global_round": int(manifest["global_round"]),
             "config": manifest["config"],
             "pool_params": jax.tree_util.tree_map(jnp.asarray, params),
             "algo_state": algo_state}
+
+
+def load_checkpoint(path: str, pool_params_template: Any) -> dict:
+    """Read the newest loadable checkpoint generation.
+
+    Tries the primary directory, then ``<path>.old`` (the previous
+    complete generation — present after any post-first save, or after a
+    crash between the two os.replace calls in save_checkpoint). A
+    generation that fails verification emits ``checkpoint_corrupt`` and
+    falls through; only when no generation loads does this raise.
+    """
+    errors: list[str] = []
+    for gen in (path, path + ".old"):
+        if not os.path.isdir(gen):
+            continue
+        try:
+            return _load_generation(gen, pool_params_template)
+        except CheckpointCorruptError as exc:
+            log.error("checkpoint generation %s is corrupt: %s "
+                      "(falling back)", gen, exc)
+            obs.emit("checkpoint_corrupt", path=gen, reason=str(exc))
+            obs.registry().counter("checkpoint_corruptions").inc()
+            errors.append(f"{gen}: {exc}")
+    if errors:
+        raise CheckpointCorruptError(
+            "no loadable checkpoint generation; rejected: "
+            + "; ".join(errors))
+    raise FileNotFoundError(f"no checkpoint at {path} (or {path}.old)")

@@ -20,9 +20,12 @@ file handle.
 from __future__ import annotations
 
 import json
+import logging
 import os
 import time
 from typing import Any
+
+log = logging.getLogger("feddrift_tpu")
 
 
 class MetricsLogger:
@@ -100,12 +103,18 @@ class MetricsLogger:
                     if rec.get("iteration", -1) < iteration:
                         kept.append(line if line.endswith("\n")
                                     else line + "\n")
-        except OSError:
+        except OSError as exc:
             # Read-back failed: leave the file untouched rather than
             # rewriting it from an empty `kept` (which would erase the
             # run's entire pre-checkpoint history on a transient error).
             # Worst case some partial rows duplicate — recoverable; an
-            # emptied file is not.
+            # emptied file is not. Loud, so the operator of a resumed run
+            # knows metrics.jsonl may carry duplicated partial-iteration
+            # rows (and that the in-memory history now disagrees with it).
+            log.warning(
+                "metrics truncation read-back failed (%s): %s left "
+                "untouched — rows with iteration >= %d may be duplicated "
+                "when the rerun logs them again", exc, path, iteration)
             self._fh = open(path, "a")
             return
         with open(path, "w") as f:

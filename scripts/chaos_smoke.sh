@@ -1,0 +1,69 @@
+#!/usr/bin/env bash
+# Chaos smoke: the resilience layer's acceptance scenario, end to end on CPU.
+#
+# 1) transport domain — a FedAvg manager exchange over a real TCP broker
+#    with a 20% seeded message-drop chaos policy AND a broker kill/restart
+#    mid-run; asserts the run completes with conn_reconnect + publish_retry
+#    visible in events.jsonl (runs the tier-1 tests that encode exactly
+#    that, so the smoke and CI can never drift apart).
+# 2) process domain — a real `python -m feddrift_tpu run` is SIGTERM'd
+#    mid-run (preemption), then re-launched with --auto_resume; asserts a
+#    clean exit, a preempt_checkpoint event, and a duplicate-free
+#    metrics.jsonl.
+# 3) the event taxonomy stays consistent (check_events_schema).
+#
+# Usage: scripts/chaos_smoke.sh            (~1-2 min on one CPU core)
+set -euo pipefail
+cd "$(dirname "$0")/.."
+export JAX_PLATFORMS=cpu
+
+OUT=$(mktemp -d)
+trap 'rm -rf "$OUT"' EXIT
+RUN="$OUT/run"
+
+echo "== [1/3] chaos transport e2e (drop_prob=0.2 + broker kill/restart) =="
+timeout -k 10 300 python -m pytest tests/test_resilience.py -q \
+    -p no:cacheprovider -p no:randomly \
+    -k "ChaosEndToEnd or survives_broker_kill or heartbeat_missed"
+
+echo "== [2/3] preemption: SIGTERM a real run, then --auto_resume =="
+ARGS=(--dataset sine --model fnn --concept_drift_algo win-1
+      --concept_num 2 --client_num_in_total 4 --client_num_per_round 4
+      --train_iterations 6 --comm_round 8 --epochs 2
+      --batch_size 16 --sample_num 64 --frequency_of_the_test 4
+      --report_client 0 --flat_out_dir --out_dir "$RUN")
+timeout -k 10 600 python -m feddrift_tpu run "${ARGS[@]}" &
+PID=$!
+# preempt once the run has completed at least one iteration (events.jsonl
+# shows an iteration_end), so the checkpoint boundary is real
+for _ in $(seq 1 600); do
+    if grep -qs iteration_end "$RUN/events.jsonl"; then break; fi
+    sleep 0.5
+done
+grep -qs iteration_end "$RUN/events.jsonl" \
+    || { echo "run never completed an iteration"; exit 1; }
+kill -TERM "$PID"
+wait "$PID"   # preempted run must still exit 0 (clean shutdown)
+grep -q preempt_checkpoint "$RUN/events.jsonl" \
+    || { echo "missing preempt_checkpoint event"; exit 1; }
+
+timeout -k 10 600 python -m feddrift_tpu run "${ARGS[@]}" --auto_resume
+
+python - "$RUN" <<'EOF'
+import json, sys
+run = sys.argv[1]
+rows = [json.loads(l) for l in open(f"{run}/metrics.jsonl")]
+seen = [(r["iteration"], r["round"]) for r in rows]
+assert len(seen) == len(set(seen)), "duplicate (iteration, round) rows"
+iters = {r["iteration"] for r in rows}
+assert iters == set(range(6)), f"missing iterations: {sorted(iters)}"
+kinds = [json.loads(l)["kind"] for l in open(f"{run}/events.jsonl")]
+assert "preempt_checkpoint" in kinds
+print(f"resume OK: {len(rows)} metric rows, final Test/Acc="
+      f"{rows[-1]['Test/Acc']:.4f}")
+EOF
+
+echo "== [3/3] event taxonomy consistency =="
+python scripts/check_events_schema.py
+
+echo "chaos_smoke: ALL OK"

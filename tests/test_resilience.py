@@ -1,0 +1,651 @@
+"""Resilience layer: retry/chaos/reconnect transport hardening, preemption
++ auto-resume, checkpoint corruption fallback, divergence guard.
+
+Every blocking operation in this module carries an explicit timeout
+(queue gets, thread joins, wall-clock deadlines) — the socket-level tests
+must not be able to wedge the fast tier even if a reconnect loop hangs;
+all background threads are daemons.
+"""
+
+import json
+import os
+import queue
+import signal
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from feddrift_tpu import obs
+from feddrift_tpu.comm.netbroker import NetworkBroker, NetworkBrokerClient
+from feddrift_tpu.comm.pubsub import Broker, PubSubCommManager
+from feddrift_tpu.resilience import (ChaosBroker, ChaosPolicy,
+                                     DivergenceError, DivergenceGuard,
+                                     PreemptionHandler,
+                                     ReconnectingBrokerClient, RetryPolicy)
+
+E2E_DEADLINE = 60.0          # hard cap for any socket-level scenario
+
+
+@pytest.fixture()
+def bus():
+    """Fresh memory-only event bus per test (socket threads emit into it)."""
+    b = obs.configure(None)
+    yield b
+    obs.configure(None)
+
+
+def _drain_until(q, want: int, deadline: float) -> list:
+    got = []
+    end = time.monotonic() + deadline
+    while len(got) < want and time.monotonic() < end:
+        try:
+            got.append(q.get(timeout=0.25))
+        except queue.Empty:
+            pass
+    return got
+
+
+class TestRetryPolicy:
+    def test_seeded_schedule_is_deterministic(self):
+        a = [RetryPolicy(seed=7).delay(k) for k in range(6)]
+        b = [RetryPolicy(seed=7).delay(k) for k in range(6)]
+        assert a == b
+        assert a != [RetryPolicy(seed=8).delay(k) for k in range(6)]
+
+    def test_backoff_grows_and_caps(self):
+        p = RetryPolicy(base_delay=0.1, max_delay=0.4, multiplier=2.0,
+                        jitter=0.0, max_attempts=6, seed=0)
+        assert list(p.delays()) == [0.1, 0.2, 0.4, 0.4, 0.4, 0.4]
+
+    def test_jitter_bounds(self):
+        p = RetryPolicy(base_delay=1.0, max_delay=1.0, jitter=0.5,
+                        max_attempts=50, deadline_s=None, seed=1)
+        ds = [p.delay(k) for k in range(50)]
+        assert all(0.5 <= d <= 1.5 for d in ds)
+        assert len(set(ds)) > 1
+
+    def test_run_retries_then_succeeds(self):
+        calls = {"n": 0}
+
+        def flaky():
+            calls["n"] += 1
+            if calls["n"] < 3:
+                raise OSError("boom")
+            return "ok"
+
+        p = RetryPolicy(base_delay=0.001, max_attempts=5, seed=0)
+        assert p.run(flaky) == "ok"
+        assert calls["n"] == 3
+
+    def test_run_exhausts_and_raises(self):
+        p = RetryPolicy(base_delay=0.001, max_attempts=2, seed=0)
+        with pytest.raises(OSError):
+            p.run(lambda: (_ for _ in ()).throw(OSError("always")))
+
+    def test_deadline_stops_schedule(self):
+        p = RetryPolicy(base_delay=0.05, max_delay=0.05, jitter=0.0,
+                        max_attempts=1000, deadline_s=0.12, seed=0)
+        t0 = time.monotonic()
+        n = sum(1 for d in p.delays() if time.sleep(d) or True)
+        assert time.monotonic() - t0 < 5.0
+        assert n <= 4          # ~0.12s budget at 0.05s steps (+1 grace)
+
+    def test_validation(self):
+        with pytest.raises(ValueError):
+            RetryPolicy(jitter=2.0)
+        with pytest.raises(ValueError):
+            RetryPolicy(max_attempts=0)
+
+
+class TestChaos:
+    def test_seeded_decisions_reproducible(self, bus):
+        a = ChaosPolicy(seed=3, drop_prob=0.3, dup_prob=0.2)
+        b = ChaosPolicy(seed=3, drop_prob=0.3, dup_prob=0.2)
+        assert [a.draw("t") for _ in range(64)] == \
+               [b.draw("t") for _ in range(64)]
+
+    def test_drop_dup_over_inprocess_broker(self, bus):
+        inner = Broker()
+        chaos = ChaosBroker(inner, seed=5, drop_prob=0.4, dup_prob=0.2)
+        q = chaos.subscribe("t")
+        n = 50
+        for i in range(n):
+            chaos.publish("t", f"m{i}")
+        got = _drain_until(q, n, deadline=2.0)
+        c = chaos.policy.counts
+        assert c["drop"] > 0 and c["dup"] > 0
+        # conservation: delivered = sent - dropped + duplicated
+        assert len(got) == n - c["drop"] + c["dup"]
+        assert any(e["kind"] == "chaos_injected" for e in bus.events())
+
+    def test_delay_still_delivers(self, bus):
+        chaos = ChaosBroker(Broker(), seed=0, delay_prob=1.0, delay_s=0.05)
+        q = chaos.subscribe("t")
+        chaos.publish("t", "late")
+        with pytest.raises(queue.Empty):
+            q.get(timeout=0.01)            # not synchronous
+        assert q.get(timeout=2.0) == "late"
+
+    def test_partition_blackholes_until_heal(self, bus):
+        chaos = ChaosBroker(Broker(), seed=0)
+        q = chaos.subscribe("t")
+        chaos.policy.partition(["t"])
+        chaos.publish("t", "lost")
+        with pytest.raises(queue.Empty):
+            q.get(timeout=0.1)
+        chaos.policy.heal()
+        chaos.publish("t", "through")
+        assert q.get(timeout=2.0) == "through"
+
+    def test_validation(self):
+        with pytest.raises(ValueError):
+            ChaosPolicy(drop_prob=1.5)
+
+
+class TestPublishAcks:
+    def test_acked_publish_clears_pending(self, bus):
+        broker = NetworkBroker()
+        try:
+            c = NetworkBrokerClient(broker.host, broker.port)
+            q = c.subscribe("t")
+            seq = c.publish("t", "x")
+            assert q.get(timeout=5) == "x"
+            end = time.monotonic() + 5
+            while seq in c.unacked() and time.monotonic() < end:
+                time.sleep(0.01)
+            assert seq not in c.unacked()
+            c.close()
+        finally:
+            broker.close()
+
+    def test_dropped_publish_stays_pending_and_resends(self, bus):
+        chaos = ChaosPolicy(seed=0, drop_prob=1.0)
+        broker = NetworkBroker(chaos=chaos)
+        try:
+            c = NetworkBrokerClient(broker.host, broker.port)
+            seq = c.publish("t", "x")
+            time.sleep(0.2)
+            assert seq in c.unacked()      # no ack for a dropped message
+            chaos.drop_prob = 0.0          # heal the wire
+            assert c.resend(seq) is True
+            end = time.monotonic() + 5
+            while seq in c.unacked() and time.monotonic() < end:
+                time.sleep(0.01)
+            assert seq not in c.unacked()
+            c.close()
+        finally:
+            broker.close()
+
+
+def _reconnecting(broker_host, broker_port, **kw):
+    kw.setdefault("retry", RetryPolicy(base_delay=0.05, max_delay=0.2,
+                                       max_attempts=60, deadline_s=30,
+                                       seed=0))
+    kw.setdefault("ack_timeout", 0.2)
+    return ReconnectingBrokerClient(
+        lambda: NetworkBrokerClient(broker_host, broker_port), **kw)
+
+
+class TestReconnectingClient:
+    def test_survives_broker_kill_and_restart(self, bus):
+        broker = NetworkBroker()
+        host, port = broker.host, broker.port
+        cli = _reconnecting(host, port)
+        broker2 = None
+        try:
+            q = cli.subscribe("t")
+            cli.publish("t", "before")
+            assert _drain_until(q, 1, 5.0) == ["before"]
+            broker.close()                       # broker dies
+            time.sleep(0.2)
+            cli.publish("t", "while-down")       # buffered, not raised
+            broker2 = NetworkBroker(host=host, port=port)   # same address
+            got = set()                          # at-least-once: "before"
+            end = time.monotonic() + E2E_DEADLINE  # may be redelivered too
+            while "while-down" not in got and time.monotonic() < end:
+                try:
+                    got.add(q.get(timeout=0.25))
+                except queue.Empty:
+                    pass
+            assert "while-down" in got           # replayed after reconnect
+            assert cli.reconnects >= 1
+            kinds = [e["kind"] for e in bus.events()]
+            assert "conn_reconnect" in kinds
+            assert "publish_retry" in kinds
+        finally:
+            cli.close()
+            broker.close()
+            if broker2 is not None:
+                broker2.close()
+
+    def test_publish_never_raises_on_dead_broker(self, bus):
+        broker = NetworkBroker()
+        cli = _reconnecting(broker.host, broker.port,
+                            retry=RetryPolicy(base_delay=0.01, max_delay=0.02,
+                                              max_attempts=3, deadline_s=1,
+                                              seed=0))
+        broker.close()
+        time.sleep(0.3)
+        cli.publish("t", "x")                    # bare client raises OSError
+        end = time.monotonic() + 10
+        while not cli.is_dead and time.monotonic() < end:
+            time.sleep(0.05)
+        assert cli.is_dead                       # schedule exhausted, no spin
+        cli.close()
+
+    def test_heartbeat_missed_forces_reconnect(self, bus):
+        # partition ONLY the heartbeat loopback: the TCP session stays up
+        # (the half-open-link case), so liveness must come from the beat
+        chaos = ChaosPolicy(seed=0)
+        broker = NetworkBroker(chaos=chaos)
+        cli = _reconnecting(broker.host, broker.port,
+                            heartbeat_interval=0.1, heartbeat_timeout=0.4,
+                            client_id="hb")
+        try:
+            chaos.partition(["__hb__/hb"])
+            end = time.monotonic() + E2E_DEADLINE
+            while time.monotonic() < end:
+                if any(e["kind"] == "heartbeat_missed"
+                       for e in bus.events()):
+                    break
+                time.sleep(0.05)
+            assert any(e["kind"] == "heartbeat_missed"
+                       for e in bus.events())
+            chaos.heal()
+            end = time.monotonic() + E2E_DEADLINE
+            while cli.reconnects < 1 and time.monotonic() < end:
+                time.sleep(0.05)
+            assert cli.reconnects >= 1
+        finally:
+            cli.close()
+            broker.close()
+
+
+# ----------------------------------------------------------------------
+# the chaos e2e of the acceptance criteria: a full FedAvg manager exchange
+# over a real TCP broker with 20% message drop AND a broker kill/restart
+# mid-run; the run completes and events.jsonl shows the healing.
+from tests.test_comm import _FedAvgClient, _FedAvgServer  # noqa: E402
+
+
+class TestChaosEndToEnd:
+    def test_fedavg_completes_under_chaos_and_broker_restart(self, tmp_path):
+        events_path = str(tmp_path / "events.jsonl")
+        bus = obs.configure(events_path)
+        chaos = ChaosPolicy(seed=11, drop_prob=0.2)
+        broker = NetworkBroker(chaos=chaos)
+        host, port = broker.host, broker.port
+        C, rounds = 2, 12
+        clients_cli = [_reconnecting(host, port) for _ in range(C + 1)]
+        server = _FedAvgServer(0, C + 1,
+                               PubSubCommManager(clients_cli[0], 0),
+                               rounds, init_params=0.0)
+        clients = [_FedAvgClient(c, C + 1,
+                                 PubSubCommManager(clients_cli[c], c),
+                                 delta=float(c)) for c in range(1, C + 1)]
+        threads = [threading.Thread(target=m.run, daemon=True)
+                   for m in [server, *clients]]
+        broker2 = None
+        try:
+            for th in threads:
+                th.start()
+            # SUBACK-analog barrier (tests/test_netbroker._sync): publishes
+            # route only to ALREADY-processed subscriptions (and are acked
+            # even when routed to nobody), so the init message must not
+            # race the clients' sub frames. The wrapper retries the sync
+            # publish itself if chaos drops it.
+            for i, cli in enumerate(clients_cli):
+                sq = cli.subscribe(f"__sync__/{i}")
+                cli.publish(f"__sync__/{i}", "ready")
+                assert _drain_until(sq, 1, 30.0), f"client {i} never synced"
+                cli.unsubscribe(f"__sync__/{i}", sq)
+            server.send_init_msg()
+            end = time.monotonic() + E2E_DEADLINE
+            while server.round_idx < 3 and time.monotonic() < end:
+                time.sleep(0.02)             # let a few rounds run first
+            assert server.round_idx >= 3, "no progress before the kill"
+            broker.close()                   # kill the broker mid-run...
+            time.sleep(0.3)
+            broker2 = NetworkBroker(host=host, port=port, chaos=chaos)
+            for th in threads:               # ...and the run still completes
+                th.join(timeout=E2E_DEADLINE)
+            assert not any(th.is_alive() for th in threads), \
+                f"hung at round {server.round_idx}/{rounds}"
+            assert server.round_idx >= rounds
+            assert np.isfinite(float(server.params))
+        finally:
+            obs.configure(None)
+            for cli in clients_cli:
+                cli.close()
+            broker.close()
+            if broker2 is not None:
+                broker2.close()
+        with open(events_path) as f:
+            kinds = [json.loads(line)["kind"] for line in f]
+        assert kinds.count("conn_reconnect") >= 1, kinds
+        assert kinds.count("publish_retry") >= 1, kinds
+        assert kinds.count("chaos_injected") >= 1, kinds
+
+
+class TestPreemptionHandler:
+    def test_signal_sets_flag_and_restores(self, bus):
+        h = PreemptionHandler(signals=(signal.SIGTERM,))
+        old = signal.getsignal(signal.SIGTERM)
+        with h:
+            assert not h.requested
+            os.kill(os.getpid(), signal.SIGTERM)
+            assert h.requested
+            assert h.signal_name == "SIGTERM"
+        assert signal.getsignal(signal.SIGTERM) is old
+
+    def test_disabled_handler_is_noop(self):
+        h = PreemptionHandler(enabled=False)
+        old = signal.getsignal(signal.SIGTERM)
+        with h:
+            assert signal.getsignal(signal.SIGTERM) is old
+
+    def test_off_main_thread_is_noop(self):
+        out = {}
+
+        def worker():
+            with PreemptionHandler() as h:
+                out["installed"] = h._installed
+
+        th = threading.Thread(target=worker)
+        th.start()
+        th.join(timeout=10)
+        assert out == {"installed": False}
+
+
+class TestDivergenceGuard:
+    def test_nonfinite_trips(self):
+        g = DivergenceGuard()
+        n = np.ones((2, 2))
+        diverged, reason, _ = g.check([[1.0, np.inf], [1.0, 1.0]], n)
+        assert diverged and reason == "nonfinite"
+
+    def test_masked_cells_ignored(self):
+        g = DivergenceGuard()
+        losses = np.array([[1.0, np.nan]])
+        n = np.array([[1.0, 0.0]])          # the NaN cell never trained
+        assert g.check(losses, n) == (False, "", 1.0)
+
+    def test_spike_needs_warmup(self):
+        g = DivergenceGuard(spike_factor=5, warmup=3)
+        n = np.ones((1, 1))
+        # warmup absorbs the early descent into the high-water mark
+        for loss in (4.0, 2.0, 1.0):
+            assert not g.check([[loss]], n)[0]
+        assert not g.check([[1.0]], n)[0]            # armed, healthy
+        diverged, reason, _ = g.check([[50.0]], n)   # 12x the window peak
+        assert diverged and reason == "loss_spike"
+
+    def test_heterogeneous_subsets_do_not_trip(self):
+        # client subsampling: round means legitimately swing an order of
+        # magnitude between subsets (a freshly-drifted client enters the
+        # sample); the high-water reference absorbs that healthy variance
+        g = DivergenceGuard(spike_factor=10, warmup=2)
+        n = np.ones((1, 1))
+        for loss in (2.3, 0.1, 0.05, 1.8, 0.02, 2.0):
+            assert not g.check([[loss]], n)[0], loss
+
+    def test_consecutive_rollbacks_abort(self):
+        g = DivergenceGuard(max_rollbacks=2)
+        g.record_rollback()
+        with pytest.raises(DivergenceError):
+            g.record_rollback()
+
+    def test_new_window_resets_baseline_not_rollbacks(self):
+        # drift boundary: the re-learning spike of a NEW concept must not
+        # trip the guard, but a rollback streak spanning the boundary must
+        # still count toward the abort budget
+        g = DivergenceGuard(spike_factor=5, warmup=1, max_rollbacks=3)
+        n = np.ones((1, 1))
+        g.check([[10.0]], n)                 # warmup
+        for _ in range(3):
+            g.check([[0.05]], n)             # converged window
+        g.record_rollback()
+        g.new_window()                       # next time step begins
+        assert g.baseline is None
+        assert g.consecutive_rollbacks == 1
+        assert not g.check([[2.0]], n)[0]    # 40x the old level: healthy
+
+    def test_healthy_round_resets_consecutive(self):
+        g = DivergenceGuard(max_rollbacks=2, warmup=0)
+        n = np.ones((1, 1))
+        g.record_rollback()
+        g.check([[1.0]], n)                  # healthy round in between
+        assert g.consecutive_rollbacks == 0
+        g.record_rollback()                  # does not abort
+
+
+class TestPreemptAutoResume:
+    """The process-domain acceptance path: SIGTERM mid-run -> checkpoint at
+    the iteration boundary -> `run --auto_resume` continues bitwise."""
+
+    _CLI_ARGS = ["--dataset", "sine", "--model", "fnn",
+                 "--concept_drift_algo", "win-1", "--concept_num", "2",
+                 "--client_num_in_total", "4", "--client_num_per_round", "4",
+                 "--train_iterations", "3", "--comm_round", "3",
+                 "--epochs", "1", "--batch_size", "16", "--sample_num", "32",
+                 "--frequency_of_the_test", "2", "--report_client", "0"]
+
+    def _cfg(self):
+        from feddrift_tpu.config import ExperimentConfig
+        return ExperimentConfig(
+            dataset="sine", model="fnn", concept_drift_algo="win-1",
+            concept_num=2, client_num_in_total=4, client_num_per_round=4,
+            train_iterations=3, comm_round=3, epochs=1, batch_size=16,
+            sample_num=32, frequency_of_the_test=2, report_client=0)
+
+    def test_sigterm_then_auto_resume_matches_uninterrupted(self, tmp_path,
+                                                            capsys):
+        from feddrift_tpu.cli import main
+        from feddrift_tpu.simulation.runner import Experiment
+
+        cfg = self._cfg()
+        full = Experiment(cfg)
+        full.run()
+        full_accs = dict(full.logger.series("Test/Acc"))
+
+        # SIGTERM delivered right after iteration 1 completes: the handler
+        # flags it, the runner checkpoints at the boundary and exits cleanly
+        out = str(tmp_path / "run")
+        part = Experiment(cfg, out_dir=out)
+        orig = part.run_iteration
+
+        def hooked(t):
+            orig(t)
+            if t == 1:
+                os.kill(os.getpid(), signal.SIGTERM)
+
+        part.run_iteration = hooked
+        part.run()
+        assert part.preempted
+        kinds = [e["kind"] for e in part.events.events()]
+        assert "preempt_checkpoint" in kinds and "run_end" in kinds
+
+        # same `run` command plus --auto_resume continues from the ckpt
+        assert main(["run", *self._CLI_ARGS, "--flat_out_dir",
+                     "--out_dir", out, "--auto_resume"]) == 0
+        final = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+        assert final["preempted"] is False
+
+        with open(os.path.join(out, "metrics.jsonl")) as f:
+            rows = [json.loads(line) for line in f]
+        seen = [(r["iteration"], r["round"]) for r in rows]
+        assert len(seen) == len(set(seen)), "duplicate (iteration, round) rows"
+        # the stitched run is bitwise the uninterrupted one
+        assert {r["round"]: r["Test/Acc"] for r in rows} == full_accs
+        assert final["Test/Acc"] == full.logger.last("Test/Acc")
+
+    def test_auto_resume_on_fresh_dir_is_plain_run(self, tmp_path, capsys):
+        from feddrift_tpu.cli import main
+        out = str(tmp_path / "fresh")
+        assert main(["run", *self._CLI_ARGS, "--flat_out_dir",
+                     "--out_dir", out, "--auto_resume"]) == 0
+        final = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+        assert final["rounds"] == 9          # ran from scratch: 3 iters x 3
+
+
+class TestDivergenceInRunner:
+    """Numeric-domain wiring: poisoned round losses -> rollback events,
+    params restored, eval skipped, bounded abort."""
+
+    def _cfg(self, **kw):
+        from feddrift_tpu.config import ExperimentConfig
+        base = dict(dataset="sine", model="fnn", concept_drift_algo="win-1",
+                    concept_num=2, client_num_in_total=4,
+                    client_num_per_round=4, train_iterations=2, comm_round=3,
+                    epochs=1, batch_size=16, sample_num=32,
+                    frequency_of_the_test=2, report_client=0,
+                    divergence_warmup_rounds=0)
+        base.update(kw)
+        return ExperimentConfig(**base)
+
+    @staticmethod
+    def _leaf0(params):
+        import jax
+        return np.asarray(jax.tree_util.tree_leaves(params)[0])
+
+    def test_per_round_nan_rolls_back_then_aborts(self, monkeypatch):
+        import jax.numpy as jnp
+        from feddrift_tpu.core.step import TrainStep
+        from feddrift_tpu.simulation.runner import Experiment
+
+        exp = Experiment(self._cfg(chunk_rounds=False,
+                                   divergence_max_rollbacks=2))
+        before = self._leaf0(exp.pool.params)
+        orig = TrainStep.train_round
+
+        def poisoned(self, *a, **k):
+            p, o, cp, n, losses = orig(self, *a, **k)
+            return p, o, cp, n, jnp.full_like(losses, jnp.nan)
+
+        monkeypatch.setattr(TrainStep, "train_round", poisoned)
+        with pytest.raises(DivergenceError):
+            exp.run()
+        evs = exp.events.events("divergence_detected")
+        assert len(evs) == 2 and evs[0]["reason"] == "nonfinite"
+        # both diverged rounds rolled back: params are still the initials
+        np.testing.assert_array_equal(self._leaf0(exp.pool.params), before)
+        assert exp.logger.series("Test/Acc") == []   # evals were skipped
+
+    def test_fused_nan_restores_snapshot_and_skips_eval(self, monkeypatch):
+        import jax.numpy as jnp
+        from feddrift_tpu.core.step import TrainStep
+        from feddrift_tpu.simulation.runner import Experiment
+
+        exp = Experiment(self._cfg(chunk_rounds=True,
+                                   divergence_max_rollbacks=2))
+        before = self._leaf0(exp.pool.params)
+        orig = TrainStep.train_iteration_eval
+
+        def poisoned(self, *a, **k):
+            p, o, n, losses, bufs, total = orig(self, *a, **k)
+            return p, o, n, jnp.full_like(losses, jnp.nan), bufs, total
+
+        monkeypatch.setattr(TrainStep, "train_iteration_eval", poisoned)
+        with pytest.raises(DivergenceError):
+            exp.run()
+        assert len(exp.events.events("divergence_detected")) == 2
+        # the fused rollback restores the host-side snapshot (the program
+        # DONATED the device input buffers)
+        np.testing.assert_array_equal(self._leaf0(exp.pool.params), before)
+        assert exp.logger.series("Test/Acc") == []
+
+    def test_healthy_run_is_untouched_by_the_guard(self):
+        from feddrift_tpu.simulation.runner import Experiment
+        a = Experiment(self._cfg(divergence_guard=True))
+        a.run()
+        b = Experiment(self._cfg(divergence_guard=False))
+        b.run()
+        assert a.logger.series("Test/Acc") == b.logger.series("Test/Acc")
+        assert not a.events.events("divergence_detected")
+
+
+class TestScheduledOutage:
+    def test_outage_window_fails_clients_then_heals(self, bus):
+        from feddrift_tpu.platform.faults import FaultInjector
+        inj = FaultInjector(6)
+        inj.schedule_outage(3, 6, [0, 1, 2])       # correlated AZ outage
+        assert inj.mask(2).tolist() == [1] * 6
+        for r in range(3, 6):
+            assert inj.mask(r).tolist() == [0, 0, 0, 1, 1, 1]
+        assert inj.mask(6).tolist() == [1] * 6      # healed
+        assert len(bus.events("fault_injected")) == 3
+
+    def test_outage_composes_with_kill_and_quorum(self, bus):
+        from feddrift_tpu.platform.faults import FaultInjector
+        inj = FaultInjector(3)
+        inj.kill(2)
+        inj.schedule_outage(0, 2, [0, 1])           # everyone down...
+        m = inj.mask(0)
+        assert m.sum() == 1 and m[0] == 1           # ...quorum floor holds
+
+    def test_outage_validation(self):
+        from feddrift_tpu.platform.faults import FaultInjector
+        with pytest.raises(ValueError):
+            FaultInjector(4).schedule_outage(5, 5, [0])
+
+
+class TestCheckpointIntegrity:
+    def _save(self, path, it=0, rnd=0, val=1.0):
+        import jax.numpy as jnp
+        from feddrift_tpu.utils.checkpoint import save_checkpoint
+        save_checkpoint(path, config_json='{"seed": 0}', iteration=it,
+                        global_round=rnd,
+                        pool_params={"w": jnp.full((2, 3), val)},
+                        algo_state={"s": np.arange(3)})
+
+    def _template(self):
+        import jax.numpy as jnp
+        return {"w": jnp.zeros((2, 3))}
+
+    def test_checksums_written_and_verified(self, tmp_path):
+        from feddrift_tpu.utils.checkpoint import verify_checkpoint
+        path = str(tmp_path / "ckpt")
+        self._save(path)
+        manifest = verify_checkpoint(path)
+        assert set(manifest["checksums"]) == {"pool.msgpack", "algo.pkl"}
+
+    def test_corrupt_pool_falls_back_to_old_generation(self, tmp_path, bus):
+        from feddrift_tpu.utils.checkpoint import load_checkpoint
+        path = str(tmp_path / "ckpt")
+        self._save(path, it=0, rnd=5, val=1.0)
+        self._save(path, it=1, rnd=10, val=2.0)
+        assert os.path.isdir(path + ".old")
+        with open(os.path.join(path, "pool.msgpack"), "r+b") as f:
+            f.truncate(4)                    # torn write
+        state = load_checkpoint(path, self._template())
+        assert state["iteration"] == 0       # the .old generation
+        assert float(np.asarray(state["pool_params"]["w"])[0, 0]) == 1.0
+        evs = bus.events("checkpoint_corrupt")
+        assert evs and "sha256 mismatch" in evs[0]["reason"]
+
+    def test_all_generations_corrupt_raises_loudly(self, tmp_path, bus):
+        from feddrift_tpu.utils.checkpoint import (CheckpointCorruptError,
+                                                   load_checkpoint)
+        path = str(tmp_path / "ckpt")
+        self._save(path, it=0)
+        self._save(path, it=1)
+        for gen in (path, path + ".old"):
+            with open(os.path.join(gen, "MANIFEST.json"), "w") as f:
+                f.write("{not json")
+        with pytest.raises(CheckpointCorruptError, match="no loadable"):
+            load_checkpoint(path, self._template())
+        assert len(bus.events("checkpoint_corrupt")) == 2
+
+    def test_legacy_manifest_without_checksums_loads(self, tmp_path):
+        from feddrift_tpu.utils.checkpoint import load_checkpoint
+        path = str(tmp_path / "ckpt")
+        self._save(path, it=3, rnd=30, val=4.0)
+        with open(os.path.join(path, "MANIFEST.json")) as f:
+            manifest = json.load(f)
+        del manifest["checksums"]            # pre-checksum era checkpoint
+        with open(os.path.join(path, "MANIFEST.json"), "w") as f:
+            json.dump(manifest, f)
+        state = load_checkpoint(path, self._template())
+        assert state["iteration"] == 3
