@@ -99,6 +99,11 @@ class DriftAlgorithm:
         self._ones_sample_w = jnp.ones((self.M, self.C, self.N), jnp.float32)
         self._ones_feat_mask = jnp.ones((self.M, *ds.feature_shape), jnp.float32) \
             if not ds.is_sequence else jnp.ones((self.M, 1), jnp.float32)
+        # Per-client accuracy-entry ages (rounds since last observed
+        # participation) + the failure detector's suspect set, pushed by
+        # the runner before each begin_iteration. Drives stale_clients.
+        self._client_ages = np.zeros(self.C, dtype=np.int64)
+        self._suspected_clients: tuple[int, ...] = ()
 
     # -- runtime binding ------------------------------------------------
     def bind(self, x, y, logger, c_pad: int) -> None:
@@ -139,6 +144,30 @@ class DriftAlgorithm:
             arr.setflags(write=False)
             frozen[t] = arr
         self._acc_offer = (params, frozen)
+
+    def set_client_staleness(self, ages, suspected=()) -> None:
+        """Runner hook: per-client absence ages ([C] rounds since the last
+        observed participation, ``FailureDetector.absent_streak``) and the
+        detector's current suspect set. Read back through
+        ``stale_clients`` by the clustering decision layers."""
+        self._client_ages = np.asarray(ages, dtype=np.int64)[: self.C]
+        self._suspected_clients = tuple(int(c) for c in suspected)
+
+    @property
+    def stale_clients(self) -> np.ndarray:
+        """[C] bool — clients whose accuracy-matrix entries are too stale to
+        drive clustering decisions: absent >= ``cfg.acc_staleness_limit``
+        rounds or currently failure-suspected. All-False when the limit is
+        0 (feature off — historical trusting behavior)."""
+        out = np.zeros(self.C, dtype=bool)
+        limit = getattr(self.cfg, "acc_staleness_limit", 0)
+        if limit > 0:
+            ages = np.zeros(self.C, dtype=np.int64)
+            ages[: len(self._client_ages)] = self._client_ages[: self.C]
+            out |= ages >= limit
+            sus = [c for c in self._suspected_clients if c < self.C]
+            out[sus] = True
+        return out
 
     def acc_matrix_at(self, t: int, feat_mask=None) -> np.ndarray:
         """[M, C] accuracy of every model on every client's step-t data

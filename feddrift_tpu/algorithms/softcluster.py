@@ -224,19 +224,48 @@ class SoftCluster(DriftAlgorithm):
         else:
             self.weights[t, 0], self.weights[t, 1] = probs[1], probs[0]
 
+    # -- staleness-aware decision inputs --------------------------------
+    def _carry_stale_assignments(self, t: int, stale: np.ndarray) -> None:
+        """Stale clients keep their step-(t-1) cluster assignment instead of
+        being re-assigned (and possibly spawning models) from an accuracy
+        column no live client vouches for. Falls back to the fresh
+        assignment when the previous model was merged/reset away."""
+        for c in np.nonzero(stale)[0]:
+            if t > 0 and (self.weights[t - 1, :, c] > 0).any():
+                self.weights[t, :, c] = self.weights[t - 1, :, c]
+
+    def _emit_stale_drift_exclusions(self, stale: np.ndarray, acc, best,
+                                     delta: float) -> None:
+        """acc_stale_excluded for the drift-trigger decision; ``changed``
+        is True when an excluded client's stale accuracy WOULD have fired
+        the trigger (i.e. the exclusion altered a create decision)."""
+        idx = np.nonzero(stale)[0]
+        if idx.size == 0:
+            return
+        changed = bool(any(
+            self.mmacc_acc[c] - acc[best[c], c] > delta for c in idx))
+        obs.emit("acc_stale_excluded", clients=idx.tolist(),
+                 decision="drift_trigger", changed=changed)
+        obs.registry().counter("acc_stale_exclusions").inc(int(idx.size))
+
     # -- FedDrift-Eager -------------------------------------------------
     def _cluster_mmacc2(self, t: int) -> None:
         """Drift detect + at most one new model per step, no merge
         (cluster_mmacc2, :796-837)."""
         acc = self.acc_matrix_at(t)
         in_use = self._models_in_use_before(t)
+        stale = self.stale_clients
         self.weights[t] = 0.0
         best_rows = np.argmax(acc[in_use], axis=0)
         best = np.asarray(in_use)[best_rows]
         self.weights[t, best, np.arange(self.C)] = 1.0
+        self._carry_stale_assignments(t, stale)
+        self._emit_stale_drift_exclusions(stale, acc, best, self.mmacc_delta)
 
         next_free = -42
         for c in range(self.C):
+            if stale[c]:        # absent too long: no trigger, keep detector
+                continue        # armed at its last live accuracy
             newest_acc = acc[best[c], c]
             if self.mmacc_acc[c] - newest_acc > self.mmacc_delta:
                 obs.emit("drift_detected", client=c,
@@ -271,20 +300,27 @@ class SoftCluster(DriftAlgorithm):
 
         in_use = self._models_in_use_before(t, exclude_marked=True)
         acc = self.acc_matrix_at(t)                       # device: [M, C]
+        stale = self.stale_clients
 
         self.weights[t] = 0.0
         for c, (m, _) in self.h_marked.items():           # marked stay local (:868)
             self.weights[t, m, c] = 1.0
 
-        # everyone else on their best in-use model (:872-876)
+        # everyone else on their best in-use model (:872-876); stale clients
+        # then keep their previous assignment instead of chasing a dead
+        # column (the fresh best remains as fallback when that model is gone)
         for c in range(self.C):
             if c not in self.h_marked:
                 best = in_use[int(np.argmax(acc[in_use, c]))]
                 self.weights[t, best, c] = 1.0
+        self._carry_stale_assignments(t, stale)
+        hbest = np.asarray([in_use[int(np.argmax(acc[in_use, c]))]
+                            for c in range(self.C)])
+        self._emit_stale_drift_exclusions(stale, acc, hbest, self.h_delta)
 
         # drift detection -> isolate on a fresh model (:879-897)
         for c in range(self.C):
-            if c in self.h_marked:
+            if c in self.h_marked or stale[c]:
                 continue
             best = in_use[int(np.argmax(acc[in_use, c]))]
             newest_acc = acc[best, c]
@@ -301,15 +337,28 @@ class SoftCluster(DriftAlgorithm):
             self.mmacc_acc[c] = newest_acc
 
         if len(in_use) > 1:
-            self._hierarchical_merge(t, in_use)
+            self._hierarchical_merge(t, in_use, stale)
 
-    def _hierarchical_merge(self, t: int, in_use: list[int]) -> None:
+    def _hierarchical_merge(self, t: int, in_use: list[int],
+                            stale: np.ndarray | None = None) -> None:
         """Cluster-accuracy matrix -> distance -> linkage -> merge
         (:899-972). The M x M accuracies come from full per-cell correct
-        counts (one XLA call) instead of the reference's 20-batch subsample."""
+        counts (one XLA call) instead of the reference's 20-batch subsample.
+
+        ``stale`` [C] bool excludes those clients' accuracy cells from the
+        cluster-distance matrix: a client absent past the staleness limit
+        contributes no evidence for (or against) merging."""
         cells = self.acc_cells_upto(t)                    # [M, C, t+1] correct
         w = np.transpose(self.weights[: t + 1], (1, 2, 0))  # [M, C, t+1]
         assigned = (w == 1.0).astype(np.float64)
+        if stale is not None and stale.any():
+            excluded_cells = float(assigned[:, stale, :].sum())
+            assigned[:, stale, :] = 0.0
+            obs.emit("acc_stale_excluded",
+                     clients=np.nonzero(stale)[0].tolist(),
+                     decision="merge_matrix", changed=excluded_cells > 0)
+            obs.registry().counter("acc_stale_exclusions").inc(
+                int(stale.sum()))
         k = len(in_use)
         cluster_acc = np.zeros((k, k))
         for j_pos, j in enumerate(in_use):

@@ -56,7 +56,9 @@ class DriftSurf(DriftAlgorithm):
     # ------------------------------------------------------------------
     def _score(self, key: str, t: int) -> float:
         """Pooled accuracy of the stored model for ``key`` on step-t data
-        (DriftSurfState._score: global win-1 loader)."""
+        (DriftSurfState._score: global win-1 loader). Columns of
+        staleness-excluded clients are dropped from the pool so a dead
+        client's frozen data cannot flip the stab/reac state machine."""
         if self.key_params[key] is None:
             return 0.0
         params = jax.tree_util.tree_map(lambda p: p[None], self.key_params[key])
@@ -64,8 +66,11 @@ class DriftSurf(DriftAlgorithm):
             params, self.x[:, t], self.y[:, t],
             jnp.ones((1, *self._ones_feat_mask.shape[1:]), jnp.float32))
         correct, total = multihost.fetch((correct, total))
-        return float(np.asarray(correct)[0, : self.C].sum()
-                     / np.asarray(total)[: self.C].sum())
+        live = ~self.stale_clients
+        if not live.any():
+            live = np.ones(self.C, dtype=bool)
+        return float(np.asarray(correct)[0, : self.C][live].sum()
+                     / np.asarray(total)[: self.C][live].sum())
 
     def _append(self, key: str, it: int) -> None:
         self.train_data[key].append(it)
@@ -75,6 +80,13 @@ class DriftSurf(DriftAlgorithm):
     def _run_ds_algo(self, t: int) -> None:
         """The transition logic, verbatim semantics of run_ds_algo
         (:212-266)."""
+        stale = self.stale_clients
+        if stale.any():
+            obs.emit("acc_stale_excluded",
+                     clients=np.nonzero(stale)[0].tolist(),
+                     decision="driftsurf_score", changed=True)
+            obs.registry().counter("acc_stale_exclusions").inc(
+                int(stale.sum()))
         acc_pred = self._score("pred", t)
         if acc_pred > self.acc_best:
             self.acc_best = acc_pred
@@ -214,7 +226,23 @@ class MultiModel(DriftAlgorithm):
         assigned = self._assigned()
         next_free = next((m for m in range(self.M) if m not in assigned), -1)
         acc = self.acc_matrix_at(t)                     # [M, C] device batched
+        stale = self.stale_clients
+        if stale.any():
+            # Absent-too-long clients keep their previous model and cannot
+            # trigger a spawn off an accuracy column nobody vouches for.
+            idx = np.nonzero(stale)[0]
+            changed = bool(any(
+                self.acc_dict[c] - acc[:, c][assigned].max(initial=0.0)
+                > self.delta for c in idx))
+            obs.emit("acc_stale_excluded", clients=idx.tolist(),
+                     decision="mm_select", changed=changed)
+            obs.registry().counter("acc_stale_exclusions").inc(int(idx.size))
         for c in range(self.C):
+            if stale[c]:
+                m_prev = int(self.train_idx[c])
+                self.train_data[m_prev][c].append(t)
+                self.test_idx[c] = m_prev
+                continue
             best_model, best_acc = -1, 0.0
             for m in assigned:
                 if acc[m, c] > best_acc:

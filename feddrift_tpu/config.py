@@ -114,6 +114,36 @@ class ExperimentConfig:
     # kill()-based permanent-failure / elastic-membership experiments.
     fault_enabled: bool = False
 
+    # --- adversary model & robust aggregation (resilience/robust_agg.py,
+    # platform/faults.py::ByzantineInjector; docs/RESILIENCE.md) ----------
+    # Per-cluster aggregator over the stacked client updates. "mean" is the
+    # historical sample-weighted FedAvg (bitwise-identical); the robust
+    # strategies tolerate corrupted submissions at the cost of statistical
+    # efficiency.
+    robust_agg: str = "mean"       # mean | median | trimmed_mean | krum |
+                                   # multi_krum | norm_clip
+    robust_trim_frac: float = 0.2  # fraction trimmed from EACH end
+    robust_krum_f: int = 1         # assumed Byzantine count (krum/multi_krum)
+    robust_clip_norm: float = 1.0  # L2 bound on client diffs (norm_clip)
+    robust_dp_stddev: float = 0.0  # weak-DP noise on the aggregate (any agg)
+    # Byzantine clients: comma-separated indices ("0,3,7"); empty = none.
+    byzantine_clients: str = ""
+    byzantine_mode: str = "sign_flip"  # sign_flip | scale | gauss |
+                                       # stale_replay | label_flip
+    byzantine_scale: float = 10.0  # λ for sign_flip / scale attacks
+    byzantine_std: float = 1.0     # stddev of the gauss attack
+    byzantine_prob: float = 1.0    # per-round activation probability
+    byzantine_seed: int = 0
+    # Staleness-aware clustering decisions: accuracy-matrix entries of
+    # clients absent >= this many rounds (or FailureDetector-suspected) are
+    # EXCLUDED from drift triggers / cluster-distance computations instead
+    # of silently reused. 0 disables (historical behavior).
+    acc_staleness_limit: int = 0
+    # Zero the aggregation weight of FailureDetector-suspected clients (the
+    # detector still observes genuine liveness, so a client that comes back
+    # clears its suspicion and rejoins).
+    exclude_suspected_from_agg: bool = False
+
     # --- resilience (feddrift_tpu/resilience/; docs/RESILIENCE.md) -------
     # SIGTERM/SIGINT -> checkpoint at the next iteration boundary + clean
     # exit (preemptible TPU VMs). Main-thread only; harmless elsewhere.
@@ -137,6 +167,23 @@ class ExperimentConfig:
             raise ValueError("divergence_spike_factor must be > 1")
         if self.divergence_max_rollbacks < 1:
             raise ValueError("divergence_max_rollbacks must be >= 1")
+        if self.robust_agg not in ("mean", "median", "trimmed_mean", "krum",
+                                   "multi_krum", "norm_clip"):
+            raise ValueError(f"unknown robust_agg {self.robust_agg!r}")
+        if not 0.0 <= self.robust_trim_frac < 0.5:
+            raise ValueError("robust_trim_frac must be in [0, 0.5)")
+        if self.robust_krum_f < 0:
+            raise ValueError("robust_krum_f must be >= 0")
+        if not 0.0 <= self.byzantine_prob <= 1.0:
+            raise ValueError("byzantine_prob must be in [0, 1]")
+        if self.acc_staleness_limit < 0:
+            raise ValueError("acc_staleness_limit must be >= 0")
+
+    @property
+    def byzantine_client_list(self) -> list[int]:
+        """Parsed ``byzantine_clients`` indices (empty list = no adversary)."""
+        s = self.byzantine_clients.strip()
+        return [int(tok) for tok in s.split(",") if tok.strip()] if s else []
 
     # ------------------------------------------------------------------
     @property

@@ -48,6 +48,8 @@ import optax
 
 from feddrift_tpu import obs
 from feddrift_tpu.core.functional import confusion_matrix, cross_entropy, tree_select
+from feddrift_tpu.platform.faults import BYZ_MODES, apply_byzantine_updates
+from feddrift_tpu.resilience.robust_agg import RobustAggConfig, aggregate
 
 
 def weight_cdf(weights: jnp.ndarray) -> jnp.ndarray:
@@ -96,6 +98,17 @@ class TrainStep:
     # the B-draw categorical over the flattened [T1*N] axis — by far the most
     # expensive op of a small-model round — is never emitted.
     weighted_sampling: bool = False
+    # Static: which per-cluster aggregator closes the round
+    # (resilience/robust_agg.py registry; "mean" is bitwise-identical to
+    # the historical inline weighted average) and its knobs. Static so the
+    # round program specializes — the robust paths (sorts, Krum distance
+    # matrices) are only ever emitted when actually selected.
+    robust_agg: str = "mean"
+    robust_cfg: RobustAggConfig = field(default_factory=RobustAggConfig)
+    # Static Byzantine attack magnitudes (platform/faults.py modes); only
+    # read when a byz_modes vector is passed into the round.
+    byz_scale: float = 10.0
+    byz_std: float = 1.0
     # Compile tracking: per jitted entry point, the set of argument
     # signatures (leaf shapes/dtypes + static values) seen so far. jit
     # retraces exactly when the signature is new, so a second distinct
@@ -201,7 +214,8 @@ class TrainStep:
 
     # ------------------------------------------------------------------
     def _round_body(self, params, opt_states, key, x, y, time_w, sample_w,
-                    feat_mask, lr_scale, client_mask=None):
+                    feat_mask, lr_scale, client_mask=None, byz_modes=None,
+                    stale_params=None):
         """One communication round (untraced body shared by train_round and
         the fused train_iteration_eval scan).
 
@@ -209,9 +223,23 @@ class TrainStep:
         client_sampling, AggregatorSoftCluster.py:197-205). Non-sampled
         clients train masked (total weight 0 -> params/opt untouched, n=0)
         and drop out of the aggregation, like the reference's absent ranks.
+
+        byz_modes [C] int32 (platform/faults.BYZ_MODES, 0 = honest):
+        adversary injection — label_flip corrupts the training labels
+        before local SGD, every other mode corrupts the submitted update
+        stack after it, BEFORE aggregation, so the server-side defense
+        (self.robust_agg) sees exactly what a malicious client would send.
+        stale_params: each client's previous-round submission ([M, C, ...]),
+        needed only when stale_replay can occur.
         """
         if client_mask is not None:
             time_w = time_w * client_mask[None, :, None]
+        if byz_modes is not None:
+            # label flipping at the data layer: y -> (K-1) - y for the
+            # attackers (eval paths read the untouched dataset)
+            flip = (byz_modes == BYZ_MODES["label_flip"])
+            y = jnp.where(flip.reshape((-1,) + (1,) * (y.ndim - 1)),
+                          self.num_classes - 1 - y, y)
         M = time_w.shape[0]
         C = x.shape[0]
         keys = jax.random.split(key, M * C).reshape(M, C, 2)
@@ -226,24 +254,28 @@ class TrainStep:
         client_params, new_opt, n, losses = jax.vmap(per_model)(
             params, opt_states, keys, time_w, sample_w, feat_mask)
 
-        # Masked weighted FedAvg over the client axis
-        # (AggregatorSoftCluster.py:149-185). With a sharded client axis the
-        # sums become ICI all-reduces.
-        denom = n.sum(axis=1)                              # [M]
-        w_norm = n / jnp.maximum(denom[:, None], 1e-12)    # [M, C]
-        def avg(leaf_mc, leaf_m):
-            wb = w_norm.reshape(w_norm.shape + (1,) * (leaf_mc.ndim - 2))
-            agg = (leaf_mc * wb).sum(axis=1)
-            keep = (denom > 0).reshape((-1,) + (1,) * (leaf_m.ndim - 1))
-            return jnp.where(keep, agg, leaf_m)
-        new_params = jax.tree_util.tree_map(avg, client_params, params)
-        return new_params, new_opt, client_params, n, losses
+        if byz_modes is not None:
+            client_params = apply_byzantine_updates(
+                client_params, params, byz_modes, stale_params,
+                jax.random.fold_in(key, 7919), self.byz_scale, self.byz_std)
+
+        # Masked per-cluster aggregation over the client axis
+        # (AggregatorSoftCluster.py:149-185): the registered robust_agg
+        # strategy — "mean" is the historical weighted FedAvg, bit for bit.
+        # With a sharded client axis the sums become ICI all-reduces.
+        new_params, agg_stats = aggregate(
+            self.robust_agg, client_params, n, params,
+            jax.random.fold_in(key, 104729), self.robust_cfg)
+        return new_params, new_opt, client_params, n, losses, agg_stats
 
     def train_round(self, params, opt_states, key, x, y, time_w, sample_w,
-                    feat_mask, lr_scale, client_mask=None, *,
-                    keep_client_params: bool = True):
+                    feat_mask, lr_scale, client_mask=None, byz_modes=None,
+                    stale_params=None, *, keep_client_params: bool = True,
+                    with_agg_stats: bool = False):
         """One communication round. Returns (new_params [M, ...],
-        new_opt_states, client_params [M, C, ...], n [M, C], mean_loss [M, C]).
+        new_opt_states, client_params [M, C, ...], n [M, C], mean_loss [M, C])
+        plus, when ``with_agg_stats``, the robust-aggregation stats
+        [M, 3] = (active, rejected, clipped) per cluster.
 
         ``keep_client_params=False`` drops the per-client parameter output
         (returned as None): only CFL-family algorithms need the [M, C, ...]
@@ -253,23 +285,27 @@ class TrainStep:
         """
         self._note_signature(
             "train_round", params, opt_states, x, y, time_w, sample_w,
-            feat_mask, client_mask,
+            feat_mask, client_mask, byz_modes, stale_params,
             static=(keep_client_params,))
-        return self._train_round_jit(
+        out = self._train_round_jit(
             params, opt_states, key, x, y, time_w, sample_w, feat_mask,
-            lr_scale, client_mask, keep_client_params=keep_client_params)
+            lr_scale, client_mask, byz_modes, stale_params,
+            keep_client_params=keep_client_params)
+        return out if with_agg_stats else out[:5]
 
     @partial(jax.jit, static_argnums=0,
              static_argnames=("keep_client_params",))
     def _train_round_jit(self, params, opt_states, key, x, y, time_w,
-                         sample_w, feat_mask, lr_scale, client_mask=None, *,
+                         sample_w, feat_mask, lr_scale, client_mask=None,
+                         byz_modes=None, stale_params=None, *,
                          keep_client_params: bool = True):
         out = self._round_body(params, opt_states, key, x, y, time_w,
-                               sample_w, feat_mask, lr_scale, client_mask)
+                               sample_w, feat_mask, lr_scale, client_mask,
+                               byz_modes, stale_params)
         if keep_client_params:
             return out
-        new_params, new_opt, _client_params, n, losses = out
-        return new_params, new_opt, None, n, losses
+        new_params, new_opt, _client_params, n, losses, agg_stats = out
+        return new_params, new_opt, None, n, losses, agg_stats
 
     @staticmethod
     def eval_rounds(R: int, freq: int) -> list[int]:
@@ -282,7 +318,9 @@ class TrainStep:
 
     def train_iteration_eval(self, params, opt_states, iter_key, x, y, time_w,
                              sample_w, feat_mask, lr_scale, R: int, freq: int,
-                             t, client_masks=None):
+                             t, client_masks=None, byz_modes=None, *,
+                             byz_stale: bool = False,
+                             with_agg_stats: bool = False):
         """ALL R communication rounds of a time step + every scheduled eval
         as ONE device program (dispatches ``_train_iteration_eval_jit``).
 
@@ -291,18 +329,30 @@ class TrainStep:
         unnoticed retrace both costs a compile and transiently doubles the
         donated buffers' HBM — exactly the recompile the event stream must
         surface.
+
+        byz_modes [R, C]: per-round adversary schedule
+        (ByzantineInjector.schedule). ``byz_stale=True`` makes the scan
+        carry every client's previous submission so stale_replay attacks
+        replay it (costs one extra [M, C, ...] buffer in the carry).
+        ``with_agg_stats`` additionally returns the per-round [R, M, 3]
+        robust-aggregation stats.
         """
         self._note_signature(
             "train_iteration_eval", params, opt_states, x, y, time_w,
-            sample_w, feat_mask, client_masks, static=(R, freq))
-        return self._train_iteration_eval_jit(
+            sample_w, feat_mask, client_masks, byz_modes,
+            static=(R, freq, byz_stale))
+        out = self._train_iteration_eval_jit(
             params, opt_states, iter_key, x, y, time_w, sample_w, feat_mask,
-            lr_scale, R, freq, t, client_masks)
+            lr_scale, R, freq, t, client_masks, byz_modes,
+            byz_stale=byz_stale)
+        return out if with_agg_stats else out[:6]
 
-    @partial(jax.jit, static_argnums=(0, 10, 11), donate_argnums=(1, 2))
+    @partial(jax.jit, static_argnums=(0, 10, 11), donate_argnums=(1, 2),
+             static_argnames=("byz_stale",))
     def _train_iteration_eval_jit(self, params, opt_states, iter_key, x, y,
                                   time_w, sample_w, feat_mask, lr_scale,
-                                  R: int, freq: int, t, client_masks=None):
+                                  R: int, freq: int, t, client_masks=None,
+                                  byz_modes=None, *, byz_stale: bool = False):
         """ALL R communication rounds of a time step + every scheduled eval
         as ONE device program.
 
@@ -317,8 +367,8 @@ class TrainStep:
         right after each eval round.
 
         Returns (params, opt_states, n [M, C], losses [M, C],
-        (corr_tr, loss_tr, corr_te, loss_te) each [E, M, C], total [C]) where
-        E = len(eval_rounds(R, freq)).
+        (corr_tr, loss_tr, corr_te, loss_te) each [E, M, C], total [C],
+        agg_stats [R, M, 3]) where E = len(eval_rounds(R, freq)).
         """
         evs = self.eval_rounds(R, freq)
         E = len(evs)
@@ -335,11 +385,16 @@ class TrainStep:
                      jnp.zeros((M, C), jnp.int32), jnp.zeros((M, C), jnp.float32))
 
         def one(carry, rx):
-            r, cm = rx
-            p, o, bufs = carry
+            r, cm, bz = rx
+            if byz_stale:
+                p, o, bufs, stale = carry
+            else:
+                p, o, bufs = carry
+                stale = None
             key = jax.random.fold_in(iter_key, r)
-            p, o, _cp, n, losses = self._round_body(
-                p, o, key, x, y, time_w, sample_w, feat_mask, lr_scale, cm)
+            p, o, cp, n, losses, agg_stats = self._round_body(
+                p, o, key, x, y, time_w, sample_w, feat_mask, lr_scale, cm,
+                bz, stale)
 
             is_eval = ((r % freq) == 0) | (r == R - 1)
             slot = jnp.where(r == R - 1, E - 1, r // freq)
@@ -355,15 +410,25 @@ class TrainStep:
                           jax.lax.dynamic_update_index_in_dim(b, m, slot, 0),
                           b)
                 for b, m in zip(bufs, mats))
-            return (p, o, bufs), (n, losses)
+            out_carry = ((p, o, bufs, cp) if byz_stale else (p, o, bufs))
+            return out_carry, (n, losses, agg_stats)
 
         bufs0 = tuple(jnp.zeros((E, M, C), d) for d in
                       (jnp.int32, jnp.float32, jnp.int32, jnp.float32))
-        (params, opt_states, bufs), (ns, ls) = jax.lax.scan(
-            one, (params, opt_states, bufs0),
-            (jnp.arange(R, dtype=jnp.int32), client_masks))
+        carry0 = (params, opt_states, bufs0)
+        if byz_stale:
+            # round 0's stale replay degenerates to "re-send the broadcast
+            # params" (a zero update) — there is no earlier submission
+            stale0 = jax.tree_util.tree_map(
+                lambda l: jnp.broadcast_to(
+                    l[:, None], (l.shape[0], C, *l.shape[1:])), params)
+            carry0 = carry0 + (stale0,)
+        carry, (ns, ls, stats) = jax.lax.scan(
+            one, carry0,
+            (jnp.arange(R, dtype=jnp.int32), client_masks, byz_modes))
+        params, opt_states, bufs = carry[0], carry[1], carry[2]
         total = jnp.full((C,), x.shape[2], dtype=jnp.int32)
-        return params, opt_states, ns[-1], ls[-1], bufs, total
+        return params, opt_states, ns[-1], ls[-1], bufs, total, stats
 
     # ------------------------------------------------------------------
     def acc_matrix(self, params, x, y, feat_mask):

@@ -71,6 +71,12 @@ EVENT_KINDS = frozenset({
     "client_killed",        # permanent kill
     "client_revived",
     "failure_suspected",    # detector's suspect set changed
+    # adversary model / robust aggregation (platform/faults.py,
+    # resilience/robust_agg.py, simulation/runner.py)
+    "byzantine_injected",   # scheduled attackers active this round
+    "robust_agg_applied",   # per-round robust-aggregation stats
+    "acc_stale_excluded",   # stale acc entries dropped from a cluster decision
+    "quorum_revive",        # quorum floor revived a client (not real liveness)
 })
 
 RING_SIZE = 4096

@@ -29,6 +29,8 @@ FAULT_KINDS = ("fault_injected", "client_killed", "client_revived",
 RESILIENCE_KINDS = ("conn_reconnect", "publish_retry", "heartbeat_missed",
                     "chaos_injected", "preempt_checkpoint",
                     "divergence_detected", "checkpoint_corrupt")
+ROBUSTNESS_KINDS = ("byzantine_injected", "robust_agg_applied",
+                    "acc_stale_excluded", "quorum_revive")
 
 
 def _load_jsonl(path: str) -> list[dict]:
@@ -150,6 +152,41 @@ def summarize(run_dir: str) -> dict[str, Any]:
             res["preempted_at_iteration"] = pre[-1].get("iteration")
         out["resilience"] = res
 
+    # -- robustness ------------------------------------------------------
+    # adversary schedule / robust aggregation / staleness exclusions
+    # (platform/faults.py::ByzantineInjector, resilience/robust_agg.py)
+    byz = [e for e in events if e["kind"] == "byzantine_injected"]
+    ragg = [e for e in events if e["kind"] == "robust_agg_applied"]
+    stale = [e for e in events if e["kind"] == "acc_stale_excluded"]
+    qrev = [e for e in events if e["kind"] == "quorum_revive"]
+    if byz or ragg or stale or qrev:
+        rob: dict[str, Any] = {}
+        if byz:
+            attackers: set[int] = set()
+            for e in byz:
+                attackers.update(e.get("clients", []))
+            rob["byzantine"] = {
+                "rounds": len(byz),
+                "clients": sorted(attackers),
+                "modes": sorted({e.get("mode", "?") for e in byz}),
+            }
+        if ragg:
+            rob["aggregation"] = {
+                "strategy": ragg[-1].get("strategy"),
+                "rounds": len(ragg),
+                "rejected_total": sum(e.get("rejected", 0) for e in ragg),
+                "clipped_total": sum(e.get("clipped", 0) for e in ragg),
+            }
+        if stale:
+            rob["stale_exclusions"] = {
+                "events": len(stale),
+                "decisions": sorted({e.get("decision", "?") for e in stale}),
+                "changed_decisions": sum(1 for e in stale if e.get("changed")),
+            }
+        if qrev:
+            rob["quorum_revives"] = len(qrev)
+        out["robustness"] = rob
+
     # -- compiles --------------------------------------------------------
     compiles = [e for e in events if e["kind"] in ("jit_compile",
                                                    "jit_recompile")]
@@ -244,6 +281,27 @@ def render(summary: dict[str, Any]) -> str:
         if "preempted_at_iteration" in res:
             L.append(f"  preempted at iteration "
                      f"{res['preempted_at_iteration']} (resumable)")
+
+    rob = summary.get("robustness")
+    if rob:
+        L.append("")
+        L.append("robustness:")
+        b = rob.get("byzantine")
+        if b:
+            L.append(f"  byzantine: {b['rounds']} attacked rounds, "
+                     f"clients {b['clients']}, modes {b['modes']}")
+        a = rob.get("aggregation")
+        if a:
+            L.append(f"  robust agg: {a['strategy']} over {a['rounds']} "
+                     f"rounds, rejected={a['rejected_total']} "
+                     f"clipped={a['clipped_total']}")
+        s = rob.get("stale_exclusions")
+        if s:
+            L.append(f"  stale acc exclusions: {s['events']} "
+                     f"({s['changed_decisions']} changed a decision; "
+                     f"decisions: {s['decisions']})")
+        if rob.get("quorum_revives"):
+            L.append(f"  quorum revives: {rob['quorum_revives']}")
 
     comp = summary.get("compiles")
     if comp:

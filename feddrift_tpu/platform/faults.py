@@ -17,17 +17,34 @@ This module makes that story testable and observable:
 - ``FailureDetector`` watches the observed per-round participation and flags
   clients absent ``patience`` consecutive rounds — the analog of a heartbeat
   timeout detector for the reference's hanging barrier, but non-blocking.
+- ``ByzantineInjector`` schedules deterministic per-round ATTACKS (not
+  crashes) for a configured client subset: sign-flip, scale-by-λ, Gaussian
+  noise, stale replay of the client's previous submission, label flipping
+  at the data layer. The schedule is host-side ([C] int mode vectors);
+  the corruption itself (``apply_byzantine_updates``) is pure array math
+  applied to the ``[M, C, ...]`` update stack inside the jitted round
+  program, composing with dropout/outage masks and whichever
+  ``cfg.robust_agg`` strategy defends the aggregation.
 
-Both are host-side and O(C) per round; the device program is untouched — the
-injector's mask multiplies into the same participation mask used by client
-subsampling (simulation/runner.py::_client_masks).
+All schedulers are host-side and O(C) per round; the device program sees
+only masks/mode vectors — the injector's mask multiplies into the same
+participation mask used by client subsampling
+(simulation/runner.py::_client_masks).
 """
 
 from __future__ import annotations
 
+import jax
+import jax.numpy as jnp
 import numpy as np
 
 from feddrift_tpu import obs
+
+# Attack-mode codes shared between the host scheduler and the device
+# transform. 0 = honest. label_flip is applied at the DATA layer
+# (core/step.py flips the training labels), not to the update.
+BYZ_MODES = {"sign_flip": 1, "scale": 2, "gauss": 3, "stale_replay": 4,
+             "label_flip": 5}
 
 
 class FaultInjector:
@@ -158,3 +175,99 @@ class FailureDetector:
             "suspected": self.suspected.tolist(),
             "max_absent_streak": int(self.absent_streak.max(initial=0)),
         }
+
+
+class ByzantineInjector:
+    """Deterministic per-round adversary schedules for a fixed client subset.
+
+    Seed/round-indexed like ``FaultInjector`` so runs are bitwise
+    reproducible and resumable, and so the fused multi-round device program
+    can precompute a whole iteration's ``[R, C]`` schedule up front. Each
+    configured attacker is active in a round independently with
+    probability ``prob`` (1.0 = every round).
+    """
+
+    def __init__(self, num_clients: int, clients, mode: str = "sign_flip",
+                 prob: float = 1.0, seed: int = 0) -> None:
+        if mode not in BYZ_MODES:
+            raise ValueError(f"unknown byzantine mode {mode!r}; "
+                             f"available: {sorted(BYZ_MODES)}")
+        if not 0.0 <= prob <= 1.0:
+            raise ValueError(f"byzantine prob must be in [0, 1], got {prob}")
+        self.C = num_clients
+        self.clients = np.unique(np.asarray(list(clients), dtype=int))
+        if self.clients.size and (self.clients.min() < 0
+                                  or self.clients.max() >= num_clients):
+            raise ValueError(f"byzantine clients {self.clients.tolist()} "
+                             f"out of range [0, {num_clients})")
+        self.mode = mode
+        self.code = BYZ_MODES[mode]
+        self.p = prob
+        self.seed = seed
+
+    @property
+    def has_stale(self) -> bool:
+        """True if the round program must carry last round's submissions."""
+        return self.mode == "stale_replay"
+
+    def modes(self, round_idx: int) -> np.ndarray:
+        """[C] int32 attack-mode vector for one global round (0 = honest).
+        Emits one ``byzantine_injected`` event per round with attackers."""
+        out = np.zeros(self.C, dtype=np.int32)
+        if not self.clients.size:
+            return out
+        active = self.clients
+        if self.p < 1.0:
+            rng = np.random.RandomState(
+                (self.seed * 2_000_003 + round_idx) % (2 ** 31 - 1))
+            active = self.clients[rng.random_sample(self.clients.size)
+                                  < self.p]
+        out[active] = self.code
+        if active.size:
+            obs.emit("byzantine_injected", byz_round=int(round_idx),
+                     clients=active.tolist(), mode=self.mode)
+            obs.registry().counter("byzantine_injections",
+                                   mode=self.mode).inc(int(active.size))
+        return out
+
+    def schedule(self, rounds) -> np.ndarray:
+        """[len(rounds), C] stacked mode vectors (fused-path precompute)."""
+        return np.stack([self.modes(int(r)) for r in rounds])
+
+
+def apply_byzantine_updates(client_params, global_params, modes,
+                            stale_params, key, scale, std):
+    """Corrupt the submitted update stack according to per-client modes.
+
+    client_params: pytree with leading ``[M, C]`` (what honest clients
+    computed); global_params: leading ``[M]`` (the round's broadcast
+    params); modes: ``[C]`` int32 from ``ByzantineInjector``;
+    stale_params: same shape as client_params holding each client's
+    PREVIOUS submission (required only when mode ``stale_replay`` can
+    occur), or None. Pure/traceable — runs inside the jitted round program,
+    vectorized over clients.
+
+    Attacks transform the update ``delta = local - global``:
+    sign_flip → ``-scale * delta``; scale → ``scale * delta``; gauss →
+    ``N(0, std)`` replaces the update; stale_replay → the client re-sends
+    its previous submission. ``label_flip`` is a data-layer attack handled
+    before training (core/step.py) and leaves the update untouched here.
+    """
+    leaves, treedef = jax.tree_util.tree_flatten(client_params)
+    gleaves = jax.tree_util.tree_leaves(global_params)
+    sleaves = (jax.tree_util.tree_leaves(stale_params)
+               if stale_params is not None else [None] * len(leaves))
+    keys = jax.random.split(key, len(leaves))
+    out = []
+    for leaf, g, s, k in zip(leaves, gleaves, sleaves, keys):
+        m = modes.reshape((1, -1) + (1,) * (leaf.ndim - 2))
+        delta = leaf - g[:, None]
+        nd = jnp.where(m == BYZ_MODES["sign_flip"], -scale * delta, delta)
+        nd = jnp.where(m == BYZ_MODES["scale"], scale * delta, nd)
+        noise = jax.random.normal(k, leaf.shape, leaf.dtype) * std
+        nd = jnp.where(m == BYZ_MODES["gauss"], noise, nd)
+        if s is not None:
+            nd = jnp.where(m == BYZ_MODES["stale_replay"],
+                           s - g[:, None], nd)
+        out.append(g[:, None] + nd)
+    return jax.tree_util.tree_unflatten(treedef, out)

@@ -1,4 +1,4 @@
-"""Robust aggregation: norm-diff clipping + weak-DP Gaussian noise.
+"""Robust-aggregation primitives: norm-diff clipping + weak-DP noise.
 
 Re-design of ``RobustAggregator``
 (fedml_core/robustness/robust_aggregation.py:32-55) and its use in
@@ -6,6 +6,14 @@ Re-design of ``RobustAggregator``
 one pickled state_dict at a time on CPU, the whole [C, ...] stack of client
 updates is clipped in one XLA program; the weak-DP noise is added to the
 aggregate under a JAX PRNG key.
+
+.. deprecated::
+    Direct use of this module is a legacy path. These primitives are
+    registered in ``feddrift_tpu.resilience.robust_agg`` as the
+    ``norm_clip`` strategy (composable with every other defense and
+    selectable per-run via ``cfg.robust_agg``); ``robust_fedavg`` below is
+    a thin wrapper over that registry kept for API compatibility. New code
+    should go through ``robust_agg.aggregate`` / ``cfg.robust_agg``.
 
 BatchNorm statistics are excluded from the clipped vector in the reference
 (is_weight_param, :28-29); flax keeps running stats outside ``params``, so
@@ -56,11 +64,16 @@ def robust_fedavg(client_params, global_params, n, key, norm_bound, stddev):
     """Full robust round: clip per-client diffs, weighted-average, add noise.
 
     client_params: [C, ...]; n: [C] sample counts; returns aggregated params.
+    One registered strategy, not a parallel code path: delegates to the
+    ``robust_agg`` registry's ``norm_clip`` math (lifted over a singleton
+    cluster axis), then composes the weak-DP noise — the same pipeline
+    ``cfg.robust_agg='norm_clip'`` runs inside the round program.
     """
-    clipped = clip_client_updates(client_params, global_params, norm_bound)
-    w = n / jnp.maximum(n.sum(), 1e-12)
-    def avg(leaf):
-        wb = w.reshape((-1,) + (1,) * (leaf.ndim - 1))
-        return (leaf * wb).sum(axis=0)
-    agg = jax.tree_util.tree_map(avg, clipped)
-    return add_weak_dp_noise(agg, key, stddev)
+    from feddrift_tpu.resilience.robust_agg import (norm_clip_stack,
+                                                    weighted_mean)
+    lift = jax.tree_util.tree_map
+    cp = lift(lambda l: l[None], client_params)          # [1, C, ...]
+    gp = lift(lambda l: l[None], global_params)          # [1, ...]
+    clipped, _ = norm_clip_stack(cp, gp, norm_bound)
+    agg = weighted_mean(clipped, n[None], gp)
+    return add_weak_dp_noise(lift(lambda l: l[0], agg), key, stddev)

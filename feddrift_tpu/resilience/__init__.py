@@ -17,14 +17,27 @@ event bus (`feddrift_tpu/obs/`):
 - **numeric** (`divergence`): ``DivergenceGuard`` — NaN/Inf and
   loss-spike detection on the fetched round losses, rollback to the
   pre-round pool params, abort after K consecutive rollbacks.
+- **adversarial** (`robust_agg`): a registry of Byzantine-tolerant
+  per-cluster aggregators (median, trimmed mean, Krum/multi-Krum,
+  norm clipping, weak-DP noise) over the ``[M, C, ...]`` update stack,
+  compiled into the round's XLA program and selected via
+  ``cfg.robust_agg``; pairs with
+  ``platform/faults.py::ByzantineInjector`` attack schedules.
 
 Event kinds emitted here: ``conn_reconnect``, ``publish_retry``,
 ``heartbeat_missed``, ``chaos_injected``, ``preempt_checkpoint``,
 ``divergence_detected`` (plus ``checkpoint_corrupt`` from the checkpoint
-store). See docs/RESILIENCE.md for the operator runbook.
+store and ``robust_agg_applied``/``byzantine_injected`` surfaced by the
+runner/injector). See docs/RESILIENCE.md for the operator runbook and
+threat model.
 """
 
 from feddrift_tpu.resilience.chaos import ChaosBroker, ChaosPolicy  # noqa: F401
+from feddrift_tpu.resilience.robust_agg import (  # noqa: F401
+    RobustAggConfig,
+    aggregate,
+    available_aggregators,
+)
 from feddrift_tpu.resilience.divergence import (  # noqa: F401
     DivergenceError,
     DivergenceGuard,

@@ -1,0 +1,259 @@
+"""Pluggable robust aggregation over the ``[M, C, ...]`` client-update stack.
+
+FedDrift's aggregation is trusting by construction: one corrupted client
+update poisons the weighted average of its whole cluster. This module is a
+registry of Byzantine-tolerant aggregators, each expressed as pure array
+math over the stacked client axis so the whole per-cluster decision runs
+inside the round's single XLA program (``core/step.py::_round_body``) — no
+per-client host loop, no extra dispatch.
+
+Strategies (selected via ``cfg.robust_agg``):
+
+    mean          sample-weighted FedAvg — bitwise-identical to the
+                  pre-registry inline aggregation (the default)
+    median        coordinate-wise median over the ACTIVE clients
+    trimmed_mean  coordinate-wise mean after dropping the
+                  ``floor(trim_frac * k)`` lowest and highest active values
+    krum          Krum: the single update closest to its q nearest
+                  neighbours (q = k - f - 2), f = ``robust_krum_f``
+    multi_krum    uniform mean of the k - f best-scored updates
+    norm_clip     per-client norm-diff clipping (platform/robust.py
+                  primitives, de-islanded here) + weighted mean
+
+Every strategy is masked: clients with aggregation weight ``n == 0``
+(non-participants, dropouts, phantom padding, suspected-dead exclusions)
+never influence the output — median/trimmed/Krum sort them out of the
+active set rather than averaging in zeros, and a cluster with no active
+client keeps its previous parameters. Weak-DP Gaussian noise
+(``robust_dp_stddev``) composes with every strategy, applied to the
+aggregate exactly as ``platform.robust.add_weak_dp_noise`` always did.
+
+Each call also returns a ``[M, 3]`` float stats matrix — per cluster
+``(active, rejected, clipped)`` — which the runner surfaces as
+``robust_agg_applied`` events.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclass(frozen=True)
+class RobustAggConfig:
+    """Static (hashable) per-run aggregator knobs, carried on TrainStep so
+    the jitted round program specializes on them."""
+
+    trim_frac: float = 0.2    # fraction trimmed from EACH end (trimmed_mean)
+    krum_f: int = 1           # assumed Byzantine count f (krum/multi_krum)
+    clip_norm: float = 1.0    # L2 bound on per-client diffs (norm_clip)
+    dp_stddev: float = 0.0    # weak-DP Gaussian noise on the aggregate
+
+
+AggregatorFn = Callable  # (client_params, n, prev_params, key, rcfg) -> (agg, stats)
+
+_REGISTRY: dict[str, AggregatorFn] = {}
+
+
+def register_aggregator(name: str):
+    def deco(fn: AggregatorFn) -> AggregatorFn:
+        _REGISTRY[name] = fn
+        return fn
+    return deco
+
+
+def available_aggregators() -> list[str]:
+    return sorted(_REGISTRY)
+
+
+def aggregate(name: str, client_params, n, prev_params, key, rcfg):
+    """Dispatch one per-cluster robust aggregation.
+
+    client_params: pytree with leading ``[M, C]``; n: ``[M, C]`` weights
+    (0 = masked out); prev_params: pytree with leading ``[M]`` (fallback
+    for clusters with no active client). Returns ``(new_params [M, ...],
+    stats [M, 3])`` with stats columns (active, rejected, clipped).
+    Pure/traceable — meant to be called INSIDE the jitted round program.
+    """
+    if name not in _REGISTRY:
+        raise KeyError(f"unknown robust_agg {name!r}; "
+                       f"available: {available_aggregators()}")
+    agg, stats = _REGISTRY[name](client_params, n, prev_params, key, rcfg)
+    if rcfg.dp_stddev > 0.0:
+        from feddrift_tpu.platform.robust import add_weak_dp_noise
+        agg = add_weak_dp_noise(agg, key, rcfg.dp_stddev)
+    return agg, stats
+
+
+# ----------------------------------------------------------------------
+# shared pieces
+def weighted_mean(client_params, w, prev_params):
+    """Masked weighted mean over the client axis — the historical inline
+    aggregation of ``_round_body``, kept operation-for-operation identical
+    so default runs stay bitwise-reproducible."""
+    denom = w.sum(axis=1)                              # [M]
+    w_norm = w / jnp.maximum(denom[:, None], 1e-12)    # [M, C]
+
+    def avg(leaf_mc, leaf_m):
+        wb = w_norm.reshape(w_norm.shape + (1,) * (leaf_mc.ndim - 2))
+        agg = (leaf_mc * wb).sum(axis=1)
+        keep = (denom > 0).reshape((-1,) + (1,) * (leaf_m.ndim - 1))
+        return jnp.where(keep, agg, leaf_m)
+
+    return jax.tree_util.tree_map(avg, client_params, prev_params)
+
+
+def _active_counts(n):
+    """(active mask [M, C] bool, per-cluster active count k [M] int32)."""
+    act = n > 0
+    return act, act.sum(axis=1).astype(jnp.int32)
+
+
+def _stats(k, rejected=None, clipped=None):
+    z = jnp.zeros_like(k)
+    return jnp.stack([k, z if rejected is None else rejected,
+                      z if clipped is None else clipped],
+                     axis=1).astype(jnp.float32)
+
+
+def _sorted_active(leaf_mc, act):
+    """Sort along the client axis with masked rows pushed to +inf, so the
+    first k positions of every coordinate hold exactly the active values."""
+    big = jnp.where(act.reshape(act.shape + (1,) * (leaf_mc.ndim - 2)),
+                    leaf_mc, jnp.inf)
+    return jnp.sort(big, axis=1)
+
+
+def _flatten_clients(client_params):
+    """[M, C, P] matrix of flattened per-client updates."""
+    leaves = jax.tree_util.tree_leaves(client_params)
+    M, C = leaves[0].shape[:2]
+    return jnp.concatenate([l.reshape(M, C, -1) for l in leaves], axis=2)
+
+
+# ----------------------------------------------------------------------
+@register_aggregator("mean")
+def agg_mean(client_params, n, prev_params, key, rcfg):
+    act, k = _active_counts(n)
+    return weighted_mean(client_params, n, prev_params), _stats(k)
+
+
+@register_aggregator("median")
+def agg_median(client_params, n, prev_params, key, rcfg):
+    """Coordinate-wise median of the active rows (even k averages the two
+    middle order statistics)."""
+    act, k = _active_counts(n)
+    lo_i = jnp.maximum((k - 1) // 2, 0)
+    hi_i = jnp.maximum(k // 2, 0)
+
+    def med(leaf_mc, leaf_m):
+        srt = _sorted_active(leaf_mc, act)
+        shp = (-1, 1) + (1,) * (leaf_mc.ndim - 2)
+        lo = jnp.take_along_axis(srt, lo_i.reshape(shp), axis=1)[:, 0]
+        hi = jnp.take_along_axis(srt, hi_i.reshape(shp), axis=1)[:, 0]
+        out = (lo + hi) * 0.5
+        keep = (k > 0).reshape((-1,) + (1,) * (leaf_m.ndim - 1))
+        return jnp.where(keep, out, leaf_m)
+
+    agg = jax.tree_util.tree_map(med, client_params, prev_params)
+    used = jnp.where(k > 0, 2 - (k % 2), 0)
+    return agg, _stats(k, rejected=jnp.maximum(k - used, 0))
+
+
+@register_aggregator("trimmed_mean")
+def agg_trimmed_mean(client_params, n, prev_params, key, rcfg):
+    """Coordinate-wise mean over the active rows after dropping the
+    ``floor(trim_frac * k)`` smallest and largest values per coordinate."""
+    act, k = _active_counts(n)
+    C = n.shape[1]
+    t = jnp.clip(jnp.floor(rcfg.trim_frac * k).astype(jnp.int32),
+                 0, jnp.maximum((k - 1) // 2, 0))
+    pos = jnp.arange(C)[None, :]                       # [1, C]
+    posw = (pos >= t[:, None]) & (pos < (k - t)[:, None])   # [M, C]
+    cnt = jnp.maximum(k - 2 * t, 1).astype(jnp.float32)
+
+    def tmean(leaf_mc, leaf_m):
+        srt = _sorted_active(leaf_mc, act)
+        pw = posw.reshape(posw.shape + (1,) * (leaf_mc.ndim - 2))
+        s = jnp.where(pw, srt, 0.0).sum(axis=1)
+        out = s / cnt.reshape((-1,) + (1,) * (leaf_mc.ndim - 2))
+        keep = (k > 0).reshape((-1,) + (1,) * (leaf_m.ndim - 1))
+        return jnp.where(keep, out, leaf_m)
+
+    agg = jax.tree_util.tree_map(tmean, client_params, prev_params)
+    return agg, _stats(k, rejected=2 * t)
+
+
+def _krum_selection_weights(client_params, n, f: int, m_sel):
+    """[M, C] 0/1 selection of the ``m_sel`` best Krum-scored active
+    clients. score_i = sum of squared distances to the q = k - f - 2
+    nearest ACTIVE neighbours; masked rows score +inf and are never
+    neighbours."""
+    act, k = _active_counts(n)
+    C = n.shape[1]
+    flat = _flatten_clients(client_params)              # [M, C, P]
+    sq = jnp.sum(flat * flat, axis=2)                   # [M, C]
+    G = jnp.einsum("mcp,mdp->mcd", flat, flat)          # [M, C, C]
+    d2 = jnp.maximum(sq[:, :, None] + sq[:, None, :] - 2.0 * G, 0.0)
+    pair = act[:, :, None] & act[:, None, :] & ~jnp.eye(C, dtype=bool)[None]
+    d2 = jnp.where(pair, d2, jnp.inf)
+    srt = jnp.sort(d2, axis=2)                          # [M, C, C]
+    q = jnp.clip(k - f - 2, 1, C - 1)                   # [M]
+    csum = jnp.cumsum(jnp.where(jnp.isfinite(srt), srt, 0.0), axis=2)
+    qidx = jnp.broadcast_to((q - 1)[:, None, None], (q.shape[0], C, 1))
+    score = jnp.take_along_axis(csum, qidx, axis=2)[..., 0]   # [M, C]
+    score = jnp.where(act, score, jnp.inf)
+    rank = jnp.argsort(jnp.argsort(score, axis=1), axis=1)
+    return ((rank < m_sel[:, None]) & act).astype(jnp.float32), k
+
+
+@register_aggregator("krum")
+def agg_krum(client_params, n, prev_params, key, rcfg):
+    m_sel = jnp.ones((n.shape[0],), jnp.int32)          # exactly one winner
+    selw, k = _krum_selection_weights(client_params, n, rcfg.krum_f, m_sel)
+    agg = weighted_mean(client_params, selw, prev_params)
+    return agg, _stats(k, rejected=jnp.maximum(k - 1, 0))
+
+
+@register_aggregator("multi_krum")
+def agg_multi_krum(client_params, n, prev_params, key, rcfg):
+    _, k0 = _active_counts(n)
+    m_sel = jnp.clip(k0 - rcfg.krum_f, 1, jnp.maximum(k0, 1))
+    selw, k = _krum_selection_weights(client_params, n, rcfg.krum_f, m_sel)
+    agg = weighted_mean(client_params, selw, prev_params)
+    return agg, _stats(k, rejected=jnp.maximum(k - m_sel, 0))
+
+
+def norm_clip_stack(client_params, prev_params, bound):
+    """w_t + clipped(w_local - w_t) over the ``[M, C, ...]`` stack — the
+    ``platform.robust.clip_client_updates`` math lifted one axis. Returns
+    (clipped stack, per-client diff norms [M, C])."""
+    leaves = jax.tree_util.tree_leaves(client_params)
+    gleaves = jax.tree_util.tree_leaves(prev_params)
+    norm2 = sum(jnp.sum(jnp.square(l - g[:, None]),
+                        axis=tuple(range(2, l.ndim)))
+                for l, g in zip(leaves, gleaves))        # [M, C]
+    norm = jnp.sqrt(norm2)
+    scale = 1.0 / jnp.maximum(1.0, norm / bound)         # [M, C]
+
+    def clip(leaf_mc, leaf_m):
+        sb = scale.reshape(scale.shape + (1,) * (leaf_mc.ndim - 2))
+        return leaf_m[:, None] + (leaf_mc - leaf_m[:, None]) * sb
+
+    return jax.tree_util.tree_map(clip, client_params, prev_params), norm
+
+
+@register_aggregator("norm_clip")
+def agg_norm_clip(client_params, n, prev_params, key, rcfg):
+    """The de-islanded ``robust_fedavg``: clip per-client diffs to
+    ``clip_norm``, then sample-weighted mean (weak-DP noise composes via
+    ``aggregate``)."""
+    act, k = _active_counts(n)
+    clipped, norm = norm_clip_stack(client_params, prev_params,
+                                    rcfg.clip_norm)
+    agg = weighted_mean(clipped, n, prev_params)
+    n_clipped = (act & (norm > rcfg.clip_norm)).sum(axis=1)
+    return agg, _stats(k, clipped=n_clipped)

@@ -60,6 +60,7 @@ class Experiment:
         self.mesh = mesh if mesh is not None else make_mesh()
         self.pool = ModelPool.create(self.module, _sample_input(self.ds),
                                      cfg.num_models, seed=cfg.seed + 42)
+        from feddrift_tpu.resilience.robust_agg import RobustAggConfig
         self.step = TrainStep(
             apply_fn=self._make_apply(),
             optimizer=make_optimizer(cfg.client_optimizer, cfg.lr, cfg.wd),
@@ -71,6 +72,15 @@ class Experiment:
             # flattened-categorical batch draw entirely.
             weighted_sampling=algorithm_class(
                 cfg.concept_drift_algo).uses_sample_weights,
+            # Static: the per-cluster aggregation strategy closing every
+            # round (resilience/robust_agg.py; "mean" = historical FedAvg).
+            robust_agg=cfg.robust_agg,
+            robust_cfg=RobustAggConfig(
+                trim_frac=cfg.robust_trim_frac, krum_f=cfg.robust_krum_f,
+                clip_norm=cfg.robust_clip_norm,
+                dp_stddev=cfg.robust_dp_stddev),
+            byz_scale=cfg.byzantine_scale,
+            byz_std=cfg.byzantine_std,
         )
         # Device-resident dataset, client axis sharded over the mesh. The
         # client axis is padded to a multiple of the mesh size with phantom
@@ -113,13 +123,26 @@ class Experiment:
             os.path.join(out_dir, "events.jsonl")
             if (out_dir and self.is_coordinator) else None)
         self.algo.bind(self.x, self.y, self.logger, self.C_pad)
-        from feddrift_tpu.platform.faults import FailureDetector, FaultInjector
+        from feddrift_tpu.platform.faults import (ByzantineInjector,
+                                                  FailureDetector,
+                                                  FaultInjector)
         self.fault_injector = (
             FaultInjector(self.C_, cfg.fault_dropout_prob, cfg.fault_seed)
             if (cfg.fault_dropout_prob > 0 or cfg.fault_enabled) else None)
         self.failure_detector = (
             FailureDetector(self.C_, cfg.failure_patience)
             if self.fault_injector is not None else None)
+        byz_clients = cfg.byzantine_client_list
+        self.byzantine = (
+            ByzantineInjector(self.C_, byz_clients, mode=cfg.byzantine_mode,
+                              prob=cfg.byzantine_prob,
+                              seed=cfg.byzantine_seed)
+            if byz_clients else None)
+        # robust_agg_applied events only when a defense is actually on —
+        # plain "mean" runs keep their historical event stream.
+        self._robust_active = (cfg.robust_agg != "mean"
+                               or cfg.robust_dp_stddev > 0)
+        self._byz_stale = None   # last round's client submissions (stale_replay)
         self.key = experiment_key(cfg.seed)
         self.global_round = 0
         self.start_iteration = 0
@@ -291,6 +314,17 @@ class Experiment:
             # the time step changes the training window/concept: losses
             # legitimately re-spike, so the spike baseline starts fresh
             self.divergence_guard.new_window()
+        # stale_replay attacks replay submissions WITHIN a time step; the
+        # iteration boundary (fresh optimizers, possibly re-clustered pool)
+        # resets the replay buffer like it resets the optimizer states
+        self._byz_stale = None
+        if self.failure_detector is not None:
+            # Hand the clustering layer each client's absence age + the
+            # current suspect set BEFORE its create/merge decisions, so
+            # stale accuracy entries can be excluded (cfg.acc_staleness_limit)
+            self.algo.set_client_staleness(
+                self.failure_detector.absent_streak,
+                self.failure_detector.suspected)
         with self.tracer.phase("cluster"):   # drift detection / clustering
             self.algo.begin_iteration(t)
         if cfg.debug_checks:
@@ -373,9 +407,25 @@ class Experiment:
                 sel = np.arange(self.C_)
                 masks[i, : self.C_] = 1.0
             if self.fault_injector is not None:
-                fault_mask = self.fault_injector.mask(
-                    t * cfg.comm_round + int(r))
+                fault_round = t * cfg.comm_round + int(r)
+                fault_mask = self.fault_injector.mask(fault_round)
                 masks[i, : self.C_] *= fault_mask
+                # The detector sees GENUINE liveness — the pre-quorum-floor
+                # mask — and only *failures*, not non-selection: sampled
+                # clients give a liveness signal, unsampled clients keep
+                # their streak unchanged. A quorum revival below is a
+                # liveness lie (the client was revived BECAUSE everything
+                # dropped), so it must not reset a real outage streak.
+                if self.failure_detector is not None:
+                    observed = np.zeros(self.C_, dtype=bool)
+                    observed[sel] = True
+                    self.failure_detector.observe(
+                        masks[i, : self.C_] > 0, observed)
+                    # Suspected-dead clients carry zero aggregation weight
+                    # when configured; genuine liveness above still clears
+                    # the suspicion the round a client actually returns.
+                    if cfg.exclude_suspected_from_agg:
+                        masks[i, self.failure_detector.suspected] = 0.0
                 # Quorum floor on the COMPOSED mask (faults.py kills are
                 # exempt): if every sampled client dropped, revive the
                 # lowest-index sampled live client so the round is not a
@@ -384,15 +434,10 @@ class Experiment:
                     alive = sel[~self.fault_injector.dead[sel]]
                     if len(alive):
                         masks[i, alive[0]] = 1.0
-                # The detector sees REALIZED participation (post-floor: a
-                # quorum-revived client did train) and only *failures*, not
-                # non-selection: sampled clients give a liveness signal,
-                # unsampled clients keep their streak unchanged.
-                if self.failure_detector is not None:
-                    observed = np.zeros(self.C_, dtype=bool)
-                    observed[sel] = True
-                    self.failure_detector.observe(
-                        masks[i, : self.C_] > 0, observed)
+                        self.events.emit("quorum_revive",
+                                         fault_round=fault_round,
+                                         client=int(alive[0]))
+                        obs.registry().counter("quorum_revives").inc()
         if self.failure_detector is not None:
             self.logger.set_summary("Failures/suspected",
                                     self.failure_detector.suspected.tolist())
@@ -422,22 +467,69 @@ class Experiment:
                     reason, self.global_round)
         return True
 
+    def _byz_modes(self, rounds, t: int) -> "np.ndarray | None":
+        """[len(rounds), C_pad] int32 attack schedule (phantom clients are
+        honest), or None without an adversary."""
+        if self.byzantine is None:
+            return None
+        sched = self.byzantine.schedule(
+            [t * self.cfg.comm_round + int(r) for r in rounds])
+        out = np.zeros((len(rounds), self.C_pad), dtype=np.int32)
+        out[:, : self.C_] = sched
+        return out
+
+    def _emit_robust_stats(self, agg_stats, round_idx: int) -> None:
+        """One robust_agg_applied event per round from the device's [M, 3]
+        (active, rejected, clipped) stats."""
+        s = np.asarray(agg_stats)
+        rejected, clipped = int(s[:, 1].sum()), int(s[:, 2].sum())
+        self.events.emit(
+            "robust_agg_applied", round=round_idx,
+            strategy=self.cfg.robust_agg,
+            active=s[:, 0].astype(int).tolist(),
+            rejected=rejected, clipped=clipped)
+        reg = obs.registry()
+        reg.counter("robust_rejected_updates",
+                    strategy=self.cfg.robust_agg).inc(rejected)
+        reg.counter("robust_clipped_updates",
+                    strategy=self.cfg.robust_agg).inc(clipped)
+
     def _run_rounds(self, t: int, opt_states) -> None:
         """Per-round host loop: algorithms that steer every round."""
         cfg = self.cfg
+        byz = self.byzantine
+        if byz is not None and byz.has_stale and self._byz_stale is None:
+            # seed the replay buffer with "no update" submissions so the
+            # first round's jit signature matches the later rounds'
+            self._byz_stale = jax.tree_util.tree_map(
+                lambda l: jnp.broadcast_to(
+                    l[:, None], (l.shape[0], self.C_pad, *l.shape[1:])),
+                self.pool.params)
+        keep_cp = self.algo.needs_client_params or (
+            byz is not None and byz.has_stale)
         for r in range(cfg.comm_round):
             self.events.set_context(round=self.global_round)
             tw, sw, fm, lr_scale = self.algo.round_inputs(t, r)
             tw = self._pad_clients(tw)                  # phantom clients: w=0
             sw = self._pad_clients(sw, value=1.0)
             cm = self._client_masks(t, [r])
+            bm = self._byz_modes([r], t)
             prev_params = self.pool.params
             with self.tracer.phase("train_round"):
-                new_params, opt_states, client_params, n, losses = self.step.train_round(
-                    prev_params, opt_states, round_key(self.key, t, r),
-                    self.x, self.y, tw, sw, fm, lr_scale,
-                    None if cm is None else jnp.asarray(cm[0]),
-                    keep_client_params=self.algo.needs_client_params)
+                new_params, opt_states, client_params, n, losses, agg_stats = \
+                    self.step.train_round(
+                        prev_params, opt_states, round_key(self.key, t, r),
+                        self.x, self.y, tw, sw, fm, lr_scale,
+                        None if cm is None else jnp.asarray(cm[0]),
+                        None if bm is None else jnp.asarray(bm[0]),
+                        self._byz_stale if (byz is not None and byz.has_stale)
+                        else None,
+                        keep_client_params=keep_cp, with_agg_stats=True)
+                if byz is not None and byz.has_stale:
+                    self._byz_stale = client_params
+                if self._robust_active:
+                    self._emit_robust_stats(
+                        multihost.fetch(agg_stats), self.global_round)
                 if cfg.trace_sync:
                     # attribute device time to this phase instead of letting
                     # async dispatch spill it into whichever phase blocks next
@@ -524,6 +616,8 @@ class Experiment:
             t_idx = t
         g0 = self.global_round
         cms = self._client_masks(t, range(R))
+        bms = self._byz_modes(range(R), t)
+        byz_stale = self.byzantine is not None and self.byzantine.has_stale
         # The fused program DONATES its params input (HBM economy), so the
         # divergence rollback target must live on host: a numpy snapshot of
         # the iteration-start pool — the same D2H the default per-iteration
@@ -531,11 +625,18 @@ class Experiment:
         host_prev = (jax.tree_util.tree_map(np.asarray, self.pool.params)
                      if self.divergence_guard is not None else None)
         with self.tracer.phase("train_round"):
-            new_params, opt_states, n, losses, bufs, total = \
+            new_params, opt_states, n, losses, bufs, total, agg_stats = \
                 self.step.train_iteration_eval(
                     self.pool.params, opt_states, it_key, x, y,
                     tw, sw, fm, lr_scale, R, freq, jnp.int32(t_idx),
-                    None if cms is None else jnp.asarray(cms))
+                    None if cms is None else jnp.asarray(cms),
+                    None if bms is None else jnp.asarray(bms),
+                    byz_stale=byz_stale, with_agg_stats=True)
+            if self._robust_active:
+                # one bulk [R, M, 3] fetch -> one event per fused round
+                for rr, row in enumerate(np.asarray(
+                        multihost.fetch(agg_stats))):
+                    self._emit_robust_stats(row, g0 + rr)
             if cfg.trace_sync:
                 jax.block_until_ready(new_params)
             if self._check_divergence(losses, n):

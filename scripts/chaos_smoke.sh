@@ -10,9 +10,15 @@
 #    mid-run (preemption), then re-launched with --auto_resume; asserts a
 #    clean exit, a preempt_checkpoint event, and a duplicate-free
 #    metrics.jsonl.
-# 3) the event taxonomy stays consistent (check_events_schema).
+# 3) the event taxonomy stays consistent (check_events_schema) — including
+#    the robustness kinds (byzantine_injected, robust_agg_applied,
+#    acc_stale_excluded, quorum_revive).
+# 4) adversary domain — the e2e chaos+Byzantine scenario (10 clients, 20%
+#    dropout, 2 sign-flippers): robust_agg=trimmed_mean stays near the
+#    clean run's accuracy while plain mean degrades more (runs the tier-1
+#    test that encodes exactly that, so the smoke and CI cannot drift).
 #
-# Usage: scripts/chaos_smoke.sh            (~1-2 min on one CPU core)
+# Usage: scripts/chaos_smoke.sh            (~2-3 min on one CPU core)
 set -euo pipefail
 cd "$(dirname "$0")/.."
 export JAX_PLATFORMS=cpu
@@ -21,12 +27,12 @@ OUT=$(mktemp -d)
 trap 'rm -rf "$OUT"' EXIT
 RUN="$OUT/run"
 
-echo "== [1/3] chaos transport e2e (drop_prob=0.2 + broker kill/restart) =="
+echo "== [1/4] chaos transport e2e (drop_prob=0.2 + broker kill/restart) =="
 timeout -k 10 300 python -m pytest tests/test_resilience.py -q \
     -p no:cacheprovider -p no:randomly \
     -k "ChaosEndToEnd or survives_broker_kill or heartbeat_missed"
 
-echo "== [2/3] preemption: SIGTERM a real run, then --auto_resume =="
+echo "== [2/4] preemption: SIGTERM a real run, then --auto_resume =="
 ARGS=(--dataset sine --model fnn --concept_drift_algo win-1
       --concept_num 2 --client_num_in_total 4 --client_num_per_round 4
       --train_iterations 6 --comm_round 8 --epochs 2
@@ -63,7 +69,12 @@ print(f"resume OK: {len(rows)} metric rows, final Test/Acc="
       f"{rows[-1]['Test/Acc']:.4f}")
 EOF
 
-echo "== [3/3] event taxonomy consistency =="
+echo "== [3/4] event taxonomy consistency =="
 python scripts/check_events_schema.py
+
+echo "== [4/4] byzantine smoke: trimmed_mean defends where mean fails =="
+timeout -k 10 300 python -m pytest tests/test_robust_agg.py -q \
+    -p no:cacheprovider -p no:randomly \
+    -k "trimmed_mean_defends_where_mean_fails"
 
 echo "chaos_smoke: ALL OK"

@@ -521,8 +521,8 @@ class TestDivergenceInRunner:
         orig = TrainStep.train_round
 
         def poisoned(self, *a, **k):
-            p, o, cp, n, losses = orig(self, *a, **k)
-            return p, o, cp, n, jnp.full_like(losses, jnp.nan)
+            p, o, cp, n, losses, *rest = orig(self, *a, **k)
+            return (p, o, cp, n, jnp.full_like(losses, jnp.nan), *rest)
 
         monkeypatch.setattr(TrainStep, "train_round", poisoned)
         with pytest.raises(DivergenceError):
@@ -544,8 +544,9 @@ class TestDivergenceInRunner:
         orig = TrainStep.train_iteration_eval
 
         def poisoned(self, *a, **k):
-            p, o, n, losses, bufs, total = orig(self, *a, **k)
-            return p, o, n, jnp.full_like(losses, jnp.nan), bufs, total
+            p, o, n, losses, bufs, total, *rest = orig(self, *a, **k)
+            return (p, o, n, jnp.full_like(losses, jnp.nan), bufs, total,
+                    *rest)
 
         monkeypatch.setattr(TrainStep, "train_iteration_eval", poisoned)
         with pytest.raises(DivergenceError):

@@ -1,0 +1,387 @@
+"""Byzantine- and staleness-tolerant rounds (resilience/robust_agg.py,
+platform/faults.py::ByzantineInjector, fault-aware clustering decisions).
+
+Covers the acceptance criteria of the robustness PR:
+- every registered aggregator against hand-computed [C] stacks, including
+  masked rows that must NEVER influence median/trimmed/Krum output;
+- deterministic, seeded attack schedules (resumability guarantee);
+- the quorum-floor/failure-detector interaction fix (a quorum revival is
+  not a liveness signal);
+- staleness-excluded accuracy entries no longer churn clusters;
+- the e2e chaos+adversary scenario: trimmed_mean stays near the clean
+  run's accuracy while plain mean degrades more.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from feddrift_tpu import obs
+from feddrift_tpu.config import ExperimentConfig
+from feddrift_tpu.platform.faults import (BYZ_MODES, ByzantineInjector,
+                                          apply_byzantine_updates)
+from feddrift_tpu.resilience.robust_agg import (RobustAggConfig, aggregate,
+                                                available_aggregators)
+from feddrift_tpu.simulation.runner import Experiment, run_experiment
+
+KEY = jax.random.PRNGKey(0)
+
+
+def _agg(name, stack, n, prev=None, **kw):
+    """One-cluster helper: stack [C, P] -> aggregated [P] + stats row."""
+    stack = jnp.asarray(stack, jnp.float32)
+    prev = (jnp.zeros(stack.shape[1:], jnp.float32) if prev is None
+            else jnp.asarray(prev, jnp.float32))
+    out, stats = jax.jit(
+        lambda cp, nn, pp: aggregate(name, cp, nn, pp, KEY,
+                                     RobustAggConfig(**kw)))(
+        {"w": stack[None]}, jnp.asarray(n, jnp.float32)[None],
+        {"w": prev[None]})
+    return np.asarray(out["w"][0]), np.asarray(stats[0])
+
+
+class TestAggregators:
+    """Hand-computed [C]-stack cases; masked rows hold garbage on purpose."""
+
+    STACK = np.array([[1.0, 10.0],
+                      [2.0, 20.0],
+                      [3.0, 30.0],
+                      [1e9, -1e9],      # masked: must never matter
+                      [4.0, 40.0]])
+    N = np.array([1.0, 1.0, 1.0, 0.0, 1.0])
+
+    def test_registry_is_complete(self):
+        assert set(available_aggregators()) == {
+            "mean", "median", "trimmed_mean", "krum", "multi_krum",
+            "norm_clip"}
+
+    def test_mean_matches_weighted_average(self):
+        n = np.array([1.0, 3.0, 0.0, 0.0, 0.0])
+        out, stats = _agg("mean", self.STACK, n)
+        np.testing.assert_allclose(
+            out, (1 * self.STACK[0] + 3 * self.STACK[1]) / 4, rtol=1e-6)
+        assert stats[0] == 2
+
+    def test_median_ignores_masked_rows(self):
+        out, stats = _agg("median", self.STACK, self.N)
+        # active rows {1,2,3,4}: even count -> mean of the two middle values
+        np.testing.assert_allclose(out, [2.5, 25.0], rtol=1e-6)
+        assert stats[0] == 4
+
+    def test_median_odd_count(self):
+        out, _ = _agg("median", self.STACK[:3], np.ones(3))
+        np.testing.assert_allclose(out, [2.0, 20.0], rtol=1e-6)
+
+    def test_trimmed_mean_drops_extremes_not_masked_zeros(self):
+        out, stats = _agg("trimmed_mean", self.STACK, self.N, trim_frac=0.3)
+        # k=4, t=1: drop min and max among ACTIVE values per coordinate
+        np.testing.assert_allclose(out, [2.5, 25.0], rtol=1e-6)
+        assert stats[1] == 2           # 2 rejected (one per end)
+
+    def test_trimmed_mean_zero_trim_equals_uniform_mean(self):
+        out, _ = _agg("trimmed_mean", self.STACK, self.N, trim_frac=0.0)
+        np.testing.assert_allclose(out, [2.5, 25.0], rtol=1e-6)
+
+    def test_krum_picks_the_clustered_update(self):
+        # three tight honest updates + one far outlier + one masked garbage
+        stack = np.array([[1.0, 1.0], [1.1, 1.0], [0.9, 1.0],
+                          [50.0, -50.0], [1e9, 1e9]])
+        n = np.array([1.0, 1.0, 1.0, 1.0, 0.0])
+        out, stats = _agg("krum", stack, n, krum_f=1)
+        assert out.tolist() in ([1.0, 1.0], [1.1, 1.0], [0.9, 1.0])
+        assert stats[0] == 4 and stats[1] == 3
+
+    def test_multi_krum_averages_the_best(self):
+        stack = np.array([[1.0, 1.0], [1.1, 1.0], [0.9, 1.0],
+                          [50.0, -50.0], [1e9, 1e9]])
+        n = np.array([1.0, 1.0, 1.0, 1.0, 0.0])
+        out, stats = _agg("multi_krum", stack, n, krum_f=1)
+        # k=4, f=1 -> 3 selected: the tight cluster; outlier rejected
+        np.testing.assert_allclose(out, [1.0, 1.0], atol=0.05)
+        assert stats[1] == 1
+
+    def test_norm_clip_bounds_and_counts(self):
+        stack = np.array([[3.0, 4.0],        # norm 5 -> clipped to 1
+                          [0.3, 0.4],        # norm .5 -> untouched
+                          [1e9, 1e9]])
+        n = np.array([1.0, 1.0, 0.0])
+        out, stats = _agg("norm_clip", stack, n, clip_norm=1.0)
+        np.testing.assert_allclose(out, [(0.6 + 0.3) / 2, (0.8 + 0.4) / 2],
+                                   rtol=1e-5)
+        assert stats[2] == 1            # one client clipped
+
+    def test_all_masked_keeps_previous_params(self):
+        prev = np.array([7.0, -7.0])
+        for name in available_aggregators():
+            out, stats = _agg(name, self.STACK, np.zeros(5), prev=prev)
+            np.testing.assert_allclose(out, prev, err_msg=name)
+            assert stats[0] == 0
+
+    def test_dp_noise_composes(self):
+        a, _ = _agg("median", self.STACK, self.N, dp_stddev=0.0)
+        b, _ = _agg("median", self.STACK, self.N, dp_stddev=0.5)
+        assert not np.allclose(a, b)
+
+    def test_unknown_aggregator_raises(self):
+        with pytest.raises(KeyError):
+            aggregate("nope", {"w": jnp.zeros((1, 2, 3))},
+                      jnp.ones((1, 2)), {"w": jnp.zeros((1, 3))}, KEY,
+                      RobustAggConfig())
+
+
+class TestByzantineInjector:
+    def test_schedules_are_deterministic(self):
+        a = ByzantineInjector(8, [1, 5], mode="gauss", prob=0.5, seed=3)
+        b = ByzantineInjector(8, [1, 5], mode="gauss", prob=0.5, seed=3)
+        np.testing.assert_array_equal(a.schedule(range(30)),
+                                      b.schedule(range(30)))
+        c = ByzantineInjector(8, [1, 5], mode="gauss", prob=0.5, seed=4)
+        assert (a.schedule(range(30)) != c.schedule(range(30))).any()
+
+    def test_modes_hit_only_configured_clients(self):
+        inj = ByzantineInjector(6, [0, 2], mode="sign_flip")
+        m = inj.modes(7)
+        assert m.tolist() == [BYZ_MODES["sign_flip"], 0,
+                              BYZ_MODES["sign_flip"], 0, 0, 0]
+
+    def test_emits_events(self):
+        obs.configure(None)
+        ByzantineInjector(4, [3], mode="scale").modes(0)
+        evs = obs.get_bus().events("byzantine_injected")
+        assert evs and evs[-1]["clients"] == [3] and evs[-1]["mode"] == "scale"
+
+    def test_rejects_bad_args(self):
+        with pytest.raises(ValueError):
+            ByzantineInjector(4, [0], mode="nuke")
+        with pytest.raises(ValueError):
+            ByzantineInjector(4, [9])
+        with pytest.raises(ValueError):
+            ByzantineInjector(4, [0], prob=1.5)
+
+
+class TestApplyByzantine:
+    def _stack(self):
+        cp = {"w": jnp.ones((1, 3, 2), jnp.float32) * 2.0}
+        gp = {"w": jnp.ones((1, 2), jnp.float32)}       # delta = +1
+        return cp, gp
+
+    def test_sign_flip_and_scale(self):
+        cp, gp = self._stack()
+        modes = jnp.asarray([BYZ_MODES["sign_flip"], BYZ_MODES["scale"], 0])
+        out = apply_byzantine_updates(cp, gp, modes, None, KEY, 3.0, 1.0)
+        w = np.asarray(out["w"][0])
+        np.testing.assert_allclose(w[0], 1.0 - 3.0)     # g - λ·delta
+        np.testing.assert_allclose(w[1], 1.0 + 3.0)     # g + λ·delta
+        np.testing.assert_allclose(w[2], 2.0)           # honest untouched
+
+    def test_stale_replay_resends_previous_submission(self):
+        cp, gp = self._stack()
+        stale = {"w": jnp.full((1, 3, 2), -5.0, jnp.float32)}
+        modes = jnp.asarray([BYZ_MODES["stale_replay"], 0, 0])
+        out = apply_byzantine_updates(cp, gp, modes, stale, KEY, 1.0, 1.0)
+        w = np.asarray(out["w"][0])
+        np.testing.assert_allclose(w[0], -5.0)
+        np.testing.assert_allclose(w[1:], 2.0)
+
+    def test_gauss_replaces_update(self):
+        cp, gp = self._stack()
+        modes = jnp.asarray([BYZ_MODES["gauss"], 0, 0])
+        out = apply_byzantine_updates(cp, gp, modes, None, KEY, 1.0, 0.5)
+        w = np.asarray(out["w"][0])
+        assert not np.allclose(w[0], 2.0)
+        np.testing.assert_allclose(w[1:], 2.0)
+
+
+def _cfg(**kw):
+    base = dict(dataset="sine", model="fnn", concept_drift_algo="win-1",
+                train_iterations=2, comm_round=8, epochs=2, sample_num=48,
+                batch_size=24, frequency_of_the_test=4, lr=0.05,
+                client_num_in_total=10, client_num_per_round=10, seed=0,
+                report_client=0, divergence_guard=False)
+    base.update(kw)
+    base.setdefault("client_num_per_round",
+                    min(10, base["client_num_in_total"]))
+    if base["client_num_per_round"] > base["client_num_in_total"]:
+        base["client_num_per_round"] = base["client_num_in_total"]
+    return ExperimentConfig(**base)
+
+
+class TestQuorumReviveDetectorFix:
+    def test_revival_is_not_liveness(self):
+        """A quorum-revived client was revived BECAUSE everything dropped;
+        its failure streak must keep growing and the revival must be
+        recorded distinctly (quorum_revive event)."""
+        cfg = _cfg(client_num_in_total=6, client_num_per_round=3,
+                   fault_enabled=True, failure_patience=2)
+        exp = Experiment(cfg)
+        exp.fault_injector.schedule_outage(0, 4, list(range(6)))
+        masks = exp._client_masks(0, range(4))
+        # quorum floor kept every round alive...
+        assert (masks.sum(axis=1) >= 1).all()
+        # ...yet every SAMPLED client's genuine outage streak advanced
+        # (the injector's own floor keeps client 0 up, so exclude it)
+        assert exp.failure_detector.absent_streak[1:].max() >= 2
+        revs = obs.get_bus().events("quorum_revive")
+        assert revs and all("client" in e for e in revs)
+
+    def test_exclude_suspected_zeroes_weight(self):
+        """Defense-in-depth knob: suspected clients carry zero aggregation
+        weight, and the run still completes normally."""
+        cfg = _cfg(client_num_in_total=4, fault_enabled=True,
+                   failure_patience=1, exclude_suspected_from_agg=True)
+        exp = Experiment(cfg)
+        exp.fault_injector.kill(3)
+        masks = exp._client_masks(0, range(4))
+        assert (masks[:, 3] == 0).all()
+        assert 3 in exp.failure_detector.suspected
+        exp.run()
+        assert exp.logger.last("Test/Acc") > 0.6
+
+
+class TestStalenessExcludedDecisions:
+    # only client 2 — the one the tests kill — ever drifts
+    CP_ONLY_CLIENT_2 = "0 0 0 0 0 0;0 0 1 0 0 0;0 0 1 0 0 0"
+
+    def _experiment(self, limit):
+        cfg = _cfg(concept_drift_algo="softcluster",
+                   concept_drift_algo_arg="mmacc_10", concept_num=4,
+                   client_num_in_total=6, fault_enabled=True,
+                   failure_patience=2, acc_staleness_limit=limit,
+                   change_points=self.CP_ONLY_CLIENT_2)
+        return Experiment(cfg)
+
+    def test_stale_client_cannot_trigger_spawn(self):
+        """Unit-level: the same accuracy drop spawns a model when the
+        client is live and must NOT when the client is staleness-excluded."""
+        for limit, want_spawn in ((0, True), (3, False)):
+            exp = self._experiment(limit)
+            algo = exp.algo
+            acc = np.full((algo.M, algo.C), 0.9)
+            acc[:, 2] = 0.2                     # client 2's column collapsed
+            algo.weights[0, 0, :] = 1.0         # everyone on model 0 at t=0
+            algo.mmacc_acc[:] = 0.9             # armed detector
+            algo.acc_matrix_at = lambda t, feat_mask=None: acc
+            algo.set_client_staleness(
+                np.array([0, 0, 10, 0, 0, 0]), suspected=(2,))
+            spawns0 = algo.event_counts["spawns"]
+            algo._cluster_mmacc2(1)
+            spawned = algo.event_counts["spawns"] > spawns0
+            assert spawned == want_spawn, f"limit={limit}"
+
+    def test_killed_client_keeps_cluster_count_flat(self):
+        """E2E acceptance: kill the only-drifting client mid-stream. The
+        pre-fix behavior (limit=0) spawns a cluster off the dead client's
+        stale accuracy column; with staleness exclusion the cluster count
+        stays flat and the exclusion is visible in the event stream."""
+        # control: historical trusting behavior churns
+        obs.configure(None)
+        exp = self._experiment(limit=0)
+        exp.fault_injector.kill(2)
+        exp.run()
+        states = [e["num_models"]
+                  for e in obs.get_bus().events("cluster_state")]
+        assert max(states) > states[0], states
+
+        # fix: stale column excluded -> no spurious model
+        obs.configure(None)
+        exp = self._experiment(limit=2)
+        exp.fault_injector.kill(2)
+        exp.run()
+        states = [e["num_models"]
+                  for e in obs.get_bus().events("cluster_state")]
+        assert states and all(s == states[0] for s in states), states
+        assert obs.get_bus().events("acc_stale_excluded")
+
+
+class TestEndToEndDefense:
+    """10 clients, 20% dropout, 2 Byzantine sign-flippers: trimmed_mean
+    must stay within DELTA of the clean run while plain mean degrades
+    more (the documented acceptance scenario; also the chaos_smoke.sh
+    Byzantine stage)."""
+
+    DELTA = 0.10
+
+    def test_trimmed_mean_defends_where_mean_fails(self):
+        clean = run_experiment(_cfg()).logger.last("Test/Acc")
+        byz = dict(byzantine_clients="0,1", byzantine_mode="sign_flip",
+                   fault_dropout_prob=0.2)
+        acc_mean = run_experiment(_cfg(**byz)).logger.last("Test/Acc")
+        acc_trim = run_experiment(
+            _cfg(**byz, robust_agg="trimmed_mean",
+                 robust_trim_frac=0.3)).logger.last("Test/Acc")
+        assert acc_trim >= clean - self.DELTA, (clean, acc_trim)
+        assert acc_mean < acc_trim - 0.05, (acc_mean, acc_trim)
+        # the attack and the defense are both visible in the event stream
+        kinds = {e["kind"] for e in obs.get_bus().events()}
+        assert {"byzantine_injected", "robust_agg_applied"} <= kinds
+        ev = obs.get_bus().events("robust_agg_applied")[-1]
+        assert ev["strategy"] == "trimmed_mean" and ev["rejected"] > 0
+
+    def test_masked_and_phantom_rows_cannot_poison(self):
+        """Same run on a client count that forces phantom padding on
+        multi-device meshes plus dropout: robust aggregation must not
+        average in masked rows (it would tank accuracy)."""
+        acc = run_experiment(
+            _cfg(client_num_in_total=7, client_num_per_round=5,
+                 robust_agg="median")).logger.last("Test/Acc")
+        assert acc > 0.6
+
+
+class TestDeterminismGuard:
+    """Identical seeds must give bitwise-identical attack schedules AND
+    bitwise-identical robust-aggregated parameters (protects --auto_resume
+    replay from PR 2)."""
+
+    def test_two_runs_bitwise_identical(self):
+        cfg = _cfg(byzantine_clients="0,1", byzantine_mode="sign_flip",
+                   byzantine_prob=0.7, fault_dropout_prob=0.2,
+                   robust_agg="trimmed_mean", robust_trim_frac=0.3)
+        a = run_experiment(cfg)
+        b = run_experiment(cfg)
+        assert a.logger.series("Test/Acc") == b.logger.series("Test/Acc")
+        for la, lb in zip(jax.tree_util.tree_leaves(a.pool.params),
+                          jax.tree_util.tree_leaves(b.pool.params)):
+            np.testing.assert_array_equal(np.asarray(la), np.asarray(lb))
+
+    def test_stale_replay_deterministic_across_paths(self):
+        """stale_replay carries state through the scan; fused and per-round
+        execution must still agree bitwise."""
+        kw = dict(byzantine_clients="0", byzantine_mode="stale_replay",
+                  robust_agg="median", client_num_in_total=6)
+        a = run_experiment(_cfg(**kw, chunk_rounds=True))
+        b = run_experiment(_cfg(**kw, chunk_rounds=False))
+        assert a.logger.series("Test/Acc") == b.logger.series("Test/Acc")
+        for la, lb in zip(jax.tree_util.tree_leaves(a.pool.params),
+                          jax.tree_util.tree_leaves(b.pool.params)):
+            np.testing.assert_array_equal(np.asarray(la), np.asarray(lb))
+
+
+class TestReportRobustnessSection:
+    def test_summarize_renders_robustness(self, tmp_path):
+        import json
+
+        from feddrift_tpu.obs.report import render, summarize
+        evs = [
+            {"_ts": 1.0, "kind": "byzantine_injected", "byz_round": 0,
+             "clients": [0, 1], "mode": "sign_flip"},
+            {"_ts": 1.1, "kind": "robust_agg_applied", "round": 0,
+             "strategy": "trimmed_mean", "active": [8], "rejected": 4,
+             "clipped": 0},
+            {"_ts": 1.2, "kind": "acc_stale_excluded", "clients": [2],
+             "decision": "drift_trigger", "changed": True},
+            {"_ts": 1.3, "kind": "quorum_revive", "fault_round": 3,
+             "client": 0},
+        ]
+        with open(tmp_path / "events.jsonl", "w") as f:
+            for e in evs:
+                f.write(json.dumps(e) + "\n")
+        s = summarize(str(tmp_path))
+        rob = s["robustness"]
+        assert rob["byzantine"]["clients"] == [0, 1]
+        assert rob["aggregation"]["strategy"] == "trimmed_mean"
+        assert rob["aggregation"]["rejected_total"] == 4
+        assert rob["stale_exclusions"]["changed_decisions"] == 1
+        assert rob["quorum_revives"] == 1
+        text = render(s)
+        assert "robustness:" in text and "trimmed_mean" in text
