@@ -28,11 +28,13 @@ import time
 
 import jax
 
-# TPU v5 lite (v5e) peak: ~197 TFLOP/s bf16, ~98 TFLOP/s f32 per chip.
-# No CPU entry on purpose: this host's peak is unknown, and an invented
-# constant would make mfu_estimate meaningless — MFU is reported null
-# unless the backend is a real TPU.
-PEAK_FLOPS = {"tpu": {"bfloat16": 197e12, "float32": 98e12}}
+# Peaks now live in the cost model (obs/costmodel.py): a datasheet table
+# for TPUs and a MEASURED matmul/stream microbenchmark for CPU hosts, so
+# mfu_estimate is non-null on every backend — the numerator comes from
+# XLA's cost_analysis of the actual compiled round program, the
+# denominator from what this silicon demonstrably does.
+from feddrift_tpu.obs.costmodel import PEAK_FLOPS  # noqa: F401  (re-export
+# kept: scripts/roofline_report.py and older notebooks read bench.PEAK_FLOPS)
 
 
 def _probe_backend(attempts: int = 3, timeout_s: float = 120.0):
@@ -100,49 +102,29 @@ def _canonical_cfg(smoke: bool, **overrides):
         # honest phase attribution: block on device output inside each
         # traced phase so async dispatch can't bill train time to eval
         trace_sync=True,
+        # full XLA memory accounting (obs/costmodel.py): the benchmark is
+        # exactly where the extra per-program compile is worth exact
+        # peak-HBM numbers (and the persistent compile cache halves it)
+        cost_model="compiled",
         report_client=0)
     base.update(overrides)
     return ExperimentConfig(**base)
 
 
 def _flops_per_example(exp) -> float:
-    """Forward FLOPs per example, preferring XLA's cost analysis of the
-    compiled single-model forward (exact for convs, where the dense
-    2-FLOPs-per-param rule undercounts by orders of magnitude). Falls back
-    to the dense analytic rule if the backend exposes no cost model."""
-    import numpy as np
-    import jax.numpy as jnp
+    """Forward FLOPs per example via XLA cost analysis (obs/costmodel.py;
+    kept as a bench.* name — scripts call it)."""
+    from feddrift_tpu.obs import costmodel
 
-    batch = min(exp.cfg.batch_size, 256)
-    try:
-        # exp.ds is always populated (exp.x is None under stream_data)
-        x1 = jnp.zeros((batch, *exp.ds.feature_shape), exp.ds.x.dtype)
-        compiled = jax.jit(exp.pool.apply).lower(exp.pool.slot(0), x1).compile()
-        cost = compiled.cost_analysis()
-        if isinstance(cost, list):           # older jax returns [dict]
-            cost = cost[0]
-        return float(cost["flops"]) / batch
-    except Exception:
-        n_params = sum(int(np.prod(l.shape[1:]))   # leading M axis excluded
-                       for l in jax.tree_util.tree_leaves(exp.pool.params))
-        return 2.0 * n_params
+    return costmodel.forward_flops_per_example(exp)
 
 
 def _flops_per_round(exp) -> float:
-    """Analytic round-FLOPs estimate for the MFU line.
+    """Analytic round-FLOPs estimate (obs/costmodel.py; the measured path
+    prefers the captured round program's own cost — see _measure)."""
+    from feddrift_tpu.obs import costmodel
 
-    backward ~= 2x forward, so a train step costs ~3x the forward. Per
-    round: M x C local trainers each run `epochs` SGD steps on a
-    `batch_size` batch. Eval matrices add M x C full-step inferences every
-    frequency_of_the_test rounds (amortised in).
-    """
-    cfg, ds = exp.cfg, exp.ds
-    fpe = _flops_per_example(exp)
-    M, C = exp.pool.num_models, cfg.client_num_in_total
-    train = M * C * cfg.epochs * cfg.batch_size * fpe * 3
-    eval_amortised = (M * C * ds.samples_per_step * fpe
-                     / max(cfg.frequency_of_the_test, 1))
-    return float(train + eval_amortised)
+    return costmodel.analytic_round_flops(exp)
 
 
 def _json_from_subprocess(cmd: list[str], timeout: float, tag: str):
@@ -354,13 +336,18 @@ def _profile_capture(cfg, profile_dir: str) -> str | None:
 def _measure(cfg, backend: str) -> dict:
     """Run one config to steady state and return its measured numbers."""
     from feddrift_tpu import obs
+    from feddrift_tpu.obs import costmodel
     from feddrift_tpu.simulation.runner import Experiment
 
+    # Per-measurement program costs: a previous config's captured round
+    # program must not feed this config's MFU.
+    costmodel.clear()
     exp = Experiment(cfg)
 
     # Warm-up: run time steps 0 AND 1 fully — t=0 takes the cluster_init
     # branch only; t>=1 is the first to trace acc_cells / the hierarchical
-    # merge path, so steady-state timing must start at t=2.
+    # merge path, so steady-state timing must start at t=2. The cost model
+    # captures each program's XLA accounting at these first compiles.
     exp.run_iteration(0)
     exp.run_iteration(1)
 
@@ -368,7 +355,10 @@ def _measure(cfg, backend: str) -> dict:
     # result covers exactly the timed steady state: compile counts here
     # mean steady-state retraces (ideally zero), and the phase_seconds
     # histograms are per-phase latency distributions of the measured rounds.
+    # The per-program cost gauges were captured during warm-up and are
+    # static facts of the compiled programs, so they are re-populated.
     obs.registry().reset()
+    costmodel.refresh_gauges()
 
     # Timed steady state: the remaining time steps.
     t0 = time.time()
@@ -379,14 +369,26 @@ def _measure(cfg, backend: str) -> dict:
     rounds = cfg.comm_round * (cfg.train_iterations - 2)
     rps = rounds / elapsed
 
-    # MFU only means something against a known peak: report it exclusively
-    # for a real TPU backend (ADVICE r2: the old CPU placeholder peaks made
-    # the estimate meaningless while sharing the TPU key).
-    mfu = None
-    if backend.startswith("tpu"):
-        peak = PEAK_FLOPS["tpu"].get(cfg.compute_dtype,
-                                     PEAK_FLOPS["tpu"]["float32"])
-        mfu = round(_flops_per_round(exp) * rps / peak, 6)
+    # MFU from the COST MODEL on every backend: FLOPs/round preferring
+    # XLA's cost_analysis of the captured round program (source
+    # "cost_analysis"; analytic fallback otherwise), peak from the
+    # datasheet on TPU and a measured matmul microbenchmark elsewhere —
+    # a real utilization number instead of the historical null.
+    effective_dtype = (cfg.compute_dtype if backend.startswith("tpu")
+                       else "float32")   # bf16 is TPU-only (runner._make_apply)
+    flops_round, flops_source = costmodel.round_flops(exp)
+    peak, peak_source = costmodel.peak_flops(backend, effective_dtype)
+    mfu = round(flops_round * rps / peak, 6)
+    roofline = costmodel.roofline(
+        flops_round * rounds,
+        (costmodel.round_bytes(exp) or 0) * rounds or None,
+        elapsed, backend, effective_dtype)
+
+    # Peak HBM: XLA's static memory_analysis of the captured programs
+    # (cost_model="compiled") plus the live device watermark where the
+    # backend has allocator stats (None on CPU — graceful).
+    costmodel.record_hbm_watermark()
+    hbm_peak = costmodel.hbm_peak_bytes()
 
     return {
         "value": round(rps, 3),
@@ -395,10 +397,18 @@ def _measure(cfg, backend: str) -> dict:
         "wall_s": round(elapsed, 2),
         "rounds": rounds,
         "mfu_estimate": mfu,
+        "mfu": {"source": flops_source, "flops_per_round": flops_round,
+                "peak_flops": peak, "peak_source": peak_source,
+                "dtype": effective_dtype},
+        "roofline": roofline,
+        "hbm_peak_bytes": hbm_peak,
+        "program_costs": {fn: pc.to_event_fields()
+                          for fn, pc in costmodel.costs().items()},
         "phases": getattr(exp, "last_phase_summary", None),
         # Cross-layer instrument snapshot for the steady state: compile /
-        # recompile counts per program, phase_seconds histograms, comm
-        # counters when a transport is active (obs/instruments.py).
+        # recompile counts per program, phase_seconds histograms, program
+        # cost + hbm_peak_bytes gauges, comm counters when a transport is
+        # active (obs/instruments.py).
         "instruments": obs.registry().snapshot(),
     }
 

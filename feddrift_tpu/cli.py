@@ -15,6 +15,8 @@ checkpoint.
     python -m feddrift_tpu resume --out_dir runs/my-run
     python -m feddrift_tpu list   # algorithms / datasets / models
     python -m feddrift_tpu report runs/my-run   # telemetry run report
+    python -m feddrift_tpu report runs/my-run --trace   # + trace.json
+    python -m feddrift_tpu regress bench_new.json --baseline BENCH_r05.json
 
 Logging is configured in exactly one place (obs.setup_logging), driven by
 the ``--log_level`` flag every subcommand accepts.
@@ -118,11 +120,27 @@ def main(argv: list[str] | None = None) -> int:
         "report", help="render a run report from events.jsonl + metrics.jsonl")
     rep_p.add_argument("run_dirs", nargs="+")
     rep_p.add_argument("--json", action="store_true")
+    rep_p.add_argument("--trace", action="store_true",
+                       help="also export <run_dir>/trace.json — a "
+                            "Perfetto/chrome://tracing-loadable timeline "
+                            "built from spans.jsonl + events.jsonl")
+
+    reg_p = sub.add_parser(
+        "regress", help="perf-regression gate: compare a bench.py artifact "
+                        "against a baseline, exit 1 on regression "
+                        "(obs/regress.py)")
+    reg_p.add_argument("candidate")
+    reg_p.add_argument("--baseline", required=True)
+    reg_p.add_argument("--tol-rounds", type=float, default=None)
+    reg_p.add_argument("--tol-wall", type=float, default=None)
+    reg_p.add_argument("--tol-acc", type=float, default=None)
+    reg_p.add_argument("--tol-compiles", type=float, default=None)
+    reg_p.add_argument("--json", action="store_true")
 
     # --log_level is also accepted after the subcommand for convenience
     # (SUPPRESS default: an absent post-subcommand flag must not clobber a
     # pre-subcommand one — both write the same namespace attribute)
-    for p in (run_p, res_p, rep_p):
+    for p in (run_p, res_p, rep_p, reg_p):
         p.add_argument("--log_level", type=str, default=argparse.SUPPRESS,
                        help=argparse.SUPPRESS)
 
@@ -134,7 +152,21 @@ def main(argv: list[str] | None = None) -> int:
     if args.cmd == "report":
         # pure host-side: no jax / backend initialisation needed
         from feddrift_tpu.obs.report import main as report_main
-        return report_main(args.run_dirs + (["--json"] if args.json else []))
+        return report_main(args.run_dirs
+                           + (["--json"] if args.json else [])
+                           + (["--trace"] if args.trace else []))
+
+    if args.cmd == "regress":
+        # pure host-side: no jax / backend initialisation needed
+        from feddrift_tpu.obs.regress import main as regress_main
+        argv_r = [args.candidate, "--baseline", args.baseline]
+        for flag in ("tol_rounds", "tol_wall", "tol_acc", "tol_compiles"):
+            v = getattr(args, flag)
+            if v is not None:
+                argv_r += [f"--{flag.replace('_', '-')}", str(v)]
+        if args.json:
+            argv_r.append("--json")
+        return regress_main(argv_r)
 
     if getattr(args, "platform", ""):
         import jax

@@ -99,6 +99,11 @@ class ExperimentConfig:
     # flight; data/prefetch.py). Requires an algorithm whose training window
     # is the current step only (win-1 family, supports_streaming trait).
     stream_data: bool = False
+    # XLA cost-capture level for the tracked programs (obs/costmodel.py):
+    # "off" | "lowered" (cost_analysis FLOPs/bytes at first compile; cheap,
+    # no second XLA compile) | "compiled" (adds memory_analysis exact HBM
+    # accounting at the price of one extra compile per program — bench.py).
+    cost_model: str = "lowered"
     # Debug mode: validate round-input invariants every iteration and raise
     # inside the op that produces a NaN (utils/invariants.py).
     debug_checks: bool = False

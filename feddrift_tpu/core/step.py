@@ -109,6 +109,13 @@ class TrainStep:
     # read when a byz_modes vector is passed into the round.
     byz_scale: float = 10.0
     byz_std: float = 1.0
+    # Static: XLA cost-capture level for the tracked programs
+    # (obs/costmodel.py CAPTURE_LEVELS). "lowered" re-lowers each program
+    # once at first compile to read cost_analysis() (FLOPs / bytes
+    # accessed); "compiled" additionally compiles the lowered module for
+    # memory_analysis() (exact static HBM) — one extra XLA compile per
+    # program, which bench.py opts into.
+    cost_capture: str = "lowered"
     # Compile tracking: per jitted entry point, the set of argument
     # signatures (leaf shapes/dtypes + static values) seen so far. jit
     # retraces exactly when the signature is new, so a second distinct
@@ -117,23 +124,37 @@ class TrainStep:
     # transient HBM for the donated args.
     _signatures: dict = field(default_factory=dict, repr=False)
 
-    def _note_signature(self, fn: str, *trees, static=()) -> None:
+    def _note_signature(self, fn: str, *trees, static=()) -> str | None:
         """Record the call signature; emits jit_compile on first sight and
         jit_recompile when a DIFFERENT signature was seen before. O(leaves)
-        host work per dispatch — microseconds against a multi-ms round."""
+        host work per dispatch — microseconds against a multi-ms round.
+        Returns the event kind emitted, or None for an already-seen
+        signature (callers hook program-cost capture on "jit_compile")."""
         sig = tuple(static) + tuple(
             (leaf.shape, str(getattr(leaf, "dtype", type(leaf).__name__)))
             if hasattr(leaf, "shape") else repr(leaf)
             for tree in trees for leaf in jax.tree_util.tree_leaves(tree))
         seen = self._signatures.setdefault(fn, set())
         if sig in seen:
-            return
+            return None
         kind = "jit_compile" if not seen else "jit_recompile"
         seen.add(sig)
         obs.registry().counter("jit_compiles", fn=fn).inc()
         if kind == "jit_recompile":
             obs.registry().counter("jit_recompiles", fn=fn).inc()
         obs.emit(kind, fn=fn, signature_count=len(seen))
+        return kind
+
+    def _capture_cost(self, kind: str | None, fn: str, jit_fn, args: tuple,
+                      kwargs: dict | None = None) -> None:
+        """Harvest XLA cost/memory accounting on the FIRST compile of each
+        tracked program (obs/costmodel.py). First compile only: the capture
+        re-lowers the program, so doing it per recompile would double every
+        retrace the jit_recompile event exists to flag."""
+        if kind != "jit_compile" or self.cost_capture == "off":
+            return
+        obs.costmodel.capture(fn, jit_fn, (self,) + args, kwargs,
+                              level=self.cost_capture)
 
     # ------------------------------------------------------------------
     def init_opt_states(self, params, num_models: int, num_clients: int):
@@ -283,10 +304,15 @@ class TrainStep:
         buffer is M x C full model copies of HBM the weighted-mean reduction
         can otherwise stream through.
         """
-        self._note_signature(
+        kind = self._note_signature(
             "train_round", params, opt_states, x, y, time_w, sample_w,
             feat_mask, client_mask, byz_modes, stale_params,
             static=(keep_client_params,))
+        self._capture_cost(
+            kind, "train_round", type(self)._train_round_jit,
+            (params, opt_states, key, x, y, time_w, sample_w, feat_mask,
+             lr_scale, client_mask, byz_modes, stale_params),
+            {"keep_client_params": keep_client_params})
         out = self._train_round_jit(
             params, opt_states, key, x, y, time_w, sample_w, feat_mask,
             lr_scale, client_mask, byz_modes, stale_params,
@@ -337,10 +363,16 @@ class TrainStep:
         ``with_agg_stats`` additionally returns the per-round [R, M, 3]
         robust-aggregation stats.
         """
-        self._note_signature(
+        kind = self._note_signature(
             "train_iteration_eval", params, opt_states, x, y, time_w,
             sample_w, feat_mask, client_masks, byz_modes,
             static=(R, freq, byz_stale))
+        self._capture_cost(
+            kind, "train_iteration_eval",
+            type(self)._train_iteration_eval_jit,
+            (params, opt_states, iter_key, x, y, time_w, sample_w,
+             feat_mask, lr_scale, R, freq, t, client_masks, byz_modes),
+            {"byz_stale": byz_stale})
         out = self._train_iteration_eval_jit(
             params, opt_states, iter_key, x, y, time_w, sample_w, feat_mask,
             lr_scale, R, freq, t, client_masks, byz_modes,
@@ -439,7 +471,9 @@ class TrainStep:
         FedAvgEnsDataLoader.py:1074-1085) — with one [M, C, N] forward.
         x: [C, N, ...]; returns (correct [M, C], loss_sum [M, C], total [C]).
         """
-        self._note_signature("acc_matrix", params, x, y, feat_mask)
+        kind = self._note_signature("acc_matrix", params, x, y, feat_mask)
+        self._capture_cost(kind, "acc_matrix", type(self)._acc_matrix_jit,
+                           (params, x, y, feat_mask))
         return self._acc_matrix_jit(params, x, y, feat_mask)
 
     @partial(jax.jit, static_argnums=0)
@@ -508,7 +542,9 @@ class TrainStep:
     # ------------------------------------------------------------------
     def acc_cells(self, params, x, y, feat_mask):
         """Tracked dispatch of ``_acc_cells_jit`` (see there)."""
-        self._note_signature("acc_cells", params, x, y, feat_mask)
+        kind = self._note_signature("acc_cells", params, x, y, feat_mask)
+        self._capture_cost(kind, "acc_cells", type(self)._acc_cells_jit,
+                           (params, x, y, feat_mask))
         return self._acc_cells_jit(params, x, y, feat_mask)
 
     @partial(jax.jit, static_argnums=0)

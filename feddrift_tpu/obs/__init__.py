@@ -18,6 +18,15 @@ were all invisible. This package is the missing observability layer:
 - ``obs.report``      — renders a human-readable run report from
   ``events.jsonl`` + ``metrics.jsonl`` (CLI: ``python -m feddrift_tpu
   report <run_dir>``).
+- ``obs.costmodel``   — XLA cost/memory accounting per compiled program
+  (FLOPs, bytes accessed, peak HBM), live ``device.memory_stats()``
+  watermarks, measured/datasheet peaks, and the roofline math behind
+  ``bench.py``'s ``mfu_estimate``.
+- ``obs.spans``       — wall-clock span recording (``spans.jsonl``) and
+  the Chrome-trace-event exporter behind ``report <run_dir> --trace``
+  (Perfetto-loadable ``trace.json``, one lane per process/thread).
+- ``obs.regress``     — the perf-regression gate over bench artifacts
+  (CLI: ``python -m feddrift_tpu regress <bench.json> --baseline ...``).
 
 Event kinds are a CLOSED set (``events.EVENT_KINDS``): ``emit()`` rejects
 unknown kinds, and ``scripts/check_events_schema.py`` statically checks that
@@ -41,6 +50,8 @@ from feddrift_tpu.obs.instruments import (  # noqa: F401
     Registry,
     registry,
 )
+from feddrift_tpu.obs import costmodel, spans  # noqa: F401  (import order:
+# both depend only on obs.events/obs.instruments, which are bound above)
 
 _LOG_FORMAT = "%(asctime)s %(name)s %(levelname)s %(message)s"
 

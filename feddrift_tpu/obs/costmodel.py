@@ -1,0 +1,425 @@
+"""XLA cost/memory accounting: what each compiled program costs the chip.
+
+Every bench artifact before this module reported ``"mfu_estimate": null``:
+the analytic FLOP rules could guess at compute, but nothing observed what
+XLA actually compiled. This module closes that gap with three pieces:
+
+**Program cost capture.** ``capture()`` runs at jit-compile time (hooked
+from ``TrainStep._note_signature`` on every *first* argument signature):
+it re-lowers the jitted program with the call's arguments and harvests
+XLA's own accounting — ``cost_analysis()`` FLOPs / bytes accessed, and
+(at the ``"compiled"`` level) ``memory_analysis()`` argument / output /
+temp HBM sizes. Each capture emits one ``program_cost`` event and
+refreshes the ``program_flops{fn=...}`` / ``program_bytes_accessed`` /
+``program_peak_hbm_bytes`` gauges plus the cross-program
+``hbm_peak_bytes`` high-water gauge. Levels (``cfg.cost_model``):
+
+    off       no capture
+    lowered   trace + lower only; FLOPs and bytes accessed (cheap —
+              no second XLA compile; the default for runs)
+    compiled  additionally compile the lowered module and read
+              ``memory_analysis()`` — exact static HBM accounting, at
+              the price of one extra XLA compile per program (bench.py
+              uses this; the persistent compile cache halves the hit)
+
+**Live HBM watermarks.** ``record_hbm_watermark()`` reads
+``device.memory_stats()`` (``bytes_in_use`` / ``peak_bytes_in_use``),
+emits an ``hbm_watermark`` event and folds the live peak into the
+``hbm_peak_bytes`` gauge. CPU backends expose no memory stats: the call
+returns ``None`` and emits nothing — graceful, never an error.
+
+**Peaks + roofline.** ``peak_flops()`` / ``peak_bytes_per_s()`` give the
+denominator MFU needs: a datasheet table for TPUs, and a *measured*
+matmul / memory-stream microbenchmark for CPU hosts (an invented CPU
+constant would make MFU meaningless; a measured one makes it "fraction
+of what this silicon demonstrably does"). ``roofline()`` combines
+achieved FLOP/s and bytes/s against those peaks and names the binding
+resource. bench.py and scripts/roofline_report.py both source their
+numbers here — one cost model, no per-script forks.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from dataclasses import asdict, dataclass
+from typing import Any
+
+from feddrift_tpu.obs import events, instruments
+
+log = logging.getLogger("feddrift_tpu")
+
+CAPTURE_LEVELS = ("off", "lowered", "compiled")
+
+# Datasheet peaks per chip. TPU v5 lite (v5e): ~197 TFLOP/s bf16,
+# ~98 TFLOP/s f32, ~819 GB/s HBM BW per chip. (Moved here from bench.py so
+# bench and scripts/roofline_report.py read one table.)
+PEAK_FLOPS = {"tpu": {"bfloat16": 197e12, "float32": 98e12}}
+PEAK_BYTES_PER_S = {"tpu": 8.19e11}
+
+
+@dataclass
+class ProgramCost:
+    """XLA's accounting of ONE compiled program (one jit entry point)."""
+
+    fn: str
+    level: str                          # "lowered" | "compiled"
+    flops: float | None = None          # per execution of the program
+    bytes_accessed: float | None = None
+    argument_bytes: int | None = None   # memory_analysis (compiled only)
+    output_bytes: int | None = None
+    temp_bytes: int | None = None
+    generated_code_bytes: int | None = None
+    peak_hbm_bytes: int | None = None   # see _peak_from_memory_analysis
+
+    def to_event_fields(self) -> dict[str, Any]:
+        return {k: v for k, v in asdict(self).items() if v is not None}
+
+
+# ----------------------------------------------------------------------
+# Process-local store of captured program costs, keyed by jit entry-point
+# name — the same names the jit_compile events carry.
+_costs: dict[str, ProgramCost] = {}
+_lock = threading.Lock()
+
+
+def costs() -> dict[str, ProgramCost]:
+    """Snapshot of every captured program cost (by entry-point name)."""
+    with _lock:
+        return dict(_costs)
+
+
+def get(fn: str) -> ProgramCost | None:
+    with _lock:
+        return _costs.get(fn)
+
+
+def clear() -> None:
+    with _lock:
+        _costs.clear()
+
+
+def _cost_dict(obj) -> dict | None:
+    """cost_analysis() returns a dict, or [dict] on older jax."""
+    try:
+        cost = obj.cost_analysis()
+    except Exception:
+        return None
+    if isinstance(cost, list):
+        cost = cost[0] if cost else None
+    return cost if isinstance(cost, dict) else None
+
+
+def _peak_from_memory_analysis(mem) -> int | None:
+    """Static peak-HBM estimate for one program.
+
+    XLA reports a true ``peak_memory_in_bytes`` on some backends; where it
+    is None (CPU) the sum argument + output + temp − aliased is the
+    buffer-assignment upper bound: everything the executable touches that
+    must be resident at once, donations already netted out via alias.
+    """
+    peak = getattr(mem, "peak_memory_in_bytes", None)
+    if peak:
+        return int(peak)
+    total = 0
+    for attr in ("argument_size_in_bytes", "output_size_in_bytes",
+                 "temp_size_in_bytes"):
+        total += int(getattr(mem, attr, 0) or 0)
+    total -= int(getattr(mem, "alias_size_in_bytes", 0) or 0)
+    return total if total > 0 else None
+
+
+def _set_gauges(pc: ProgramCost) -> None:
+    reg = instruments.registry()
+    if pc.flops is not None:
+        reg.gauge("program_flops", fn=pc.fn).set(pc.flops)
+    if pc.bytes_accessed is not None:
+        reg.gauge("program_bytes_accessed", fn=pc.fn).set(pc.bytes_accessed)
+    if pc.peak_hbm_bytes is not None:
+        reg.gauge("program_peak_hbm_bytes", fn=pc.fn).set(pc.peak_hbm_bytes)
+        peak = hbm_peak_bytes()
+        if peak is not None:
+            reg.gauge("hbm_peak_bytes").set(peak)
+
+
+def refresh_gauges() -> None:
+    """Re-populate the program-cost gauges from the store.
+
+    bench.py resets the instrument registry after warm-up so its snapshot
+    covers exactly the timed steady state — but the programs compiled (and
+    were captured) *during* warm-up. This puts their gauges back without
+    re-capturing anything.
+    """
+    for pc in costs().values():
+        _set_gauges(pc)
+
+
+def capture(fn: str, jit_fn, args: tuple, kwargs: dict | None = None,
+            level: str = "lowered") -> ProgramCost | None:
+    """Harvest XLA's cost/memory accounting for one jitted entry point.
+
+    ``jit_fn`` is the jax.jit-wrapped callable and ``args``/``kwargs`` the
+    exact call about to be dispatched (lowering with donated argnums is
+    abstract — no buffer is consumed). Failures are never fatal: the cost
+    model is evidence, not a gate, so any backend/API gap logs a warning
+    and returns None.
+    """
+    if level == "off":
+        return None
+    if level not in CAPTURE_LEVELS:
+        raise ValueError(f"unknown cost-capture level {level!r}; "
+                         f"one of {CAPTURE_LEVELS}")
+    try:
+        lowered = jit_fn.lower(*args, **(kwargs or {}))
+        pc = ProgramCost(fn=fn, level=level)
+        cost = _cost_dict(lowered)
+        if level == "compiled":
+            compiled = lowered.compile()
+            # compiled cost_analysis reflects the optimized HLO; prefer it
+            cost = _cost_dict(compiled) or cost
+            try:
+                mem = compiled.memory_analysis()
+            except Exception:
+                mem = None
+            if mem is not None:
+                pc.argument_bytes = int(
+                    getattr(mem, "argument_size_in_bytes", 0) or 0)
+                pc.output_bytes = int(
+                    getattr(mem, "output_size_in_bytes", 0) or 0)
+                pc.temp_bytes = int(
+                    getattr(mem, "temp_size_in_bytes", 0) or 0)
+                pc.generated_code_bytes = int(
+                    getattr(mem, "generated_code_size_in_bytes", 0) or 0)
+                pc.peak_hbm_bytes = _peak_from_memory_analysis(mem)
+        if cost:
+            if cost.get("flops") is not None:
+                pc.flops = float(cost["flops"])
+            if cost.get("bytes accessed") is not None:
+                pc.bytes_accessed = float(cost["bytes accessed"])
+    except Exception as e:                       # pragma: no cover - backend
+        log.warning("costmodel: capture of %s failed: %s: %s",
+                    fn, type(e).__name__, str(e)[:200])
+        return None
+    with _lock:
+        _costs[fn] = pc
+    _set_gauges(pc)
+    events.emit("program_cost", **pc.to_event_fields())
+    return pc
+
+
+# ----------------------------------------------------------------------
+# Live device-memory watermarks
+def device_memory_stats() -> dict | None:
+    """{"bytes_in_use", "peak_bytes_in_use", ...} for the first local
+    device, or None where the backend exposes no allocator stats (CPU)."""
+    try:
+        import jax
+        stats = jax.local_devices()[0].memory_stats()
+    except Exception:
+        return None
+    if not stats:
+        return None
+    return dict(stats)
+
+
+def record_hbm_watermark(**context: Any) -> dict | None:
+    """Emit one ``hbm_watermark`` event + refresh the HBM gauges from live
+    allocator stats. Returns the stats, or None (silently) on backends
+    without ``memory_stats()`` — per-iteration callers need no guard."""
+    stats = device_memory_stats()
+    if stats is None:
+        return None
+    in_use = stats.get("bytes_in_use")
+    peak = stats.get("peak_bytes_in_use")
+    reg = instruments.registry()
+    if in_use is not None:
+        reg.gauge("hbm_bytes_in_use").set(in_use)
+    if peak is not None:
+        reg.gauge("hbm_live_peak_bytes").set(peak)
+        best = hbm_peak_bytes()
+        if best is not None:
+            reg.gauge("hbm_peak_bytes").set(best)
+    events.emit("hbm_watermark", bytes_in_use=in_use, peak_bytes=peak,
+                **context)
+    return stats
+
+
+def hbm_peak_bytes() -> int | None:
+    """Best-known peak HBM: max of the static per-program accounting and
+    the live allocator watermark. None when neither source has data."""
+    peaks = [pc.peak_hbm_bytes for pc in costs().values()
+             if pc.peak_hbm_bytes is not None]
+    live = device_memory_stats()
+    if live and live.get("peak_bytes_in_use") is not None:
+        peaks.append(int(live["peak_bytes_in_use"]))
+    return max(peaks) if peaks else None
+
+
+# ----------------------------------------------------------------------
+# Peaks: the MFU / roofline denominators
+_measured_peaks: dict[str, float] = {}
+
+
+def _measure_cpu_peak_flops() -> float:
+    """Achieved f32 matmul FLOP/s on this host — the honest MFU
+    denominator where no datasheet applies. One-time, ~100 ms."""
+    import jax
+    import jax.numpy as jnp
+
+    n = 512
+    f = jax.jit(lambda a, b: a @ b)
+    a = jnp.ones((n, n), jnp.float32)
+    jax.block_until_ready(f(a, a))               # compile
+    reps, best = 3, 0.0
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        jax.block_until_ready(f(a, a))
+        dt = time.perf_counter() - t0
+        best = max(best, (2 * n ** 3) / max(dt, 1e-9))
+    return best
+
+
+def _measure_cpu_peak_bytes() -> float:
+    """Achieved memory-stream bytes/s (large-array copy) on this host."""
+    import jax
+    import jax.numpy as jnp
+
+    n = 4 * 1024 * 1024                          # 16 MiB f32
+    f = jax.jit(lambda a: a + 1.0)
+    a = jnp.ones((n,), jnp.float32)
+    jax.block_until_ready(f(a))
+    reps, best = 3, 0.0
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        jax.block_until_ready(f(a))
+        dt = time.perf_counter() - t0
+        best = max(best, (2 * 4 * n) / max(dt, 1e-9))   # read + write
+    return best
+
+
+def peak_flops(backend: str, dtype: str = "float32") -> tuple[float, str]:
+    """(peak FLOP/s, source) for MFU. TPU backends use the datasheet
+    table; everything else gets a measured matmul microbenchmark
+    (memoized per process) so MFU is non-null on every backend."""
+    if backend.startswith("tpu"):
+        table = PEAK_FLOPS["tpu"]
+        return table.get(dtype, table["float32"]), "datasheet_tpu_v5e"
+    key = "cpu_flops"
+    if key not in _measured_peaks:
+        _measured_peaks[key] = _measure_cpu_peak_flops()
+    return _measured_peaks[key], "measured_matmul_f32"
+
+
+def peak_bytes_per_s(backend: str) -> tuple[float, str]:
+    """(peak bytes/s, source) for the bandwidth roofline axis."""
+    if backend.startswith("tpu"):
+        return PEAK_BYTES_PER_S["tpu"], "datasheet_tpu_v5e"
+    key = "cpu_bytes"
+    if key not in _measured_peaks:
+        _measured_peaks[key] = _measure_cpu_peak_bytes()
+    return _measured_peaks[key], "measured_stream"
+
+
+def roofline(flops: float | None, bytes_accessed: float | None,
+             seconds: float, backend: str,
+             dtype: str = "float32") -> dict | None:
+    """Achieved-vs-peak utilization on both roofline axes.
+
+    Returns {"achieved_flops_per_s", "flops_utilization",
+    "achieved_bytes_per_s", "bandwidth_utilization", "bound",
+    "peak_flops", "peak_bytes_per_s", "peak_source"} — ``bound`` names
+    whichever axis is closer to its peak (the binding resource).
+    """
+    if seconds <= 0 or (flops is None and bytes_accessed is None):
+        return None
+    pf, src = peak_flops(backend, dtype)
+    pb, _ = peak_bytes_per_s(backend)
+    out: dict[str, Any] = {"peak_flops": pf, "peak_bytes_per_s": pb,
+                           "peak_source": src}
+    fu = bu = None
+    if flops is not None:
+        out["achieved_flops_per_s"] = flops / seconds
+        fu = out["flops_utilization"] = round(flops / seconds / pf, 6)
+    if bytes_accessed is not None:
+        out["achieved_bytes_per_s"] = bytes_accessed / seconds
+        bu = out["bandwidth_utilization"] = round(
+            bytes_accessed / seconds / pb, 6)
+    out["bound"] = ("compute" if (fu or 0) >= (bu or 0) else "memory")
+    return out
+
+
+# ----------------------------------------------------------------------
+# Model-level FLOP counting (shared by bench.py and
+# scripts/roofline_report.py — previously an island in each)
+def forward_flops_per_example(exp) -> float:
+    """Forward FLOPs per example of an Experiment's model, preferring
+    XLA's cost analysis of the compiled single-model forward (exact for
+    convs, where the dense 2-FLOPs-per-param rule undercounts by orders
+    of magnitude). Falls back to the dense analytic rule."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    batch = min(exp.cfg.batch_size, 256)
+    try:
+        # exp.ds is always populated (exp.x is None under stream_data)
+        x1 = jnp.zeros((batch, *exp.ds.feature_shape), exp.ds.x.dtype)
+        compiled = jax.jit(exp.pool.apply).lower(
+            exp.pool.slot(0), x1).compile()
+        cost = _cost_dict(compiled)
+        return float(cost["flops"]) / batch
+    except Exception:
+        n_params = sum(int(np.prod(l.shape[1:]))   # leading M axis excluded
+                       for l in jax.tree_util.tree_leaves(exp.pool.params))
+        return 2.0 * n_params
+
+
+def round_flops(exp) -> tuple[float, str]:
+    """(FLOPs per communication round, source) for an Experiment.
+
+    Prefers the captured cost of the program that actually runs the
+    round: the fused ``train_iteration_eval`` executes ``comm_round``
+    rounds (plus its in-program evals) per dispatch; ``train_round``
+    executes one. Falls back to the analytic estimate (forward cost
+    model × the round's step arithmetic) when no program was captured.
+    """
+    pc = get("train_iteration_eval")
+    if pc is not None and pc.flops:
+        return pc.flops / max(exp.cfg.comm_round, 1), "cost_analysis"
+    pc = get("train_round")
+    if pc is not None and pc.flops:
+        # eval programs run separately on this path; amortise them in
+        eval_pc = get("acc_matrix")
+        per_eval = (2 * eval_pc.flops if eval_pc is not None and eval_pc.flops
+                    else 0.0)
+        return (pc.flops + per_eval / max(exp.cfg.frequency_of_the_test, 1),
+                "cost_analysis")
+    return analytic_round_flops(exp), "analytic"
+
+
+def round_bytes(exp) -> float | None:
+    """Bytes accessed per communication round from the captured round
+    program, or None when nothing was captured."""
+    pc = get("train_iteration_eval")
+    if pc is not None and pc.bytes_accessed:
+        return pc.bytes_accessed / max(exp.cfg.comm_round, 1)
+    pc = get("train_round")
+    if pc is not None and pc.bytes_accessed:
+        return pc.bytes_accessed
+    return None
+
+
+def analytic_round_flops(exp) -> float:
+    """Analytic round-FLOPs estimate: backward ≈ 2× forward, so a train
+    step costs ~3× the forward. Per round: M × C local trainers each run
+    ``epochs`` SGD steps on a ``batch_size`` batch; eval matrices add
+    M × C full-step inferences every ``frequency_of_the_test`` rounds
+    (amortised in)."""
+    cfg, ds = exp.cfg, exp.ds
+    fpe = forward_flops_per_example(exp)
+    M, C = exp.pool.num_models, cfg.client_num_in_total
+    train = M * C * cfg.epochs * cfg.batch_size * fpe * 3
+    eval_amortised = (M * C * ds.samples_per_step * fpe
+                      / max(cfg.frequency_of_the_test, 1))
+    return float(train + eval_amortised)

@@ -47,6 +47,10 @@ EVENT_KINDS = frozenset({
     # XLA compile tracking (core/step.py)
     "jit_compile",          # first time a program sees an argument signature
     "jit_recompile",        # a NEW signature on an already-compiled program
+    # performance accounting (obs/costmodel.py, utils/tracing.py)
+    "program_cost",         # XLA cost/memory analysis of a compiled program
+    "hbm_watermark",        # live device.memory_stats() snapshot
+    "profile_captured",     # a jax.profiler trace was written (xla_trace)
     # drift / cluster decisions (algorithms/*)
     "drift_detected",       # per-client accuracy-drop trigger
     "cluster_create",       # a pool slot is (re)allocated for a new cluster

@@ -1,0 +1,226 @@
+"""Perf-regression gate over bench.py artifacts.
+
+Five BENCH_r0*.json snapshots existed with nothing that compared them;
+this module is the comparator, runnable in CI:
+
+    python -m feddrift_tpu regress <bench.json> --baseline BENCH_r05.json
+
+Accepts both raw ``bench.py`` stdout (a JSON object / last JSON line of a
+capture) and the committed ``BENCH_r0*.json`` wrapper format (driver
+snapshots with the bench object under ``"parsed"``). Compares the
+metrics a throughput regression shows up in — rounds/s, wall seconds,
+steady-state XLA compile counts, final test accuracy — and exits nonzero
+iff any regresses past its threshold, printing a delta table either way.
+
+Thresholds are *noise-aware* by construction: every limit is explicit,
+relative where the metric scales (throughput, wall) and absolute where
+it does not (accuracy, compile counts), with defaults sized for a noisy
+1-core CI host. A metric missing from either side is reported as
+``skip``, never a failure — older artifacts (no ``instruments`` key) and
+``--smoke`` runs (no baselines) stay comparable on the metrics they do
+carry. ``wall_s`` is only compared when both runs measured the same
+number of rounds (otherwise wall scales with work, not speed).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Any
+
+# (flag, default) — relative for throughput/wall, absolute for the rest
+DEFAULT_TOL = {
+    "rounds": 0.25,      # fail if rounds/s < baseline * (1 - tol)
+    "wall": 0.30,        # fail if wall_s > baseline * (1 + tol)
+    "acc": 0.02,         # fail if final_test_acc < baseline - tol
+    "compiles": 0.0,     # fail if steady-state compiles > baseline + tol
+}
+
+
+def load_bench(path: str) -> dict:
+    """Load a bench artifact: raw bench.py output, a mixed-output capture
+    (last parseable JSON line wins), or a BENCH_r0*.json driver wrapper
+    (bench object under "parsed")."""
+    with open(path) as f:
+        text = f.read()
+    try:
+        d = json.loads(text)
+    except json.JSONDecodeError:
+        d = None
+        for line in reversed(text.strip().splitlines()):
+            try:
+                d = json.loads(line)
+                break
+            except json.JSONDecodeError:
+                continue
+        if d is None:
+            raise ValueError(f"{path}: no JSON object found")
+    if not isinstance(d, dict):
+        raise ValueError(f"{path}: expected a JSON object")
+    if "parsed" in d and isinstance(d["parsed"], dict):
+        d = d["parsed"]                # committed BENCH_r0*.json wrapper
+    return d
+
+
+def _compile_counts(bench: dict) -> tuple[float | None, float | None]:
+    """(compiles, recompiles) summed over programs from the instruments
+    snapshot, or (None, None) when the artifact predates instruments."""
+    inst = bench.get("instruments")
+    if not isinstance(inst, dict):
+        return None, None
+    comp = sum(v for k, v in inst.items()
+               if k.startswith("jit_compiles") and isinstance(v, (int, float)))
+    rec = sum(v for k, v in inst.items()
+              if k.startswith("jit_recompiles") and isinstance(v, (int, float)))
+    return comp, rec
+
+
+def extract_metrics(bench: dict) -> dict[str, float | None]:
+    comp, rec = _compile_counts(bench)
+    return {
+        "rounds_per_s": bench.get("value"),
+        "wall_s": bench.get("wall_s"),
+        "rounds": bench.get("rounds"),
+        "final_test_acc": bench.get("final_test_acc"),
+        "jit_compiles": comp,
+        "jit_recompiles": rec,
+    }
+
+
+def compare(candidate: dict, baseline: dict,
+            tol: dict[str, float] | None = None) -> list[dict[str, Any]]:
+    """Delta rows, one per gated metric: {"metric", "baseline",
+    "candidate", "delta_pct", "limit", "status"} with status ∈
+    ok | regress | skip."""
+    tol = {**DEFAULT_TOL, **(tol or {})}
+    c, b = extract_metrics(candidate), extract_metrics(baseline)
+    rows: list[dict[str, Any]] = []
+
+    def row(metric, bv, cv, limit, regressed, note=None):
+        r: dict[str, Any] = {"metric": metric, "baseline": bv,
+                             "candidate": cv, "limit": limit,
+                             "status": "regress" if regressed else "ok"}
+        if bv not in (None, 0) and cv is not None:
+            r["delta_pct"] = round(100.0 * (cv - bv) / bv, 2)
+        if note:
+            r["note"] = note
+        return r
+
+    def skip(metric, note):
+        rows.append({"metric": metric, "baseline": b.get(metric),
+                     "candidate": c.get(metric), "status": "skip",
+                     "note": note})
+
+    # throughput: higher is better, relative tolerance
+    if b["rounds_per_s"] is None or c["rounds_per_s"] is None:
+        skip("rounds_per_s", "missing from one side")
+    else:
+        floor = b["rounds_per_s"] * (1.0 - tol["rounds"])
+        rows.append(row("rounds_per_s", b["rounds_per_s"], c["rounds_per_s"],
+                        f">= {floor:.3f}", c["rounds_per_s"] < floor))
+
+    # wall: lower is better; comparable only for equal measured rounds
+    if b["wall_s"] is None or c["wall_s"] is None:
+        skip("wall_s", "missing from one side")
+    elif b["rounds"] != c["rounds"]:
+        skip("wall_s", f"rounds differ ({b['rounds']} vs {c['rounds']})")
+    else:
+        ceil = b["wall_s"] * (1.0 + tol["wall"])
+        rows.append(row("wall_s", b["wall_s"], c["wall_s"],
+                        f"<= {ceil:.3f}", c["wall_s"] > ceil))
+
+    # accuracy: higher is better, absolute tolerance
+    if b["final_test_acc"] is None or c["final_test_acc"] is None:
+        skip("final_test_acc", "missing from one side")
+    else:
+        floor = b["final_test_acc"] - tol["acc"]
+        rows.append(row("final_test_acc", b["final_test_acc"],
+                        c["final_test_acc"], f">= {floor:.4f}",
+                        c["final_test_acc"] < floor))
+
+    # steady-state compile counts: lower is better, absolute tolerance
+    for metric in ("jit_compiles", "jit_recompiles"):
+        if b[metric] is None or c[metric] is None:
+            skip(metric, "no instruments snapshot")
+        else:
+            ceil = b[metric] + tol["compiles"]
+            rows.append(row(metric, b[metric], c[metric],
+                            f"<= {ceil:g}", c[metric] > ceil))
+    return rows
+
+
+def render(rows: list[dict[str, Any]]) -> str:
+    def fmt(v):
+        if v is None:
+            return "-"
+        if isinstance(v, float):
+            return f"{v:.4g}"
+        return str(v)
+
+    head = f"{'metric':<16} {'baseline':>10} {'candidate':>10} " \
+           f"{'delta':>8} {'limit':>12}  status"
+    lines = [head, "-" * len(head)]
+    for r in rows:
+        delta = (f"{r['delta_pct']:+.1f}%" if "delta_pct" in r else "-")
+        status = r["status"].upper() if r["status"] == "regress" \
+            else r["status"]
+        note = f"  ({r['note']})" if r.get("note") else ""
+        lines.append(f"{r['metric']:<16} {fmt(r.get('baseline')):>10} "
+                     f"{fmt(r.get('candidate')):>10} {delta:>8} "
+                     f"{fmt(r.get('limit')):>12}  {status}{note}")
+    n_reg = sum(1 for r in rows if r["status"] == "regress")
+    lines.append("")
+    lines.append(f"{'REGRESSION' if n_reg else 'OK'}: "
+                 f"{n_reg} regressed, "
+                 f"{sum(1 for r in rows if r['status'] == 'ok')} ok, "
+                 f"{sum(1 for r in rows if r['status'] == 'skip')} skipped")
+    return "\n".join(lines)
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="feddrift_tpu regress",
+        description="compare a bench.py artifact against a baseline; "
+                    "exit 1 on regression")
+    ap.add_argument("candidate", help="bench JSON to gate")
+    ap.add_argument("--baseline", required=True,
+                    help="bench JSON to compare against (raw output or a "
+                         "committed BENCH_r0*.json)")
+    ap.add_argument("--tol-rounds", type=float, default=DEFAULT_TOL["rounds"],
+                    help="relative rounds/s drop tolerated (default %(default)s)")
+    ap.add_argument("--tol-wall", type=float, default=DEFAULT_TOL["wall"],
+                    help="relative wall_s growth tolerated (default %(default)s)")
+    ap.add_argument("--tol-acc", type=float, default=DEFAULT_TOL["acc"],
+                    help="absolute final_test_acc drop tolerated "
+                         "(default %(default)s)")
+    ap.add_argument("--tol-compiles", type=float,
+                    default=DEFAULT_TOL["compiles"],
+                    help="absolute extra steady-state compiles tolerated "
+                         "(default %(default)s)")
+    ap.add_argument("--json", action="store_true", help="machine-readable")
+    args = ap.parse_args(argv)
+
+    try:
+        candidate = load_bench(args.candidate)
+        baseline = load_bench(args.baseline)
+    except (OSError, ValueError) as e:
+        print(f"regress: {e}", file=sys.stderr)
+        return 2
+
+    rows = compare(candidate, baseline,
+                   tol={"rounds": args.tol_rounds, "wall": args.tol_wall,
+                        "acc": args.tol_acc, "compiles": args.tol_compiles})
+    regressed = any(r["status"] == "regress" for r in rows)
+    if args.json:
+        print(json.dumps({"regressed": regressed, "rows": rows,
+                          "candidate": args.candidate,
+                          "baseline": args.baseline}, indent=2))
+    else:
+        print(f"candidate: {args.candidate}\nbaseline:  {args.baseline}\n")
+        print(render(rows))
+    return 1 if regressed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -187,6 +187,36 @@ def summarize(run_dir: str) -> dict[str, Any]:
             rob["quorum_revives"] = len(qrev)
         out["robustness"] = rob
 
+    # -- cost model (obs/costmodel.py) -----------------------------------
+    # XLA's own accounting per compiled program + live HBM watermarks
+    prog_costs = [e for e in events if e["kind"] == "program_cost"]
+    marks = [e for e in events if e["kind"] == "hbm_watermark"]
+    profiles = [e for e in events if e["kind"] == "profile_captured"]
+    if prog_costs or marks or profiles:
+        cm: dict[str, Any] = {}
+        if prog_costs:
+            cm["programs"] = {
+                e.get("fn", "?"): {k: e[k] for k in
+                                   ("level", "flops", "bytes_accessed",
+                                    "argument_bytes", "temp_bytes",
+                                    "peak_hbm_bytes") if e.get(k) is not None}
+                for e in prog_costs}
+        peaks = [e["peak_hbm_bytes"] for e in prog_costs
+                 if e.get("peak_hbm_bytes") is not None]
+        peaks += [e["peak_bytes"] for e in marks
+                  if e.get("peak_bytes") is not None]
+        if peaks:
+            cm["hbm_peak_bytes"] = max(peaks)
+        if marks:
+            cm["hbm_watermarks"] = len(marks)
+        if profiles:
+            cm["profiles_captured"] = sorted(
+                {e.get("trace_dir", "?") for e in profiles})
+        roof = _roofline_from_events(events, prog_costs, ends)
+        if roof:
+            cm["roofline"] = roof
+        out["cost_model"] = cm
+
     # -- compiles --------------------------------------------------------
     compiles = [e for e in events if e["kind"] in ("jit_compile",
                                                    "jit_recompile")]
@@ -198,6 +228,49 @@ def summarize(run_dir: str) -> dict[str, Any]:
             d["compiles" if e["kind"] == "jit_compile" else "recompiles"] += 1
         out["compiles"] = by_fn
 
+    return out
+
+
+def _roofline_from_events(events: list[dict], prog_costs: list[dict],
+                          ends: list[dict]) -> dict[str, Any] | None:
+    """Achieved FLOP/s and bytes/s of the run from the captured round
+    program's XLA cost + the iteration walls. Utilization against peak is
+    added only when the run's backend was a TPU: the datasheet lookup is
+    jax-free, whereas the CPU peak is a measured microbenchmark that the
+    (pure host-side) report CLI must not run."""
+    if not prog_costs or not ends:
+        return None
+    by_fn = {e.get("fn"): e for e in prog_costs}
+    pc = by_fn.get("train_iteration_eval") or by_fn.get("train_round")
+    if not pc or not pc.get("flops"):
+        return None
+    wall = sum(e.get("wall_s", 0.0) for e in ends)
+    rounds = sum(e.get("rounds", 0) for e in ends)
+    if wall <= 0 or not rounds:
+        return None
+    per_dispatch = max(rounds / len(ends), 1) \
+        if pc["fn"] == "train_iteration_eval" else 1   # fused: R rounds/call
+    flops_pr = pc["flops"] / per_dispatch
+    bytes_pr = (pc.get("bytes_accessed") or 0) / per_dispatch
+    out: dict[str, Any] = {
+        "program": pc["fn"], "source": "cost_analysis",
+        "flops_per_round": round(flops_pr, 1),
+        "achieved_flops_per_s": round(flops_pr * rounds / wall, 1)}
+    if bytes_pr:
+        out["achieved_bytes_per_s"] = round(bytes_pr * rounds / wall, 1)
+    start = next((e for e in events if e["kind"] == "run_start"), None)
+    backend = (start or {}).get("backend", "") or ""
+    if backend.startswith("tpu"):
+        from feddrift_tpu.obs import costmodel
+        dtype = (start or {}).get("compute_dtype", "float32")
+        pf, src = costmodel.peak_flops(backend, dtype)
+        out["flops_utilization"] = round(
+            out["achieved_flops_per_s"] / pf, 6)
+        if bytes_pr:
+            pb, _ = costmodel.peak_bytes_per_s(backend)
+            out["bandwidth_utilization"] = round(
+                out["achieved_bytes_per_s"] / pb, 6)
+        out["peak_source"] = src
     return out
 
 
@@ -310,6 +383,36 @@ def render(summary: dict[str, Any]) -> str:
         for fn, d in sorted(comp.items()):
             L.append(f"  {fn:<24} compiles={d['compiles']} "
                      f"recompiles={d['recompiles']}")
+
+    cm = summary.get("cost_model")
+    if cm:
+        L.append("")
+        L.append("cost model (XLA accounting):")
+        for fn, d in sorted((cm.get("programs") or {}).items()):
+            bits = []
+            if d.get("flops") is not None:
+                bits.append(f"{d['flops'] / 1e6:.1f} MFLOP")
+            if d.get("bytes_accessed") is not None:
+                bits.append(f"{d['bytes_accessed'] / 1e6:.1f} MB accessed")
+            if d.get("peak_hbm_bytes") is not None:
+                bits.append(f"peak {d['peak_hbm_bytes'] / 1e6:.1f} MB")
+            L.append(f"  {fn:<24} {', '.join(bits) or d.get('level', '?')}")
+        if cm.get("hbm_peak_bytes") is not None:
+            n = f" ({cm['hbm_watermarks']} live watermarks)" \
+                if cm.get("hbm_watermarks") else ""
+            L.append(f"  peak HBM: {cm['hbm_peak_bytes'] / 1e6:.1f} MB{n}")
+        roof = cm.get("roofline")
+        if roof:
+            line = (f"  roofline ({roof['program']}): "
+                    f"{roof['achieved_flops_per_s'] / 1e9:.3f} GFLOP/s")
+            if roof.get("achieved_bytes_per_s"):
+                line += f", {roof['achieved_bytes_per_s'] / 1e9:.3f} GB/s"
+            if roof.get("flops_utilization") is not None:
+                line += (f" — {100 * roof['flops_utilization']:.2f}% of "
+                         f"{roof.get('peak_source', 'peak')}")
+            L.append(line)
+        if cm.get("profiles_captured"):
+            L.append(f"  profiler traces: {cm['profiles_captured']}")
     return "\n".join(L)
 
 
@@ -321,6 +424,9 @@ def main(argv: list[str] | None = None) -> int:
         description="render a run report from events.jsonl + metrics.jsonl")
     ap.add_argument("run_dirs", nargs="+", help="run directories")
     ap.add_argument("--json", action="store_true", help="machine-readable")
+    ap.add_argument("--trace", action="store_true",
+                    help="also write <run_dir>/trace.json (Chrome-trace-"
+                         "event timeline from spans.jsonl + events.jsonl)")
     args = ap.parse_args(argv)
 
     summaries = []
@@ -329,6 +435,13 @@ def main(argv: list[str] | None = None) -> int:
         if not s["has_metrics"] and not s["has_events"]:
             print(f"{d}: no metrics.jsonl or events.jsonl found")
             return 1
+        if args.trace:
+            from feddrift_tpu.obs import spans
+            path = spans.write_trace(d)
+            with open(path) as f:
+                n = len(json.load(f)["traceEvents"])
+            s["trace"] = {"path": path, "events": n}
+            print(f"trace written: {path} ({n} events)")
         summaries.append(s)
 
     if args.json:

@@ -1,0 +1,225 @@
+"""Unified trace timeline: spans + events → one Chrome-trace JSON.
+
+A *span* is a named wall-clock interval (phase, iteration, comm publish)
+recorded live; an *event* (obs/events.py) is a point occurrence. This
+module records the former to ``<run_dir>/spans.jsonl`` and folds BOTH
+into a single Chrome-trace-event JSON that Perfetto / ``chrome://tracing``
+loads directly:
+
+    python -m feddrift_tpu report <run_dir> --trace   # writes trace.json
+
+Timeline layout: one **process lane per host process** (multihost runs
+stamp ``jax.process_index()`` into every span, so merged traces keep one
+lane each), and within a process one **thread lane per recording thread**
+(the runner's main thread, comm-broker background threads) plus one
+reserved ``events`` lane where every ``events.jsonl`` record appears as
+an instant. Span ``ts`` is unix epoch microseconds — the same clock
+events carry in ``_ts`` — so the two sources interleave correctly.
+
+Recording is O(1) per span (one lock, one append, one optional file
+write) and the recorder is disabled until ``configure()`` arms it, so
+un-instrumented processes pay one attribute check on the hot path.
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import os
+import threading
+import time
+from typing import Any, Iterator
+
+import contextlib
+
+RING_SIZE = 8192
+
+# tid of the reserved per-process instant-event lane in trace.json
+EVENTS_LANE_TID = 0
+
+
+class SpanRecorder:
+    """Thread-safe span sink: in-memory ring + optional JSONL file."""
+
+    def __init__(self, path: str | None = None, pid: int = 0,
+                 enabled: bool = True) -> None:
+        self._lock = threading.Lock()
+        self.ring: collections.deque = collections.deque(maxlen=RING_SIZE)
+        self.pid = pid
+        self.enabled = enabled
+        self.path = path
+        self._fh = None
+        if path:
+            os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+            self._fh = open(path, "a")
+
+    def record(self, name: str, ts: float, dur: float, cat: str = "phase",
+               **args: Any) -> dict | None:
+        """Record one completed span. ``ts`` unix seconds, ``dur`` seconds."""
+        if not self.enabled:
+            return None
+        rec = {"name": name, "cat": cat,
+               "ts": round(ts * 1e6, 1),          # µs — trace-event unit
+               "dur": round(dur * 1e6, 1),
+               "pid": self.pid, "tid": threading.get_ident()}
+        if args:
+            rec["args"] = args
+        with self._lock:
+            self.ring.append(rec)
+            if self._fh is not None:
+                self._fh.write(json.dumps(rec) + "\n")
+                self._fh.flush()
+        return rec
+
+    @contextlib.contextmanager
+    def span(self, name: str, cat: str = "phase",
+             **args: Any) -> Iterator[None]:
+        """Context manager recording the enclosed interval."""
+        if not self.enabled:
+            yield
+            return
+        t0 = time.time()
+        p0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.record(name, t0, time.perf_counter() - p0, cat, **args)
+
+    def spans(self, name: str | None = None) -> list[dict]:
+        with self._lock:
+            out = list(self.ring)
+        return out if name is None else [s for s in out if s["name"] == name]
+
+    def close(self) -> None:
+        with self._lock:
+            if self._fh is not None:
+                self._fh.close()
+                self._fh = None
+
+    def __enter__(self) -> "SpanRecorder":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+# ----------------------------------------------------------------------
+# Process-local default recorder, mirroring obs.events: layers record
+# through the module-level helpers, the runner re-points the sink per run.
+# Starts disabled so library use without a run context costs ~nothing.
+_recorder = SpanRecorder(None, enabled=False)
+_rec_lock = threading.Lock()
+
+
+def get_recorder() -> SpanRecorder:
+    return _recorder
+
+
+def configure(path: str | None, pid: int = 0) -> SpanRecorder:
+    """Install a fresh default recorder writing to ``path`` (None =
+    memory-only, still enabled). Closes the previous recorder's sink."""
+    global _recorder
+    with _rec_lock:
+        old, _recorder = _recorder, SpanRecorder(path, pid=pid)
+        old.close()
+    return _recorder
+
+
+def span(name: str, cat: str = "phase", **args: Any):
+    return _recorder.span(name, cat, **args)
+
+
+def record(name: str, ts: float, dur: float, cat: str = "phase",
+           **args: Any) -> dict | None:
+    return _recorder.record(name, ts, dur, cat, **args)
+
+
+# ----------------------------------------------------------------------
+# Chrome-trace export
+def _load_jsonl(path: str) -> list[dict]:
+    rows: list[dict] = []
+    if not os.path.isfile(path):
+        return rows
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rows.append(json.loads(line))
+            except json.JSONDecodeError:
+                continue                         # tolerate a torn tail line
+    return rows
+
+
+def build_trace(run_dir: str) -> dict:
+    """Chrome-trace-event JSON (object form) for one run directory.
+
+    Sources ``spans.jsonl`` (duration events, ``ph: "X"``) and
+    ``events.jsonl`` (instant events, ``ph: "i"``, one reserved lane per
+    process). Output invariants, tested in tests/test_obs_perf.py: every
+    event has name/ph/ts/pid/tid, durations are non-negative, the list is
+    sorted by ts, and each (pid, tid) lane carries metadata naming it.
+    """
+    spans = _load_jsonl(os.path.join(run_dir, "spans.jsonl"))
+    events = _load_jsonl(os.path.join(run_dir, "events.jsonl"))
+
+    trace: list[dict] = []
+    # (pid, raw tid) -> compact per-process tid; tid 0 = events lane
+    lanes: dict[tuple[int, Any], int] = {}
+    pids: set[int] = set()
+
+    def lane(pid: int, raw_tid: Any) -> int:
+        key = (pid, raw_tid)
+        if key not in lanes:
+            lanes[key] = 1 + sum(1 for (p, _) in lanes if p == pid)
+        return lanes[key]
+
+    for s in spans:
+        pid = int(s.get("pid", 0))
+        pids.add(pid)
+        ev = {"name": s.get("name", "?"), "cat": s.get("cat", "phase"),
+              "ph": "X", "ts": float(s.get("ts", 0.0)),
+              "dur": max(float(s.get("dur", 0.0)), 0.0),
+              "pid": pid, "tid": lane(pid, s.get("tid", "main"))}
+        if s.get("args"):
+            ev["args"] = s["args"]
+        trace.append(ev)
+
+    for e in events:
+        if "_ts" not in e or "kind" not in e:
+            continue
+        pid = int(e.get("pid", 0))
+        pids.add(pid)
+        args = {k: v for k, v in e.items()
+                if k not in ("_ts", "kind", "pid") and _json_scalarish(v)}
+        trace.append({"name": e["kind"], "cat": "event", "ph": "i",
+                      "s": "t", "ts": round(float(e["_ts"]) * 1e6, 1),
+                      "pid": pid, "tid": EVENTS_LANE_TID, "args": args})
+
+    trace.sort(key=lambda ev: ev["ts"])
+
+    meta: list[dict] = []
+    for pid in sorted(pids):
+        meta.append({"ph": "M", "name": "process_name", "pid": pid,
+                     "tid": 0, "args": {"name": f"process {pid}"}})
+        meta.append({"ph": "M", "name": "thread_name", "pid": pid,
+                     "tid": EVENTS_LANE_TID, "args": {"name": "events"}})
+    for (pid, _raw), tid in sorted(lanes.items(), key=lambda kv: kv[1]):
+        meta.append({"ph": "M", "name": "thread_name", "pid": pid,
+                     "tid": tid, "args": {"name": f"thread {tid}"}})
+
+    return {"traceEvents": meta + trace, "displayTimeUnit": "ms"}
+
+
+def _json_scalarish(v: Any) -> bool:
+    return isinstance(v, (str, int, float, bool, list)) or v is None
+
+
+def write_trace(run_dir: str, out_path: str | None = None) -> str:
+    """Build + write ``trace.json`` for a run dir; returns the path."""
+    trace = build_trace(run_dir)
+    out_path = out_path or os.path.join(run_dir, "trace.json")
+    with open(out_path, "w") as f:
+        json.dump(trace, f)
+    return out_path

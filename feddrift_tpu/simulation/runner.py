@@ -81,6 +81,11 @@ class Experiment:
                 dp_stddev=cfg.robust_dp_stddev),
             byz_scale=cfg.byzantine_scale,
             byz_std=cfg.byzantine_std,
+            # Static: XLA cost-capture level (obs/costmodel.py) — each
+            # tracked program's first compile also harvests cost_analysis
+            # (and memory_analysis under "compiled") into program_cost
+            # events + gauges.
+            cost_capture=cfg.cost_model,
         )
         # Device-resident dataset, client axis sharded over the mesh. The
         # client axis is padded to a multiple of the mesh size with phantom
@@ -122,6 +127,15 @@ class Experiment:
         self.events = obs.configure(
             os.path.join(out_dir, "events.jsonl")
             if (out_dir and self.is_coordinator) else None)
+        # Span recorder: wall-clock intervals (phases, iterations, comm
+        # publishes) next to the event stream; `report <run_dir> --trace`
+        # folds both into one Perfetto-loadable trace.json. Every process
+        # records (pid = its lane in the merged timeline); only the
+        # coordinator gets a file sink, like the event bus.
+        self.spans = obs.spans.configure(
+            os.path.join(out_dir, "spans.jsonl")
+            if (out_dir and self.is_coordinator) else None,
+            pid=jax.process_index())
         self.algo.bind(self.x, self.y, self.logger, self.C_pad)
         from feddrift_tpu.platform.faults import (ByzantineInjector,
                                                   FailureDetector,
@@ -154,13 +168,14 @@ class Experiment:
                             max_rollbacks=cfg.divergence_max_rollbacks,
                             warmup=cfg.divergence_warmup_rounds)
             if cfg.divergence_guard else None)
-        self.tracer = PhaseTracer(registry=obs.registry())
+        self.tracer = PhaseTracer(registry=obs.registry(), spans=self.spans)
         self.events.emit(
             "run_start", dataset=cfg.dataset, model=cfg.model,
             algo=cfg.concept_drift_algo, algo_arg=cfg.concept_drift_algo_arg,
             clients=self.C_, num_models=self.pool.num_models,
             comm_round=cfg.comm_round, train_iterations=cfg.train_iterations,
-            backend=jax.default_backend(), seed=cfg.seed)
+            backend=jax.default_backend(), compute_dtype=cfg.compute_dtype,
+            seed=cfg.seed)
         if cfg.debug_checks:
             from feddrift_tpu.utils.invariants import enable_nan_debugging
             enable_nan_debugging()
@@ -376,6 +391,11 @@ class Experiment:
             phases={k: {"total_s": round(v["total_s"], 4),
                         "count": v["count"]}
                     for k, v in self.last_phase_summary.items()})
+        # One trace lane entry spanning the whole time step, and a live
+        # HBM watermark per iteration (silently a no-op on backends
+        # without memory_stats — CPU).
+        self.spans.record("iteration", t0, wall, cat="runner", iteration=t)
+        obs.costmodel.record_hbm_watermark(iteration=t)
         if self.out_dir and self.is_coordinator:
             # Prometheus textfile-collector snapshot, refreshed per
             # iteration (atomic replace; scrape-safe).

@@ -23,7 +23,14 @@ its own start time on the context-manager frame, so overlapping phases on
 one thread and concurrent phases across threads both accumulate
 correctly. Pass ``registry=obs.registry()`` to additionally record each
 phase duration into a ``phase_seconds{phase=...}`` histogram instrument
-(bench snapshots read those).
+(bench snapshots read those), and ``spans=obs.spans.get_recorder()`` to
+put every phase on the unified trace timeline
+(``report <run_dir> --trace`` → Perfetto-loadable trace.json).
+
+``xla_trace`` is no-op-safe under nesting: ``jax.profiler.start_trace``
+raises when a trace is already active, so an inner ``xla_trace`` runs its
+body without starting (or stopping) anything; each completed capture
+emits a ``profile_captured`` event carrying the trace dir.
 """
 
 from __future__ import annotations
@@ -42,15 +49,17 @@ class PhaseTracer:
     """Accumulates wall-clock per named phase; nestable, re-entrant, and
     thread-safe."""
 
-    def __init__(self, registry=None) -> None:
+    def __init__(self, registry=None, spans=None) -> None:
         self.totals: dict[str, float] = defaultdict(float)
         self.counts: dict[str, int] = defaultdict(int)
         self._lock = threading.Lock()
         self._registry = registry
+        self._spans = spans
 
     @contextlib.contextmanager
     def phase(self, name: str) -> Iterator[None]:
         t0 = time.perf_counter()
+        wall0 = time.time()
         try:
             yield
         finally:
@@ -61,6 +70,8 @@ class PhaseTracer:
             if self._registry is not None:
                 self._registry.histogram("phase_seconds",
                                          phase=name).observe(dt)
+            if self._spans is not None:
+                self._spans.record(name, wall0, dt, cat="phase")
 
     def summary(self) -> dict[str, dict[str, float]]:
         with self._lock:
@@ -80,22 +91,52 @@ class PhaseTracer:
             self.counts.clear()
 
 
+# True while an xla_trace capture is active in this process. jax raises
+# on a nested start_trace; this flag makes the nested entry a clean no-op
+# (body runs, outer capture owns the trace) instead of a warning-swallowed
+# exception race with jax's own global state.
+_trace_active = False
+_trace_lock = threading.Lock()
+
+
 @contextlib.contextmanager
 def xla_trace(log_dir: str) -> Iterator[None]:
-    """jax.profiler trace (TensorBoard format). No-op-safe: if the profiler
-    cannot start (e.g. already active), the body still runs."""
+    """jax.profiler trace (TensorBoard format). No-op-safe: if a trace is
+    already active (nested use) or the profiler cannot start, the body
+    still runs and the outer/foreign capture is left untouched. Each
+    completed capture emits a ``profile_captured`` event with the dir."""
+    global _trace_active
     import jax
     started = False
-    try:
-        jax.profiler.start_trace(log_dir)
-        started = True
-    except Exception as e:                      # pragma: no cover
-        log.warning("xla_trace: profiler unavailable (%s)", e)
+    with _trace_lock:
+        nested = _trace_active
+        if not nested:
+            _trace_active = True
+    if nested:
+        log.debug("xla_trace: trace already active; nested capture of %s "
+                  "is a no-op", log_dir)
+    else:
+        try:
+            jax.profiler.start_trace(log_dir)
+            started = True
+        except Exception as e:                  # pragma: no cover
+            log.warning("xla_trace: profiler unavailable (%s)", e)
+            with _trace_lock:
+                _trace_active = False
     try:
         yield
     finally:
         if started:
-            jax.profiler.stop_trace()
+            try:
+                jax.profiler.stop_trace()
+                from feddrift_tpu import obs
+                obs.emit("profile_captured", trace_dir=log_dir)
+            finally:
+                with _trace_lock:
+                    _trace_active = False
+        elif not nested:
+            with _trace_lock:
+                _trace_active = False
 
 
 @contextlib.contextmanager

@@ -16,6 +16,7 @@ ship a new event kind that is undocumented, or documentation for an event
 that no longer exists.
 
     python scripts/check_events_schema.py        # exit 0 = consistent
+    python scripts/check_events_schema.py --list # print the taxonomy
 """
 
 from __future__ import annotations
@@ -87,6 +88,13 @@ def check() -> list[str]:
 
 
 def main() -> int:
+    if "--list" in sys.argv[1:]:
+        # machine-consumable taxonomy dump, one kind per line (used by
+        # tests/test_obs_perf.py and handy for grepping run artifacts)
+        from feddrift_tpu.obs.events import EVENT_KINDS
+        for kind in sorted(EVENT_KINDS):
+            print(kind)
+        return 0
     problems = check()
     for p in problems:
         print(f"check_events_schema: {p}", file=sys.stderr)

@@ -1,0 +1,46 @@
+#!/usr/bin/env bash
+# Perf-regression gate: measure the canonical smoke bench on this host and
+# hold it against (a) itself — a warm back-to-back rerun, tight-ish
+# noise-aware thresholds — and (b) the committed BENCH_r05.json artifact
+# with loose thresholds (r05 is a FULL 1600-round run; rounds/s and
+# accuracy are only loosely comparable to a smoke run, and wall_s is
+# skipped automatically because the round counts differ).
+#
+# Run as the slow-marked tier-2 test tests/test_obs_perf.py::test_perf_gate,
+# or standalone:  bash scripts/perf_gate.sh
+#
+# Exit nonzero iff a regress verdict fires (or the bench itself fails).
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+out="$(mktemp -d)"
+trap 'rm -rf "$out"' EXIT
+
+echo "[perf_gate 1/4] warm run (populates the persistent compile cache)"
+python bench.py --smoke --cpu > "$out/warm.json"
+
+echo "[perf_gate 2/4] measured run"
+python bench.py --smoke --cpu > "$out/bench.json"
+
+echo "[perf_gate 3/4] cost-model fields present"
+python - "$out/bench.json" <<'EOF'
+import json, sys
+d = json.loads(open(sys.argv[1]).read().strip().splitlines()[-1])
+assert d.get("mfu_estimate") is not None, "mfu_estimate is null"
+assert d.get("hbm_peak_bytes") is not None, "hbm_peak_bytes is null"
+assert d.get("mfu", {}).get("source") in ("cost_analysis", "analytic"), d.get("mfu")
+print(f"  mfu_estimate={d['mfu_estimate']} (source={d['mfu']['source']}), "
+      f"hbm_peak_bytes={d['hbm_peak_bytes']}")
+EOF
+
+echo "[perf_gate 4/4] regress: self-comparison (warm), then vs BENCH_r05.json"
+# back-to-back smoke runs on a busy 1-core host: generous relative noise
+# margins, but identical round counts make every metric comparable
+python -m feddrift_tpu regress "$out/bench.json" --baseline "$out/warm.json" \
+    --tol-rounds 0.6 --tol-wall 2.0 --tol-acc 0.02 --tol-compiles 0
+# committed full-run artifact: loose floors that still catch a
+# catastrophic (order-of-magnitude) throughput or accuracy collapse
+python -m feddrift_tpu regress "$out/bench.json" --baseline BENCH_r05.json \
+    --tol-rounds 0.9 --tol-acc 0.15
+
+echo "perf_gate: OK"

@@ -30,8 +30,12 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-PEAK_BF16 = 197e12   # bench.py PEAK_FLOPS: v4-class chip, bf16
-PEAK_F32 = 98e12
+# Single source of truth for chip peaks + FLOP counting: the cost model
+# (feddrift_tpu/obs/costmodel.py). This script is a consumer, not a fork.
+from feddrift_tpu.obs.costmodel import PEAK_FLOPS  # noqa: E402
+
+PEAK_BF16 = PEAK_FLOPS["tpu"]["bfloat16"]
+PEAK_F32 = PEAK_FLOPS["tpu"]["float32"]
 
 # BENCH_r03_tpu_smoke.json, the only on-chip measurements in four rounds
 SMOKE = {
@@ -46,6 +50,7 @@ def measure_flops():
     import jax
     jax.config.update("jax_platforms", "cpu")
     import bench
+    from feddrift_tpu.obs import costmodel
     from feddrift_tpu.simulation.runner import Experiment
 
     out = {}
@@ -64,7 +69,7 @@ def measure_flops():
                                    train_iterations=2, comm_round=2,
                                    sample_num=32)
         exp = Experiment(cfg)
-        fpe = bench._flops_per_example(exp)
+        fpe = costmodel.forward_flops_per_example(exp)
         n_params = sum(
             int(__import__("numpy").prod(l.shape[1:]))
             for l in jax.tree_util.tree_leaves(exp.pool.params))
