@@ -28,6 +28,7 @@ from typing import Any, Callable, Optional
 import jax.numpy as jnp
 import numpy as np
 
+from feddrift_tpu import obs
 from feddrift_tpu.comm import multihost
 
 _REGISTRY: dict[str, Callable[..., "DriftAlgorithm"]] = {}
@@ -247,6 +248,29 @@ class DriftAlgorithm:
         pass
 
     # -- helpers --------------------------------------------------------
+    def emit_assignment(self, t: int) -> None:
+        """Emit the per-iteration ``cluster_assign`` event: the dense
+        client -> model vector (the EM view's E-step state,
+        arXiv:2111.10192) plus per-model client counts, and — when the
+        dataset carries ground-truth concepts — the live oracle ARI /
+        purity of this iteration's clustering (obs/lineage.py scores the
+        whole timeline offline from these same events)."""
+        assign = np.asarray(self.test_model_idx(t), dtype=np.int64)
+        counts = np.bincount(assign, minlength=self.M)
+        fields: dict = {
+            "assignment": assign.tolist(),
+            "model_clients": {int(m): int(counts[m])
+                              for m in np.nonzero(counts)[0]},
+        }
+        concepts = getattr(self.ds, "concepts", None)
+        if concepts is not None and t < concepts.shape[0]:
+            truth = np.asarray(concepts)[t, : self.C]
+            fields["oracle_ari"] = round(
+                obs.lineage.adjusted_rand_index(truth, assign), 4)
+            fields["oracle_purity"] = round(
+                obs.lineage.cluster_purity(truth, assign), 4)
+        obs.emit("cluster_assign", **fields)
+
     def feature_mask_for(self, mask_flat: np.ndarray) -> jnp.ndarray:
         """Reshape [M, F_flat] masks to the dataset's feature shape (KUE
         reshapes masks to the sample shape, FedAvgEnsTrainerKue.py:68-71)."""

@@ -73,7 +73,8 @@ class _AueBase(DriftAlgorithm):
             for m in reversed(range(1, self.model_num)):
                 self.pool.copy_slot(m, m - 1)
             self.pool.reinit_slot(0)
-            obs.emit("model_replaced", model=0, reason="aue_window_shift")
+            obs.emit("model_replaced", model=0, reason="aue_window_shift",
+                     window=int(self.model_num))
             # Weights shift with the models; fresh model starts "perfect".
             if self.per_client_weights:
                 self.ens_weights[:, 1:] = self.ens_weights[:, :-1]
@@ -206,7 +207,9 @@ class Kue(DriftAlgorithm):
             self.pool.reinit_slot(self.worst_idx)
             obs.emit("model_replaced", model=int(self.worst_idx),
                      reason="kue_worst_kappa",
-                     kappa=round(float(self.ens_weights[self.worst_idx]), 4))
+                     kappa=round(float(self.ens_weights[self.worst_idx]), 4),
+                     kappa_all=[round(float(k), 4)
+                                for k in self.ens_weights])
         # win-1 time window; per-model Poisson bootstrap sample weights.
         w = time_weights("win-1", self.C, t, self.T1)
         self._tw = jnp.asarray(np.broadcast_to(w[None], (self.M, self.C, self.T1)).copy())

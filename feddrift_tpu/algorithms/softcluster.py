@@ -270,9 +270,11 @@ class SoftCluster(DriftAlgorithm):
             if self.mmacc_acc[c] - newest_acc > self.mmacc_delta:
                 obs.emit("drift_detected", client=c,
                          acc_drop=round(float(self.mmacc_acc[c] - newest_acc), 4),
+                         threshold=self.mmacc_delta,
                          best_model=int(best[c]))
                 if next_free == -42:
-                    next_free = self._find_unused_model_lru(t, original_model=best[c])
+                    next_free = self._find_unused_model_lru(
+                        t, original_model=best[c], client=c)
                 if next_free != -1:
                     self.event_counts["spawns"] += 1
                     self.weights[t, :, c] = 0.0
@@ -327,8 +329,10 @@ class SoftCluster(DriftAlgorithm):
             if self.mmacc_acc[c] - newest_acc > self.h_delta:
                 obs.emit("drift_detected", client=c,
                          acc_drop=round(float(self.mmacc_acc[c] - newest_acc), 4),
+                         threshold=self.h_delta,
                          best_model=int(best))
-                next_free = self._find_unused_model_lru(t, original_model=best)
+                next_free = self._find_unused_model_lru(
+                    t, original_model=best, client=c)
                 if next_free != -1:
                     self.event_counts["spawns"] += 1
                     self.h_marked[c] = (next_free, t + self.h_w)
@@ -393,15 +397,30 @@ class SoftCluster(DriftAlgorithm):
             if len(group) > 1:
                 merged_log.append("(" + ", ".join(str(m) for m in group) + ")")
             base = group[0]
+            base_pos = in_use.index(base)
             for second in group[1:]:
-                self._merge(t, base, second)
+                # The decision's evidence rides on the event: the winning
+                # pairwise distance (vs. the merge threshold Δ') and the
+                # merged model's full distance row over every in-use model,
+                # so a lineage replay can show WHY this pair merged and how
+                # close the runners-up were.
+                second_pos = in_use.index(second)
+                self._merge(t, base, second, evidence={
+                    "distance": round(float(dist[base_pos, second_pos]), 4),
+                    "threshold": self.h_deltap,
+                    "in_use": [int(m) for m in in_use],
+                    "distance_row": [round(float(d), 4)
+                                     for d in dist[second_pos]],
+                })
         if merged_log and self.logger:
             self.logger.set_summary("Merge", ", ".join(merged_log))
 
-    def _merge(self, t: int, base: int, second: int) -> None:
+    def _merge(self, t: int, base: int, second: int,
+               evidence: dict | None = None) -> None:
         """Weighted param average + weight union (merge, :1048-1072)."""
         self.event_counts["merges"] += 1
-        obs.emit("cluster_merge", base=int(base), merged=int(second))
+        obs.emit("cluster_merge", base=int(base), merged=int(second),
+                 **(evidence or {}))
         w1 = float(self.weights[: t + 1, base, :].sum())
         w2 = float(self.weights[: t + 1, second, :].sum())
         s = w1 + w2
@@ -409,8 +428,13 @@ class SoftCluster(DriftAlgorithm):
         self.weights[: t + 1, base, :] += self.weights[: t + 1, second, :]
         self.weights[:, second, :] = 0.0
 
-    def _find_unused_model_lru(self, t: int, original_model: int) -> int:
-        """LRU slot allocation (find_unused_model_lru, :1011-1036)."""
+    def _find_unused_model_lru(self, t: int, original_model: int,
+                               client: int | None = None) -> int:
+        """LRU slot allocation (find_unused_model_lru, :1011-1036).
+
+        ``client`` is the drift-trigger client — recorded on the
+        cluster_create event so the lineage layer can attribute each
+        spawned model to the client set that demanded it."""
         if self.h_next_free < self.M:
             nxt = self.h_next_free
             self.h_next_free += 1
@@ -428,7 +452,8 @@ class SoftCluster(DriftAlgorithm):
         # initialise from the drifted client's previous model (:1031-1033)
         self.pool.copy_slot(nxt, original_model)
         obs.emit("cluster_create", model=int(nxt),
-                 init_from=int(original_model))
+                 init_from=int(original_model),
+                 client=None if client is None else int(client))
         return nxt
 
     # -- softclusterreset ----------------------------------------------
@@ -507,7 +532,11 @@ class SoftCluster(DriftAlgorithm):
                         obs.emit(
                             "cluster_split", model=int(m), new_model=int(nxt),
                             clients_kept=[int(participating[i]) for i in cl1],
-                            clients_moved=[int(participating[i]) for i in cl2])
+                            clients_moved=[int(participating[i]) for i in cl2],
+                            alpha_cross=round(float(alpha_cross), 4),
+                            gamma=self.cfl_gamma,
+                            mean_norm=round(mean_norm, 6),
+                            max_norm=round(max_norm, 6))
 
         if did_split and self.cfl_retrain == "all":           # (:1219-1221)
             for tt in range(t):
@@ -553,11 +582,18 @@ class SoftCluster(DriftAlgorithm):
                              if (self.weights[: t + 1, m, :] > 0).any())
         self.logger.set_summary("num_models", num_models)
         # The paper's key hidden state, now first-class telemetry: one
-        # cluster_state event per iteration plus a live gauge.
+        # cluster_state event per iteration plus a live gauge, and the
+        # dense assignment vector (cluster_assign) with live oracle
+        # ARI/purity when ground truth exists.
+        assign = self.test_model_idx(t)
+        counts = np.bincount(assign, minlength=self.M)
         obs.registry().gauge("num_models").set(num_models)
         obs.emit("cluster_state", num_models=int(num_models),
                  spawns=self.event_counts["spawns"],
-                 merges=self.event_counts["merges"])
+                 merges=self.event_counts["merges"],
+                 model_clients={int(m): int(counts[m])
+                                for m in np.nonzero(counts)[0]})
+        self.emit_assignment(t)
 
         trained_by = {m: set(np.nonzero(self.weights[: t + 1, m, :].sum(0))[0])
                       for m in range(self.M)}

@@ -96,7 +96,9 @@ class DriftSurf(DriftAlgorithm):
                (acc_pred < acc_stab - self.delta / 2):
                 obs.emit("drift_detected", detector="driftsurf",
                          acc_pred=round(acc_pred, 4),
-                         acc_best=round(self.acc_best, 4))
+                         acc_best=round(self.acc_best, 4),
+                         acc_stab=round(acc_stab, 4),
+                         threshold=self.delta)
                 self.state = "reac"
                 self.key_params["reac"] = None
                 self.train_data["reac"] = []
@@ -250,11 +252,12 @@ class MultiModel(DriftAlgorithm):
             if self.acc_dict[c] - best_acc > self.delta and next_free != -1:
                 obs.emit("drift_detected", client=c,
                          acc_drop=round(float(self.acc_dict[c] - best_acc), 4),
+                         threshold=self.delta,
                          best_model=int(best_model))
                 if not any(self.train_data[next_free][cc]
                            for cc in range(self.C)):
                     obs.emit("cluster_create", model=int(next_free),
-                             init_from=None)
+                             init_from=None, client=int(c))
                 best_model = next_free
             self.train_data[best_model][c].append(t)
             self.train_idx[c] = best_model
@@ -294,6 +297,7 @@ class MultiModel(DriftAlgorithm):
                 for it in self.train_data[m][c]:
                     w[m, c, it] = 1.0
         self._tw = jnp.asarray(w)
+        self.emit_assignment(t)
 
     def round_inputs(self, t: int, r: int):
         return self._tw, self._ones_sample_w, self._ones_feat_mask, jnp.float32(1.0)

@@ -16,6 +16,8 @@ checkpoint.
     python -m feddrift_tpu list   # algorithms / datasets / models
     python -m feddrift_tpu report runs/my-run   # telemetry run report
     python -m feddrift_tpu report runs/my-run --trace   # + trace.json
+    python -m feddrift_tpu report runs/my-run --follow  # live tail + alerts
+    python -m feddrift_tpu lineage runs/my-run  # cluster genealogy + oracle ARI
     python -m feddrift_tpu regress bench_new.json --baseline BENCH_r05.json
 
 Logging is configured in exactly one place (obs.setup_logging), driven by
@@ -124,6 +126,25 @@ def main(argv: list[str] | None = None) -> int:
                        help="also export <run_dir>/trace.json — a "
                             "Perfetto/chrome://tracing-loadable timeline "
                             "built from spans.jsonl + events.jsonl")
+    rep_p.add_argument("--follow", action="store_true",
+                       help="bounded tail mode: stream events + health "
+                            "alerts (obs/alerts.py, evaluated offline) "
+                            "until run_end or --follow-timeout, then "
+                            "render the report")
+    rep_p.add_argument("--follow-timeout", type=float, default=30.0)
+    rep_p.add_argument("--poll", type=float, default=0.5)
+
+    lin_p = sub.add_parser(
+        "lineage", help="reconstruct the cluster genealogy DAG from a "
+                        "run's events.jsonl — evidence-annotated "
+                        "create/merge/split/delete with slot reuse "
+                        "resolved into stable lineage ids, plus "
+                        "per-iteration oracle ARI/purity for synthetic "
+                        "ground truth (obs/lineage.py)")
+    lin_p.add_argument("run_dir")
+    lin_p.add_argument("--dot", type=str, default=None,
+                       help="also write a Graphviz DOT export here")
+    lin_p.add_argument("--json", action="store_true")
 
     reg_p = sub.add_parser(
         "regress", help="perf-regression gate: compare a bench.py artifact "
@@ -140,7 +161,7 @@ def main(argv: list[str] | None = None) -> int:
     # --log_level is also accepted after the subcommand for convenience
     # (SUPPRESS default: an absent post-subcommand flag must not clobber a
     # pre-subcommand one — both write the same namespace attribute)
-    for p in (run_p, res_p, rep_p, reg_p):
+    for p in (run_p, res_p, rep_p, reg_p, lin_p):
         p.add_argument("--log_level", type=str, default=argparse.SUPPRESS,
                        help=argparse.SUPPRESS)
 
@@ -154,7 +175,18 @@ def main(argv: list[str] | None = None) -> int:
         from feddrift_tpu.obs.report import main as report_main
         return report_main(args.run_dirs
                            + (["--json"] if args.json else [])
-                           + (["--trace"] if args.trace else []))
+                           + (["--trace"] if args.trace else [])
+                           + (["--follow",
+                               "--follow-timeout", str(args.follow_timeout),
+                               "--poll", str(args.poll)]
+                              if args.follow else []))
+
+    if args.cmd == "lineage":
+        # pure host-side: no jax / backend initialisation needed
+        from feddrift_tpu.obs.lineage import main as lineage_main
+        return lineage_main([args.run_dir]
+                            + (["--dot", args.dot] if args.dot else [])
+                            + (["--json"] if args.json else []))
 
     if args.cmd == "regress":
         # pure host-side: no jax / backend initialisation needed

@@ -163,6 +163,14 @@ class ExperimentConfig:
     divergence_max_rollbacks: int = 3      # consecutive rollbacks before abort
     divergence_warmup_rounds: int = 5      # healthy rounds before spike arms
 
+    # --- decision observability (obs/alerts.py; docs/OBSERVABILITY.md) --
+    # Live rule-based health monitor tapping the event bus: cluster-count
+    # churn, oracle-ARI collapse, divergence+Byzantine co-occurrence,
+    # eval-gap stall, client outages -> alert_raised events + alerts.jsonl.
+    alerts: bool = True
+    alert_window: int = 3           # churn window (iterations)
+    alert_churn_threshold: int = 4  # structural cluster events per window
+
     def __post_init__(self) -> None:
         if self.client_num_per_round > self.client_num_in_total:
             raise ValueError("client_num_per_round > client_num_in_total")
@@ -183,6 +191,10 @@ class ExperimentConfig:
             raise ValueError("byzantine_prob must be in [0, 1]")
         if self.acc_staleness_limit < 0:
             raise ValueError("acc_staleness_limit must be >= 0")
+        if self.alert_window < 1:
+            raise ValueError("alert_window must be >= 1")
+        if self.alert_churn_threshold < 1:
+            raise ValueError("alert_churn_threshold must be >= 1")
 
     @property
     def byzantine_client_list(self) -> list[int]:

@@ -27,6 +27,11 @@ were all invisible. This package is the missing observability layer:
   (Perfetto-loadable ``trace.json``, one lane per process/thread).
 - ``obs.regress``     — the perf-regression gate over bench artifacts
   (CLI: ``python -m feddrift_tpu regress <bench.json> --baseline ...``).
+- ``obs.lineage``     — cluster genealogy DAG reconstruction + oracle
+  ARI/purity scoring (CLI: ``python -m feddrift_tpu lineage <run_dir>``).
+- ``obs.alerts``      — declarative rule-based health monitor: live as an
+  event-bus tap (``cfg.alerts``) and offline via ``report --follow``,
+  raising ``alert_raised`` events + ``alerts.jsonl``.
 
 Event kinds are a CLOSED set (``events.EVENT_KINDS``): ``emit()`` rejects
 unknown kinds, and ``scripts/check_events_schema.py`` statically checks that
@@ -50,8 +55,9 @@ from feddrift_tpu.obs.instruments import (  # noqa: F401
     Registry,
     registry,
 )
-from feddrift_tpu.obs import costmodel, spans  # noqa: F401  (import order:
-# both depend only on obs.events/obs.instruments, which are bound above)
+from feddrift_tpu.obs import alerts, costmodel, lineage, spans  # noqa: F401
+# (import order: all depend only on obs.events/obs.instruments, bound above;
+# lineage is numpy+stdlib only and alerts touches the bus solely via taps)
 
 _LOG_FORMAT = "%(asctime)s %(name)s %(levelname)s %(message)s"
 

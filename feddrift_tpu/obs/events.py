@@ -58,7 +58,10 @@ EVENT_KINDS = frozenset({
     "cluster_delete",       # a model is deleted / reset out of use
     "cluster_split",        # CFL gradient bipartition fired
     "cluster_state",        # per-iteration summary: models in use etc.
+    "cluster_assign",       # dense per-client -> model assignment (E-step)
     "model_replaced",       # ensemble rotation (AUE window, KUE worst model)
+    # run-health monitor (obs/alerts.py)
+    "alert_raised",         # a declarative health rule fired
     # comm transports (comm/netbroker.py, comm/mqtt.py)
     "conn_drop",            # a broker connection closed / was cleaned up
     "conn_wedged_drop",     # bounded outbound queue overflow -> force-drop
@@ -93,6 +96,7 @@ class EventBus:
         self._lock = threading.Lock()
         self._context: dict[str, Any] = {}
         self.ring: collections.deque = collections.deque(maxlen=RING_SIZE)
+        self._taps: list = []
         self._fh = None
         self.path = path
         if path:
@@ -112,7 +116,28 @@ class EventBus:
             if self._fh is not None:
                 self._fh.write(json.dumps(rec, default=_json_default) + "\n")
                 self._fh.flush()
+            taps = tuple(self._taps)
+        # Taps (the live alert monitor) run AFTER the bus lock is
+        # released: a tap may legally re-enter emit() (alert_raised), and
+        # a slow tap must not serialize hot-path emitters. A failing tap
+        # never takes the run down with it.
+        for tap in taps:
+            try:
+                tap(rec)
+            except Exception:   # noqa: BLE001 — observability stays passive
+                pass
         return rec
+
+    def add_tap(self, fn) -> None:
+        """Register a callable observing every emitted record (called on
+        the emitting thread, after the record is persisted)."""
+        with self._lock:
+            self._taps.append(fn)
+
+    def remove_tap(self, fn) -> None:
+        with self._lock:
+            if fn in self._taps:
+                self._taps.remove(fn)
 
     def set_context(self, **ctx: Any) -> None:
         """Merge ambient fields (iteration=..., round=...) into every

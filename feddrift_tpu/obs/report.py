@@ -120,6 +120,43 @@ def summarize(run_dir: str) -> dict[str, Any]:
             "final": states[-1].get("num_models"),
         }
 
+    # -- assignment matrix + oracle agreement (cluster_assign events,
+    # obs/lineage.py; ground truth rides in run_start.concept_matrix) ----
+    assigns: dict[int, dict] = {}
+    for e in events:
+        if e["kind"] == "cluster_assign" and e.get("iteration") is not None:
+            assigns[int(e["iteration"])] = e          # last one per t wins
+    if assigns:
+        out["assignments"] = [
+            {"iteration": it,
+             "assignment": assigns[it].get("assignment"),
+             "oracle_ari": assigns[it].get("oracle_ari"),
+             "oracle_purity": assigns[it].get("oracle_purity")}
+            for it in sorted(assigns)]
+        aris = [a["oracle_ari"] for a in out["assignments"]
+                if a["oracle_ari"] is not None]
+        if aris:
+            purs = [a["oracle_purity"] for a in out["assignments"]
+                    if a["oracle_purity"] is not None]
+            out["oracle"] = {
+                "final_ari": aris[-1], "best_ari": max(aris),
+                "mean_ari": round(sum(aris) / len(aris), 4),
+                "final_purity": purs[-1] if purs else None,
+            }
+
+    # -- alerts (obs/alerts.py: alerts.jsonl or live alert_raised) -------
+    alert_recs = _load_jsonl(os.path.join(run_dir, "alerts.jsonl")) \
+        or [e for e in events if e["kind"] == "alert_raised"]
+    if alert_recs:
+        by_rule: dict[str, int] = {}
+        for a in alert_recs:
+            by_rule[a.get("rule", "?")] = by_rule.get(a.get("rule", "?"), 0) + 1
+        out["alerts"] = {
+            "count": len(alert_recs),
+            "by_rule": by_rule,
+            "last": alert_recs[-5:],
+        }
+
     # -- faults ---------------------------------------------------------
     faults = [e for e in events if e["kind"] in FAULT_KINDS]
     if faults:
@@ -330,6 +367,30 @@ def render(summary: dict[str, Any]) -> str:
     elif not mc:
         L.append("  (no drift/cluster events recorded)")
 
+    assigns = summary.get("assignments")
+    if assigns:
+        has_oracle = any(a.get("oracle_ari") is not None for a in assigns)
+        head = "  assignment matrix (client → model"
+        head += ", oracle ARI/purity):" if has_oracle else "):"
+        L.append(head)
+        shown = assigns if len(assigns) <= 40 else assigns[:39]
+        for a in shown:
+            vec = " ".join(str(v) for v in (a.get("assignment") or []))
+            line = f"    t={a['iteration']:<3} [{vec}]"
+            if a.get("oracle_ari") is not None:
+                line += f"  ARI={a['oracle_ari']:.3f}"
+            if a.get("oracle_purity") is not None:
+                line += f" purity={a['oracle_purity']:.3f}"
+            L.append(line)
+        if len(assigns) > 40:
+            L.append(f"    ... ({len(assigns) - 39} more iterations — "
+                     "see `lineage` for the full timeline)")
+        osum = summary.get("oracle")
+        if osum:
+            L.append(f"  oracle agreement: final ARI {osum['final_ari']:.4f} "
+                     f"(best {osum['best_ari']:.4f}, "
+                     f"mean {osum['mean_ari']:.4f})")
+
     faults = summary.get("faults")
     L.append("")
     L.append("faults:")
@@ -376,6 +437,17 @@ def render(summary: dict[str, Any]) -> str:
         if rob.get("quorum_revives"):
             L.append(f"  quorum revives: {rob['quorum_revives']}")
 
+    al = summary.get("alerts")
+    if al:
+        L.append("")
+        L.append("alerts:")
+        rules = ", ".join(f"{r}×{n}" for r, n in sorted(al["by_rule"].items()))
+        L.append(f"  {al['count']} raised — {rules}")
+        for a in al["last"]:
+            where = f"t={a.get('iteration', '?')}"
+            L.append(f"  {where:<6} [{a.get('severity', '?')}] "
+                     f"{a.get('rule', '?')}: {a.get('message', '')}")
+
     comp = summary.get("compiles")
     if comp:
         L.append("")
@@ -416,8 +488,84 @@ def render(summary: dict[str, Any]) -> str:
     return "\n".join(L)
 
 
+def follow(run_dir: str, timeout_s: float = 30.0, poll_s: float = 0.5,
+           out=None) -> int:
+    """Bounded tail mode: stream events.jsonl as it grows, print notable
+    events (every alert_raised, plus offline rule evaluation via
+    obs/alerts.py for runs recorded without live alerting), and render
+    the ordinary report once the run ends — or the time bound expires.
+
+    Returns 0; being cut off by the bound is the contract, not an error.
+    """
+    import sys
+    import time as _time
+
+    from feddrift_tpu.obs import alerts as obs_alerts
+
+    out = out or sys.stdout
+    path = os.path.join(run_dir, "events.jsonl")
+    mon = obs_alerts.AlertMonitor()          # offline: no file, no bus
+    seen_alerts: set = set()                 # (rule, iteration) dedupe
+    offset = 0
+    deadline = _time.monotonic() + timeout_s
+    done = False
+
+    def fmt_alert(a: dict, origin: str) -> str:
+        return (f"[{origin}] t={a.get('iteration', '?')} "
+                f"{a.get('severity', '?')}/{a.get('rule', '?')}: "
+                f"{a.get('message', '')}")
+
+    print(f"following {path} (bound {timeout_s:.0f}s; "
+          "ends at run_end)", file=out)
+    while not done and _time.monotonic() < deadline:
+        new = []
+        if os.path.isfile(path):
+            with open(path) as f:
+                f.seek(offset)
+                chunk = f.read()
+                offset = f.tell()
+            for line in chunk.splitlines():
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    new.append(json.loads(line))
+                except json.JSONDecodeError:
+                    offset -= len(line) + 1   # torn tail: re-read next poll
+                    break
+        for e in new:
+            kind = e.get("kind")
+            if kind == "alert_raised":
+                seen_alerts.add((e.get("rule"), e.get("iteration")))
+                print(fmt_alert(e, "live"), file=out)
+            else:
+                n_before = len(mon.alerts)
+                mon.observe(e)
+                for a in mon.alerts[n_before:]:
+                    key = (a.get("rule"), a.get("iteration"))
+                    if key not in seen_alerts:
+                        seen_alerts.add(key)
+                        print(fmt_alert(a, "offline"), file=out)
+            if kind == "iteration_end":
+                print(f"t={e.get('iteration', '?')} done: "
+                      f"Test/Acc={e.get('test_acc')} "
+                      f"({e.get('rounds_per_s')} rounds/s)", file=out)
+            if kind == "run_end":
+                done = True
+        if not done:
+            _time.sleep(poll_s)
+
+    print("", file=out)
+    if not done:
+        print(f"(bound reached after {timeout_s:.0f}s — report below is a "
+              "snapshot of an unfinished run)", file=out)
+    print(render(summarize(run_dir)), file=out)
+    return 0
+
+
 def main(argv: list[str] | None = None) -> int:
     import argparse
+    import sys
 
     ap = argparse.ArgumentParser(
         prog="feddrift_tpu report",
@@ -427,13 +575,36 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--trace", action="store_true",
                     help="also write <run_dir>/trace.json (Chrome-trace-"
                          "event timeline from spans.jsonl + events.jsonl)")
+    ap.add_argument("--follow", action="store_true",
+                    help="bounded tail mode: stream events + alerts until "
+                         "run_end or --follow-timeout, then render the "
+                         "report")
+    ap.add_argument("--follow-timeout", type=float, default=30.0,
+                    help="max seconds to follow (default 30)")
+    ap.add_argument("--poll", type=float, default=0.5,
+                    help="follow-mode poll interval in seconds")
     args = ap.parse_args(argv)
+
+    for d in args.run_dirs:
+        if not os.path.isdir(d):
+            print(f"report: run_dir {d!r} does not exist", file=sys.stderr)
+            return 1
+
+    if args.follow:
+        if len(args.run_dirs) != 1:
+            print("report: --follow takes exactly one run_dir",
+                  file=sys.stderr)
+            return 1
+        return follow(args.run_dirs[0], timeout_s=args.follow_timeout,
+                      poll_s=args.poll)
 
     summaries = []
     for d in args.run_dirs:
         s = summarize(d)
         if not s["has_metrics"] and not s["has_events"]:
-            print(f"{d}: no metrics.jsonl or events.jsonl found")
+            print(f"report: {d}: no metrics.jsonl or events.jsonl — "
+                  "nothing to report (is this a run directory?)",
+                  file=sys.stderr)
             return 1
         if args.trace:
             from feddrift_tpu.obs import spans

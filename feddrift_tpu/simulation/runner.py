@@ -136,6 +136,19 @@ class Experiment:
             os.path.join(out_dir, "spans.jsonl")
             if (out_dir and self.is_coordinator) else None,
             pid=jax.process_index())
+        # Live health monitor (obs/alerts.py): a bus tap evaluating the
+        # declarative rule set over every emitted event; fired alerts are
+        # re-emitted as alert_raised AND appended to alerts.jsonl so a
+        # crashed run keeps its alert trail.
+        self.alerts = None
+        if cfg.alerts:
+            self.alerts = obs.alerts.AlertMonitor(
+                rules=obs.alerts.default_rules(
+                    churn_threshold=cfg.alert_churn_threshold,
+                    churn_window=cfg.alert_window),
+                path=os.path.join(out_dir, "alerts.jsonl")
+                if (out_dir and self.is_coordinator) else None,
+            ).attach(self.events)
         self.algo.bind(self.x, self.y, self.logger, self.C_pad)
         from feddrift_tpu.platform.faults import (ByzantineInjector,
                                                   FailureDetector,
@@ -169,13 +182,22 @@ class Experiment:
                             warmup=cfg.divergence_warmup_rounds)
             if cfg.divergence_guard else None)
         self.tracer = PhaseTracer(registry=obs.registry(), spans=self.spans)
+        # The ground-truth concept matrix rides along in run_start for
+        # synthetic datasets: obs/lineage.py scores the recorded
+        # cluster_assign timeline against it (oracle ARI/purity) without
+        # re-materializing the dataset. Size-gated so a thousand-client
+        # scaling run does not bloat its first event line.
+        concepts = getattr(self.ds, "concepts", None)
+        concept_matrix = (concepts[:, : self.C_].tolist()
+                          if concepts is not None
+                          and concepts[:, : self.C_].size <= 20000 else None)
         self.events.emit(
             "run_start", dataset=cfg.dataset, model=cfg.model,
             algo=cfg.concept_drift_algo, algo_arg=cfg.concept_drift_algo_arg,
             clients=self.C_, num_models=self.pool.num_models,
             comm_round=cfg.comm_round, train_iterations=cfg.train_iterations,
             backend=jax.default_backend(), compute_dtype=cfg.compute_dtype,
-            seed=cfg.seed)
+            seed=cfg.seed, concept_matrix=concept_matrix)
         if cfg.debug_checks:
             from feddrift_tpu.utils.invariants import enable_nan_debugging
             enable_nan_debugging()

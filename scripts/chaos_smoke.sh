@@ -10,13 +10,19 @@
 #    mid-run (preemption), then re-launched with --auto_resume; asserts a
 #    clean exit, a preempt_checkpoint event, and a duplicate-free
 #    metrics.jsonl.
-# 3) the event taxonomy stays consistent (check_events_schema) — including
-#    the robustness kinds (byzantine_injected, robust_agg_applied,
-#    acc_stale_excluded, quorum_revive).
+# 3) the event taxonomy stays consistent (check_events_schema --strict:
+#    code<->docs correspondence AND no dead kinds) — including the
+#    robustness kinds (byzantine_injected, robust_agg_applied,
+#    acc_stale_excluded, quorum_revive) and the decision-observability
+#    kinds (cluster_assign, alert_raised).
 # 4) adversary domain — the e2e chaos+Byzantine scenario (10 clients, 20%
 #    dropout, 2 sign-flippers): robust_agg=trimmed_mean stays near the
 #    clean run's accuracy while plain mean degrades more (runs the tier-1
 #    test that encodes exactly that, so the smoke and CI cannot drift).
+# 5) decision observability — kill two clients in a live run and assert
+#    the alert monitor raises (alert_raised in events.jsonl AND a line in
+#    alerts.jsonl), then run the `lineage` CLI on the same run and assert
+#    the genealogy renders and `report` surfaces the alerts section.
 #
 # Usage: scripts/chaos_smoke.sh            (~2-3 min on one CPU core)
 set -euo pipefail
@@ -27,12 +33,12 @@ OUT=$(mktemp -d)
 trap 'rm -rf "$OUT"' EXIT
 RUN="$OUT/run"
 
-echo "== [1/4] chaos transport e2e (drop_prob=0.2 + broker kill/restart) =="
+echo "== [1/5] chaos transport e2e (drop_prob=0.2 + broker kill/restart) =="
 timeout -k 10 300 python -m pytest tests/test_resilience.py -q \
     -p no:cacheprovider -p no:randomly \
     -k "ChaosEndToEnd or survives_broker_kill or heartbeat_missed"
 
-echo "== [2/4] preemption: SIGTERM a real run, then --auto_resume =="
+echo "== [2/5] preemption: SIGTERM a real run, then --auto_resume =="
 ARGS=(--dataset sine --model fnn --concept_drift_algo win-1
       --concept_num 2 --client_num_in_total 4 --client_num_per_round 4
       --train_iterations 6 --comm_round 8 --epochs 2
@@ -69,12 +75,46 @@ print(f"resume OK: {len(rows)} metric rows, final Test/Acc="
       f"{rows[-1]['Test/Acc']:.4f}")
 EOF
 
-echo "== [3/4] event taxonomy consistency =="
-python scripts/check_events_schema.py
+echo "== [3/5] event taxonomy consistency (strict: no dead kinds) =="
+python scripts/check_events_schema.py --strict
 
-echo "== [4/4] byzantine smoke: trimmed_mean defends where mean fails =="
+echo "== [4/5] byzantine smoke: trimmed_mean defends where mean fails =="
 timeout -k 10 300 python -m pytest tests/test_robust_agg.py -q \
     -p no:cacheprovider -p no:randomly \
     -k "trimmed_mean_defends_where_mean_fails"
+
+echo "== [5/5] decision observability: kill clients -> alerts + lineage =="
+LRUN="$OUT/lineage-run"
+timeout -k 10 300 python - "$LRUN" <<'EOF'
+import sys
+from feddrift_tpu.config import ExperimentConfig
+from feddrift_tpu.simulation.runner import Experiment
+out = sys.argv[1]
+cfg = ExperimentConfig(
+    dataset="sine", model="fnn", concept_num=4,
+    concept_drift_algo="softcluster", concept_drift_algo_arg="H_A_C_1_10_0",
+    client_num_in_total=10, client_num_per_round=10,
+    train_iterations=3, comm_round=6, epochs=3, sample_num=50,
+    batch_size=25, frequency_of_the_test=3, lr=0.05, report_client=0,
+    fault_enabled=True, failure_patience=2, seed=0, out_dir=out)
+exp = Experiment(cfg, out_dir=out)
+exp.fault_injector.kill(3)     # -> client_outage alert via the live tap
+exp.fault_injector.kill(7)
+exp.run()
+EOF
+grep -q alert_raised "$LRUN/events.jsonl" \
+    || { echo "missing alert_raised event"; exit 1; }
+test -s "$LRUN/alerts.jsonl" \
+    || { echo "missing/empty alerts.jsonl"; exit 1; }
+python -m feddrift_tpu lineage "$LRUN" > "$OUT/lineage.txt"
+grep -q "cluster genealogy" "$OUT/lineage.txt" \
+    || { echo "lineage render failed"; exit 1; }
+grep -q "assignment timeline" "$OUT/lineage.txt" \
+    || { echo "lineage timeline missing"; exit 1; }
+# (report output to a file: `| grep -q` would close the pipe early and
+# trip pipefail on report's BrokenPipeError)
+python -m feddrift_tpu report "$LRUN" > "$OUT/report.txt"
+grep -q "alerts:" "$OUT/report.txt" \
+    || { echo "report missing alerts section"; exit 1; }
 
 echo "chaos_smoke: ALL OK"

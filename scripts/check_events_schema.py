@@ -15,8 +15,9 @@ Together with ``emit()``'s runtime validation this makes it impossible to
 ship a new event kind that is undocumented, or documentation for an event
 that no longer exists.
 
-    python scripts/check_events_schema.py        # exit 0 = consistent
-    python scripts/check_events_schema.py --list # print the taxonomy
+    python scripts/check_events_schema.py          # exit 0 = consistent
+    python scripts/check_events_schema.py --strict # + dead-kind detection
+    python scripts/check_events_schema.py --list   # print the taxonomy
 """
 
 from __future__ import annotations
@@ -32,6 +33,16 @@ sys.path.insert(0, ROOT)
 _EMIT_RE = re.compile(r"""\bemit\(\s*\n?\s*["']([a-z_]+)["']""")
 # taxonomy rows: | `kind` | layer | ...
 _DOC_ROW_RE = re.compile(r"^\|\s*`([a-z_]+)`\s*\|", re.MULTILINE)
+
+# Kinds emitted through a COMPUTED first argument (obs.emit(kind, ...)),
+# which the literal scan cannot attribute: kind -> the one file whose
+# source must still contain the literal. Strict mode verifies the literal
+# is present there, so a refactor that drops the emission path still
+# trips dead-kind detection instead of hiding behind this allowlist.
+_INDIRECT_KINDS = {
+    "jit_compile": "feddrift_tpu/core/step.py",     # _note_signature's
+    "jit_recompile": "feddrift_tpu/core/step.py",   # kind = ... ternary
+}
 
 
 def emitted_kinds(pkg_dir: str) -> dict[str, list[str]]:
@@ -53,12 +64,25 @@ def emitted_kinds(pkg_dir: str) -> dict[str, list[str]]:
 
 
 def documented_kinds(doc_path: str) -> set[str]:
+    """Kinds documented in the '## Event taxonomy' table ONLY — other
+    tables in the doc (alert rules, file inventory) also use backticked
+    first columns and must not count as taxonomy rows."""
     with open(doc_path, encoding="utf-8") as f:
-        return set(_DOC_ROW_RE.findall(f.read()))
+        text = f.read()
+    start = text.find("## Event taxonomy")
+    if start != -1:
+        end = text.find("\n## ", start + 1)
+        text = text[start:end if end != -1 else len(text)]
+    return set(_DOC_ROW_RE.findall(text))
 
 
-def check() -> list[str]:
-    """Returns a list of problem strings; empty = consistent."""
+def check(strict: bool = False) -> list[str]:
+    """Returns a list of problem strings; empty = consistent.
+
+    ``strict`` additionally fails DEAD KINDS: an ``EVENT_KINDS`` member
+    with zero ``emit()`` sites anywhere in the tree is taxonomy rot — it
+    documents an event no run can ever produce (tier-1 runs strict via
+    tests/test_obs.py)."""
     from feddrift_tpu.obs.events import EVENT_KINDS
 
     problems: list[str] = []
@@ -80,6 +104,16 @@ def check() -> list[str]:
         problems.append(
             f"kind {kind!r} documented in docs/OBSERVABILITY.md but "
             "missing from EVENT_KINDS (stale docs?)")
+    if strict:
+        for kind in sorted(EVENT_KINDS - set(emitted)):
+            site = _INDIRECT_KINDS.get(kind)
+            if site is not None:
+                with open(os.path.join(ROOT, site), encoding="utf-8") as f:
+                    if f'"{kind}"' in f.read():
+                        continue        # indirect emission still in place
+            problems.append(
+                f"kind {kind!r} has ZERO emit sites in feddrift_tpu/ — "
+                "dead taxonomy entry (remove it, or emit it)")
     # sanity: the scan itself must see emission sites, otherwise a regex
     # rot would make this check pass vacuously
     if not emitted:
@@ -95,7 +129,7 @@ def main() -> int:
         for kind in sorted(EVENT_KINDS):
             print(kind)
         return 0
-    problems = check()
+    problems = check(strict="--strict" in sys.argv[1:])
     for p in problems:
         print(f"check_events_schema: {p}", file=sys.stderr)
     if not problems:

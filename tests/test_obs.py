@@ -310,9 +310,7 @@ class TestLoggingSetup:
 
 
 class TestSchemaConsistency:
-    def test_static_taxonomy_check(self):
-        """The tier-1 incarnation of scripts/check_events_schema.py: every
-        emitted kind is in EVENT_KINDS and documented, no stale docs."""
+    def _mod(self):
         import importlib.util
         path = os.path.join(os.path.dirname(__file__), os.pardir, "scripts",
                             "check_events_schema.py")
@@ -320,7 +318,30 @@ class TestSchemaConsistency:
                                                       path)
         mod = importlib.util.module_from_spec(spec)
         spec.loader.exec_module(mod)
-        assert mod.check() == []
+        return mod
+
+    def test_static_taxonomy_check(self):
+        """The tier-1 incarnation of scripts/check_events_schema.py: every
+        emitted kind is in EVENT_KINDS and documented, no stale docs."""
+        assert self._mod().check() == []
+
+    def test_strict_no_dead_kinds(self):
+        """--strict additionally rejects taxonomy members with ZERO emit
+        sites in the tree (dead kinds): an event that can never be
+        produced must not stay documented as if it could."""
+        assert self._mod().check(strict=True) == []
+
+    def test_strict_detects_a_dead_kind(self, monkeypatch):
+        """Negative control: inject a phantom kind into EVENT_KINDS and
+        strict mode must flag it while the lax check stays quiet about
+        emission (it only cross-checks docs)."""
+        mod = self._mod()
+        from feddrift_tpu.obs import events as ev
+        monkeypatch.setattr(
+            ev, "EVENT_KINDS", frozenset(ev.EVENT_KINDS | {"phantom_kind"}))
+        problems = mod.check(strict=True)
+        assert any("phantom_kind" in p and "ZERO emit sites" in p
+                   for p in problems)
 
 
 @pytest.mark.slow
