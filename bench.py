@@ -413,6 +413,47 @@ def _measure(cfg, backend: str) -> dict:
     }
 
 
+def _popscale_cfg(smoke: bool, population: int):
+    """Fixed cohort, growing registered population: the population-scale
+    participation axis (ISSUE 6). Straggler + churn chaos is ON so the
+    measured path is the production-shaped one (masked rounds, registry
+    bookkeeping), and the cohort geometry never changes — the whole point
+    is that XLA programs are shaped by the cohort, not the population."""
+    return _canonical_cfg(
+        smoke, population_size=population, cohort_size=10,
+        cohort_overprovision=2, straggler_prob=0.1,
+        churn_leave_prob=0.01, churn_join_prob=0.02,
+        sample_num=50, batch_size=50, train_iterations=4,
+        comm_round=10 if smoke else 20,
+        cost_model="lowered")     # exact-HBM capture not worth 3 extra compiles here
+
+
+def _popscale_bench(backend: str, smoke: bool) -> list:
+    """rounds/s + steady-state recompile counts vs population size.
+
+    The POPSCALE artifact the `regress` gate checks: throughput must hold
+    within the rounds tolerance per population point and steady-state
+    recompiles must stay ZERO as the population grows 10^2 -> 10^4."""
+    from feddrift_tpu.obs.regress import _compile_counts
+    out = []
+    for population in (100, 1000) if smoke else (100, 1000, 10000):
+        cfg = _popscale_cfg(smoke, population)
+        r = _measure_with_retry(cfg, backend)
+        _, recompiles = _compile_counts(r)
+        out.append({
+            "population": population,
+            "cohort_slots": cfg.cohort_slots,
+            "rounds_per_sec": r.get("value"),
+            "final_test_acc": r.get("final_test_acc"),
+            "wall_s": r.get("wall_s"),
+            "steady_recompiles": recompiles,
+            **({"error": r["error"]} if "error" in r else {}),
+        })
+        print(json.dumps({"partial": f"popscale@{population}", **out[-1]}),
+              file=sys.stderr)
+    return out
+
+
 def _conv_cfg(smoke: bool, **overrides):
     base = dict(
         dataset="cifar10", model="resnet8",
@@ -523,6 +564,10 @@ def main() -> None:
         "dispatch_rtt": _dispatch_rtt(backend),
         "conv_bench": conv,
         "mfu_vs_batch": None if smoke else _mfu_batch_sweep(backend),
+        # population-scaling axis (opt-in: adds ~5 short population-mode
+        # runs); committed as POPSCALE_r0*.json and gated by `regress`
+        "popscale": (_popscale_bench(backend, smoke)
+                     if "--popscale" in sys.argv else None),
     }
     print(json.dumps(out))
     if conv is not None and "error" in conv:

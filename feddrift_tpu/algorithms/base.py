@@ -86,6 +86,13 @@ class DriftAlgorithm:
     # (acc_matrix_at / acc_cells_upto) — the precondition for cfg.stream_data
     # host-streaming execution. Instance attribute where spec-dependent.
     supports_streaming = False
+    # True if the algorithm can run cohort-sampled population rounds
+    # (cfg.population_size > 0): its per-client state must be expressible
+    # as (cluster assignment history, drift-detector arm) so the runner
+    # can reload it from the ClientRegistry for whichever members are
+    # sampled this iteration. Stateless algorithms get this for free via
+    # the base load_cohort_state; instance attribute where kind-dependent.
+    supports_cohort = False
 
     def __init__(self, cfg, ds, pool, step) -> None:
         self.cfg = cfg
@@ -93,7 +100,9 @@ class DriftAlgorithm:
         self.pool = pool
         self.step = step
         self.M = pool.num_models
-        self.C = cfg.client_num_in_total
+        # Device-visible client-axis size: the cohort slots in population
+        # mode (cfg.population_size > 0), every client in dense mode.
+        self.C = cfg.device_clients
         self.T1 = ds.num_steps + 1
         self.N = ds.samples_per_step
         # default device-side constants
@@ -105,6 +114,11 @@ class DriftAlgorithm:
         # the runner before each begin_iteration. Drives stale_clients.
         self._client_ages = np.zeros(self.C, dtype=np.int64)
         self._suspected_clients: tuple[int, ...] = ()
+        # Population mode: the member id behind each cohort slot this
+        # iteration (None in legacy dense mode, where slot == client id),
+        # and the slots with no member behind them (active pop < slots).
+        self._cohort_members: np.ndarray | None = None
+        self._invalid_slots: np.ndarray | None = None
 
     # -- runtime binding ------------------------------------------------
     def bind(self, x, y, logger, c_pad: int) -> None:
@@ -119,6 +133,41 @@ class DriftAlgorithm:
         # with a different dataset must never serve accuracies computed on
         # the previous one.
         self._acc_offer = None
+
+    def rebind_data(self, x, y) -> None:
+        """Population mode: swap in this iteration's gathered cohort shard
+        (same shapes as the previous one — XLA never recompiles). Clears
+        the accuracy-offer cache: a hit keyed to the old data would serve
+        the previous cohort's accuracies."""
+        self.x = x
+        self.y = y
+        self._acc_offer = None
+
+    # -- cohort state bridge (population mode) --------------------------
+    def load_cohort_state(self, t: int, members: np.ndarray,
+                          assign_hist: np.ndarray, arm_acc: np.ndarray,
+                          reserved_models=None) -> None:
+        """Install the sampled members' per-client state for iteration t.
+
+        ``members`` [C] ids (< 0 = phantom slot), ``assign_hist`` [C, T1]
+        each member's own past cluster assignments (-1 = unknown: not
+        sampled that step), ``arm_acc`` [C] drift-detector arms (NaN =
+        never observed), ``reserved_models`` model ids some ACTIVE member
+        outside the cohort is still registered to (slot allocators must
+        not clobber them). The base implementation records the
+        slot->member mapping — sufficient for algorithms without
+        per-client state; stateful algorithms override AND call super()."""
+        self._cohort_members = np.asarray(members, dtype=np.int64)
+        self._invalid_slots = self._cohort_members < 0
+
+    def save_cohort_state(self, t: int) -> None:
+        """Hook before the runner's registry writeback: sync any
+        slot-keyed internal state back to member-keyed storage."""
+
+    def cohort_arm_acc(self, t: int) -> "np.ndarray | None":
+        """[C] per-slot drift-detector arm accuracies to persist per
+        member (None = algorithm has no drift detector)."""
+        return None
 
     def offer_acc_matrix(self, params, offers: "dict[int, np.ndarray]") -> None:
         """Runner ride-along: the fused iteration program's final eval slot
@@ -168,6 +217,10 @@ class DriftAlgorithm:
             out |= ages >= limit
             sus = [c for c in self._suspected_clients if c < self.C]
             out[sus] = True
+        # Phantom cohort slots (population mode, active pop < slots) hold
+        # copies of another member's data: never let them steer decisions.
+        if self._invalid_slots is not None:
+            out |= self._invalid_slots
         return out
 
     def acc_matrix_at(self, t: int, feat_mask=None) -> np.ndarray:
@@ -256,19 +309,33 @@ class DriftAlgorithm:
         purity of this iteration's clustering (obs/lineage.py scores the
         whole timeline offline from these same events)."""
         assign = np.asarray(self.test_model_idx(t), dtype=np.int64)
-        counts = np.bincount(assign, minlength=self.M)
+        members = self._cohort_members
+        scored = assign
+        concepts = getattr(self.ds, "concepts", None)
+        truth = None
+        if members is not None:
+            # population mode: slots are cohort positions; score valid
+            # slots against THEIR members' ground-truth concepts and ship
+            # the member ids so offline consumers can resolve the mapping
+            valid = members >= 0
+            scored = assign[valid]
+            if concepts is not None and t < concepts.shape[0] and valid.any():
+                truth = np.asarray(concepts)[t, members[valid]]
+        elif concepts is not None and t < concepts.shape[0]:
+            truth = np.asarray(concepts)[t, : self.C]
+        counts = np.bincount(scored, minlength=self.M)
         fields: dict = {
             "assignment": assign.tolist(),
             "model_clients": {int(m): int(counts[m])
                               for m in np.nonzero(counts)[0]},
         }
-        concepts = getattr(self.ds, "concepts", None)
-        if concepts is not None and t < concepts.shape[0]:
-            truth = np.asarray(concepts)[t, : self.C]
+        if members is not None:
+            fields["members"] = members[members >= 0].tolist()
+        if truth is not None and len(scored):
             fields["oracle_ari"] = round(
-                obs.lineage.adjusted_rand_index(truth, assign), 4)
+                obs.lineage.adjusted_rand_index(truth, scored), 4)
             fields["oracle_purity"] = round(
-                obs.lineage.cluster_purity(truth, assign), 4)
+                obs.lineage.cluster_purity(truth, scored), 4)
         obs.emit("cluster_assign", **fields)
 
     def feature_mask_for(self, mask_flat: np.ndarray) -> jnp.ndarray:

@@ -22,6 +22,10 @@ class WindowBaseline(DriftAlgorithm):
     cont_one shell arg 19 (run_fedavg_distributed_pytorch.sh:21)."""
 
     name = "window"
+    # Single shared model, no per-client state: the base cohort bridge
+    # (slot->member mapping only) is sufficient for population mode, and
+    # each sampled member trains on its OWN gathered past-step data.
+    supports_cohort = True
 
     def __init__(self, cfg, ds, pool, step) -> None:
         super().__init__(cfg, ds, pool, step)
@@ -56,6 +60,7 @@ class RecencyWeighted(DriftAlgorithm):
     (FedAvgEnsTrainer{Exp,Lin}.py:66)."""
 
     name = "recency"
+    supports_cohort = True          # stateless per client, like window
 
     def begin_iteration(self, t: int) -> None:
         kind = "weight-exp" if self.cfg.concept_drift_algo == "exp" else "weight-linear"

@@ -86,17 +86,39 @@ class SoftCluster(DriftAlgorithm):
         self._tw = None
         # only the CFL variant reads per-client deltas in after_round
         self.needs_client_params = self.kind == "cfl"
+        # Population mode (cfg.population_size > 0): the hard-assignment
+        # variants can reload their per-client state from the registry's
+        # (assignment history, detector arm) columns. Fractional-weight
+        # variants (softmax, gmm) and CFL's per-round gradient clustering
+        # cannot round-trip through an argmax writeback.
+        self.supports_cohort = self.kind in (
+            "hierarchical", "mmacc", "hard", "hard-r", "geni")
+        # member-keyed isolation marks + pending registry remaps
+        # (population mode only; see load/save_cohort_state)
+        self._h_marked_members: dict[int, tuple[int, int]] = {}
+        self._model_remaps: list[tuple[str, int, int]] = []
+        self._reserved_models: set[int] = set()
 
     # ------------------------------------------------------------------
     # plumbing
     def _models_in_use_before(self, t: int, exclude_marked: bool = False) -> list[int]:
-        """Models with any weight before step t (reference :686-690, :855-859)."""
+        """Models with any weight before step t (reference :686-690, :855-859).
+
+        Population mode: the slot-local weight tensor only carries the
+        sampled members' history, so models serving only UNSAMPLED members
+        would look unused — union the registry-known reserved set (models
+        any active member is registered to), excluding out-of-cohort
+        isolation models like the in-cohort ones."""
         marked = {m for (m, _) in self.h_marked.values()} if exclude_marked else set()
-        used = []
-        for m in range(self.M):
-            if (self.weights[:t, m, :] > 0).any() and m not in marked:
-                used.append(m)
-        return used
+        if exclude_marked and self._cohort_members is not None:
+            marked |= {m for (m, _) in self._h_marked_members.values()}
+        used = {m for m in range(self.M)
+                if (self.weights[:t, m, :] > 0).any()}
+        if self._cohort_members is not None and t > 0:
+            used |= {m for m in self._reserved_models if 0 <= m < self.M}
+            if not (used - marked):
+                used.add(0)     # degenerate fresh population: model 0
+        return [m for m in sorted(used) if m not in marked]
 
     def _sync_device_weights(self) -> None:
         # [T1, M, C] -> [M, C, T1] for the train step
@@ -178,6 +200,68 @@ class SoftCluster(DriftAlgorithm):
             self._cluster(self.acc_matrix_at(t), t, round_idx=r + 1)
             self._sync_device_weights()
         return self.pool.params
+
+    # ------------------------------------------------------------------
+    # cohort state bridge (population mode)
+    def load_cohort_state(self, t: int, members, assign_hist, arm_acc,
+                          reserved_models=None) -> None:
+        """Rebuild the slot-indexed state for this iteration's cohort from
+        each member's OWN registry columns: past-step training weights
+        from its assignment history (-1 = not sampled then = no weight —
+        unknown is not evidence), the drift-detector arm from its last
+        observed accuracy (NaN = unarmed: a trigger can never fire off a
+        baseline nobody measured)."""
+        super().load_cohort_state(t, members, assign_hist, arm_acc)
+        hist = np.asarray(assign_hist)
+        self.weights[:] = 0.0
+        for tt in range(min(t, hist.shape[1])):
+            known = np.where(hist[:, tt] >= 0)[0]
+            self.weights[tt, hist[known, tt], known] = 1.0
+        arm = np.asarray(arm_acc, dtype=np.float64)
+        self.mmacc_acc = np.where(np.isnan(arm), -np.inf, arm)
+        self._reserved_models = set(reserved_models or ())
+        if self.kind == "geni":
+            # oracle concepts re-sliced to the sampled members (phantom
+            # slots borrow member 0's column; they are stale-masked anyway)
+            m = np.where(self._cohort_members >= 0, self._cohort_members, 0)
+            self.geni_concepts = self.ds.concepts[:, m]
+        # isolation marks: member-keyed -> slot-keyed for this cohort;
+        # marks whose unmark time has passed expire even if the member
+        # was never resampled in between
+        self._h_marked_members = {
+            mem: mk for mem, mk in self._h_marked_members.items()
+            if mk[1] > t}
+        slot_of = {int(mem): s for s, mem in enumerate(self._cohort_members)
+                   if mem >= 0}
+        self.h_marked = {slot_of[mem]: mk
+                         for mem, mk in self._h_marked_members.items()
+                         if mem in slot_of}
+
+    def save_cohort_state(self, t: int) -> None:
+        """Sync slot-keyed isolation marks back to member-keyed storage
+        (members outside this cohort keep theirs)."""
+        if self._cohort_members is None:
+            return
+        sampled = {int(m) for m in self._cohort_members if m >= 0}
+        keep = {mem: mk for mem, mk in self._h_marked_members.items()
+                if mem not in sampled}
+        for slot, mk in self.h_marked.items():
+            mem = int(self._cohort_members[slot])
+            if mem >= 0:
+                keep[mem] = mk
+        self._h_marked_members = keep
+
+    def cohort_arm_acc(self, t: int) -> np.ndarray:
+        """Persist the detector arm per member; -inf (never armed this
+        life) round-trips as NaN = still unarmed."""
+        return np.where(np.isfinite(self.mmacc_acc), self.mmacc_acc, np.nan)
+
+    def drain_model_remaps(self) -> list[tuple[str, int, int]]:
+        """Pool-structure changes (merges, slot reuse/deletes) recorded
+        this iteration, for the runner to replay onto the registry so
+        unsampled members' stored assignments follow their model."""
+        out, self._model_remaps = self._model_remaps, []
+        return out
 
     # ------------------------------------------------------------------
     # clustering variants
@@ -293,6 +377,8 @@ class SoftCluster(DriftAlgorithm):
                     if mm != keep:
                         self.pool.reinit_slot(mm)
                         self.weights[:, mm, :] = 0.0
+                        if self._cohort_members is not None:
+                            self._model_remaps.append(("clear", mm, -1))
                         obs.emit("cluster_delete", model=int(mm),
                                  reason="feddrift_c_keep_one")
 
@@ -419,6 +505,8 @@ class SoftCluster(DriftAlgorithm):
                evidence: dict | None = None) -> None:
         """Weighted param average + weight union (merge, :1048-1072)."""
         self.event_counts["merges"] += 1
+        if self._cohort_members is not None:
+            self._model_remaps.append(("merge", base, second))
         obs.emit("cluster_merge", base=int(base), merged=int(second),
                  **(evidence or {}))
         w1 = float(self.weights[: t + 1, base, :].sum())
@@ -444,11 +532,18 @@ class SoftCluster(DriftAlgorithm):
                 for m in range(self.M):
                     if (self.weights[tt, m] > 0).any():
                         last_used[m] = tt
+            # Population mode: a model can look LRU-free here only because
+            # its clients were not sampled this iteration — protect any
+            # model some active member is still registered to.
+            for m in self._reserved_models:
+                last_used[m] = max(last_used[m], t - 1)
             lru = np.where(last_used == last_used.min())[0]
             nxt = int(self.rng.choice(lru))
             if last_used[nxt] == t:
                 return -1
             self.weights[:, nxt, :] = 0.0
+            if self._cohort_members is not None:
+                self._model_remaps.append(("clear", nxt, -1))
         # initialise from the drifted client's previous model (:1031-1033)
         self.pool.copy_slot(nxt, original_model)
         obs.emit("cluster_create", model=int(nxt),
@@ -611,6 +706,7 @@ class SoftCluster(DriftAlgorithm):
             "weights": self.weights,
             "mmacc_acc": self.mmacc_acc,
             "h_marked": dict(self.h_marked),
+            "h_marked_members": dict(self._h_marked_members),
             "h_next_free": self.h_next_free,
             "cfl_norm": self.cfl_norm,
             "cfl_eps1": self.cfl_eps1,
@@ -624,6 +720,9 @@ class SoftCluster(DriftAlgorithm):
         self.weights = np.asarray(d["weights"], dtype=np.float32)
         self.mmacc_acc = np.asarray(d["mmacc_acc"])
         self.h_marked = {int(k): tuple(v) for k, v in d["h_marked"].items()}
+        self._h_marked_members = {
+            int(k): tuple(v)
+            for k, v in d.get("h_marked_members", {}).items()}
         self.h_next_free = int(d["h_next_free"])
         self.cfl_norm = float(d["cfl_norm"])
         self.cfl_eps1 = float(d["cfl_eps1"])

@@ -163,6 +163,41 @@ class ExperimentConfig:
     divergence_max_rollbacks: int = 3      # consecutive rollbacks before abort
     divergence_warmup_rounds: int = 5      # healthy rounds before spike arms
 
+    # --- population-scale participation (platform/registry.py,
+    # resilience/participation.py; docs/RESILIENCE.md "Participation
+    # model"). population_size > 0 switches the run from the legacy dense
+    # lockstep loop (every registered client in every round) to
+    # cohort-sampled rounds: a host-side ClientRegistry tracks the whole
+    # population, a seeded sampler draws a fixed-size cohort each
+    # iteration, and the device programs only ever see the cohort axis —
+    # growing the population never changes an XLA program shape.
+    population_size: int = 0       # registered clients; 0 = legacy dense
+    cohort_size: int = 0           # aggregation target per round
+                                   # (0 -> client_num_in_total)
+    cohort_overprovision: int = 0  # extra sampled clients hedging stragglers
+    cohort_seed: int = 0           # cohort schedule seed (pure fn of (seed, t))
+    # Deadline-based partial aggregation: the round closes at
+    # round_deadline (simulated latency units); sampled clients whose
+    # simulated latency exceeds it are masked out of the aggregation
+    # (straggler_masked). Below quorum_frac * cohort_size on-time clients
+    # the round degrades gracefully: params are kept, round_degraded is
+    # emitted, and the RNG/eval cadence still advances.
+    round_deadline: float = 1.0
+    quorum_frac: float = 0.5
+    # Seeded straggler injection (platform/faults.py::StragglerInjector):
+    # each sampled client independently misses the deadline with
+    # straggler_prob; a persistent straggler_slow_frac of the population
+    # additionally misses it with probability ~0.9 every round.
+    straggler_prob: float = 0.0
+    straggler_slow_frac: float = 0.0
+    straggler_seed: int = 0
+    # Seeded population churn (platform/faults.py::ChurnSchedule): each
+    # iteration every active member leaves with churn_leave_prob and every
+    # inactive member (re)joins with churn_join_prob — join/leave/flap.
+    churn_leave_prob: float = 0.0
+    churn_join_prob: float = 0.0
+    churn_seed: int = 0
+
     # --- decision observability (obs/alerts.py; docs/OBSERVABILITY.md) --
     # Live rule-based health monitor tapping the event bus: cluster-count
     # churn, oracle-ARI collapse, divergence+Byzantine co-occurrence,
@@ -172,8 +207,42 @@ class ExperimentConfig:
     alert_churn_threshold: int = 4  # structural cluster events per window
 
     def __post_init__(self) -> None:
-        if self.client_num_per_round > self.client_num_in_total:
+        if self.population_size == 0 \
+                and self.client_num_per_round > self.client_num_in_total:
             raise ValueError("client_num_per_round > client_num_in_total")
+        if self.population_size < 0:
+            raise ValueError("population_size must be >= 0")
+        if self.population_size > 0:
+            if self.population_size < self.cohort_slots:
+                raise ValueError(
+                    f"population_size={self.population_size} < cohort slots "
+                    f"{self.cohort_slots} (cohort_size + cohort_overprovision)")
+            if self.fault_dropout_prob > 0 or self.fault_enabled:
+                raise ValueError(
+                    "fault injection (fault_dropout_prob/fault_enabled) is a "
+                    "dense-pool mechanism; with population_size > 0 use "
+                    "straggler_prob / churn_*_prob instead")
+            if self.byzantine_clients.strip():
+                raise ValueError(
+                    "byzantine_clients indexes the dense client axis and is "
+                    "not yet supported with population_size > 0")
+            if self.stream_data:
+                raise ValueError(
+                    "stream_data and population_size are mutually exclusive: "
+                    "population mode already stages only the cohort's shard")
+        if self.cohort_size < 0 or self.cohort_overprovision < 0:
+            raise ValueError("cohort_size/cohort_overprovision must be >= 0")
+        if self.round_deadline <= 0:
+            raise ValueError("round_deadline must be > 0")
+        if not 0.0 < self.quorum_frac <= 1.0:
+            raise ValueError("quorum_frac must be in (0, 1]")
+        if not 0.0 <= self.straggler_prob < 1.0:
+            raise ValueError("straggler_prob must be in [0, 1)")
+        if not 0.0 <= self.straggler_slow_frac <= 1.0:
+            raise ValueError("straggler_slow_frac must be in [0, 1]")
+        for p in (self.churn_leave_prob, self.churn_join_prob):
+            if not 0.0 <= p < 1.0:
+                raise ValueError("churn probabilities must be in [0, 1)")
         if self.time_stretch < 1:
             raise ValueError("time_stretch must be >= 1")
         if self.divergence_spike_factor <= 1.0:
@@ -195,6 +264,29 @@ class ExperimentConfig:
             raise ValueError("alert_window must be >= 1")
         if self.alert_churn_threshold < 1:
             raise ValueError("alert_churn_threshold must be >= 1")
+
+    # ------------------------------------------------------------------
+    @property
+    def cohort_slots(self) -> int:
+        """Device-visible client-axis size in population mode: the
+        aggregation target plus the straggler hedge. XLA programs are
+        shaped by THIS, never by ``population_size`` — that is the whole
+        compile-count-invariance contract."""
+        return (self.cohort_size or self.client_num_in_total) \
+            + self.cohort_overprovision
+
+    @property
+    def device_clients(self) -> int:
+        """Size of the client axis the device programs see: the sampled
+        cohort in population mode, every client in the legacy dense mode."""
+        return self.cohort_slots if self.population_size > 0 \
+            else self.client_num_in_total
+
+    @property
+    def data_clients(self) -> int:
+        """Number of clients the dataset is generated for: the whole
+        registered population in population mode."""
+        return self.population_size or self.client_num_in_total
 
     @property
     def byzantine_client_list(self) -> list[int]:

@@ -34,6 +34,16 @@ contiguous batch within it (FedAvgEnsTrainerSoftCluster.py:91-113: concatenated
 per-step batch lists, uniform batch choice). With per-sample weights the batch
 is instead drawn by weighted categorical sampling with replacement (the
 Poisson bootstrap resample, retrain.py:65-74).
+
+Population mode (cfg.population_size > 0) changes nothing here by design:
+the client axis C is the sampled COHORT, and the runner re-gathers a new
+cohort's shard into identically-shaped x/y stacks each iteration
+(simulation/runner.py::_prepare_cohort). Stragglers and quorum-degraded
+rounds arrive as the same client_mask rows subsampling always used (an
+all-zero row = keep-prev-params no-op via the masked aggregation), so the
+registered population can grow 10^2 -> 10^5 without a single new argument
+signature — the compile-count invariance the _note_signature detector and
+the POPSCALE regress axis gate.
 """
 
 from __future__ import annotations

@@ -125,6 +125,24 @@ def _mk_word(cfg: ExperimentConfig, change_points: np.ndarray) -> DriftDataset:
 def make_dataset(cfg: ExperimentConfig) -> DriftDataset:
     if cfg.dataset not in _REGISTRY:
         raise KeyError(f"unknown dataset {cfg.dataset!r}; available: {available_datasets()}")
+    if cfg.population_size > 0:
+        # Population mode: the dataset covers every REGISTERED client, not
+        # just the device-visible cohort. The builders read
+        # cfg.client_num_in_total, so hand them a data-shaped clone; the
+        # published 10-column change-point presets tile across the
+        # population (member i drifts like preset column i mod 10 — the
+        # canonical benchmark drift patterns, replicated at scale).
+        import dataclasses
+        data_cfg = dataclasses.replace(
+            cfg, population_size=0,
+            client_num_in_total=cfg.population_size,
+            client_num_per_round=min(cfg.client_num_per_round,
+                                     cfg.population_size))
+        change_points = _resolve_change_points(data_cfg)
+        if change_points.shape[1] < data_cfg.client_num_in_total:
+            reps = -(-data_cfg.client_num_in_total // change_points.shape[1])
+            change_points = np.tile(change_points, (1, reps))
+        return _REGISTRY[cfg.dataset](data_cfg, change_points)
     change_points = _resolve_change_points(cfg)
     if change_points.shape[1] < cfg.client_num_in_total:
         raise ValueError(

@@ -65,7 +65,9 @@ def default_rules(churn_threshold: int = 4, churn_window: int = 3,
                   ari_arm: float = 0.5, ari_drop: float = 0.3,
                   byz_round_window: int = 16,
                   stall_evals: int = 4, stall_gap: float = 0.15,
-                  stall_eps: float = 0.01) -> list[Rule]:
+                  stall_eps: float = 0.01,
+                  quorum_miss_threshold: int = 2,
+                  quorum_miss_window: int = 3) -> list[Rule]:
     """The built-in rule set, thresholds exposed for cfg overrides."""
 
     def check_churn(mon: "AlertMonitor", rec: dict) -> Optional[dict]:
@@ -142,6 +144,22 @@ def default_rules(churn_threshold: int = 4, churn_window: int = 3,
                     "clients": clients}
         return None
 
+    def check_quorum_miss(mon: "AlertMonitor", rec: dict) -> Optional[dict]:
+        lo = mon.iteration - quorum_miss_window
+        n = sum(1 for e in mon.recent["round_degraded"]
+                if (e.get("iteration") or 0) > lo)
+        if n >= quorum_miss_threshold:
+            return {"message": f"{n} quorum-missed (degraded) rounds in the "
+                               f"last {quorum_miss_window} iterations — the "
+                               "cohort repeatedly cannot reach quorum; raise "
+                               "cohort_overprovision / round_deadline or "
+                               "lower quorum_frac",
+                    "count": n, "window": quorum_miss_window,
+                    "threshold": quorum_miss_threshold,
+                    "quorum": rec.get("quorum"),
+                    "on_time": rec.get("on_time")}
+        return None
+
     return [
         Rule("cluster_churn", "warn",
              "structural cluster events per window above threshold",
@@ -159,6 +177,9 @@ def default_rules(churn_threshold: int = 4, churn_window: int = 3,
              "permanent kill or failure-suspected clients",
              ("client_killed", "failure_suspected"), check_outage,
              cooldown=1),
+        Rule("quorum_miss", "crit",
+             "repeated quorum-missed (degraded) rounds within the window",
+             ("round_degraded",), check_quorum_miss, cooldown=2),
     ]
 
 

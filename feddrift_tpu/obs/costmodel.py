@@ -418,7 +418,7 @@ def analytic_round_flops(exp) -> float:
     (amortised in)."""
     cfg, ds = exp.cfg, exp.ds
     fpe = forward_flops_per_example(exp)
-    M, C = exp.pool.num_models, cfg.client_num_in_total
+    M, C = exp.pool.num_models, cfg.device_clients
     train = M * C * cfg.epochs * cfg.batch_size * fpe * 3
     eval_amortised = (M * C * ds.samples_per_step * fpe
                       / max(cfg.frequency_of_the_test, 1))

@@ -84,6 +84,13 @@ EVENT_KINDS = frozenset({
     "robust_agg_applied",   # per-round robust-aggregation stats
     "acc_stale_excluded",   # stale acc entries dropped from a cluster decision
     "quorum_revive",        # quorum floor revived a client (not real liveness)
+    # population-scale participation (platform/registry.py,
+    # resilience/participation.py)
+    "cohort_sampled",       # the iteration's cohort draw from the registry
+    "client_join",          # members (re)joined the registered population
+    "client_leave",         # members left the registered population
+    "straggler_masked",     # sampled members missed the round deadline
+    "round_degraded",       # on-time cohort below quorum: params kept
 })
 
 RING_SIZE = 4096

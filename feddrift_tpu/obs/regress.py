@@ -147,6 +147,36 @@ def compare(candidate: dict, baseline: dict,
             ceil = b[metric] + tol["compiles"]
             rows.append(row(metric, b[metric], c[metric],
                             f"<= {ceil:g}", c[metric] > ceil))
+
+    # population-scaling axis (bench.py --popscale; POPSCALE artifacts):
+    # rounds/s per population point under the throughput tolerance, and
+    # steady-state recompiles as an ABSOLUTE zero gate — growing the
+    # population at fixed cohort must never change an XLA program shape.
+    cps, bps = candidate.get("popscale"), baseline.get("popscale")
+    if isinstance(cps, list) and isinstance(bps, list):
+        by_pop = {e.get("population"): e for e in bps if isinstance(e, dict)}
+        for e in cps:
+            if not isinstance(e, dict):
+                continue
+            p = e.get("population")
+            be = by_pop.get(p)
+            if be is None:
+                skip(f"popscale[{p}]", "population point missing in baseline")
+                continue
+            bv, cv = be.get("rounds_per_sec"), e.get("rounds_per_sec")
+            if bv and cv:
+                floor = bv * (1.0 - tol["rounds"])
+                rows.append(row(f"popscale[{p}].rounds_per_s", bv, cv,
+                                f">= {floor:.3f}", cv < floor))
+            rec = e.get("steady_recompiles")
+            if rec is not None:
+                rows.append(row(f"popscale[{p}].steady_recompiles",
+                                be.get("steady_recompiles"), rec, "== 0",
+                                rec > 0,
+                                note="compile-count invariance over "
+                                     "population size"))
+    elif isinstance(bps, list):
+        skip("popscale", "candidate lacks the popscale axis")
     return rows
 
 

@@ -173,6 +173,48 @@ def summarize(run_dir: str) -> dict[str, Any]:
                                else []),
         }
 
+    # -- participation ---------------------------------------------------
+    # population-scale cohort rounds (platform/registry.py,
+    # resilience/participation.py; docs/RESILIENCE.md Participation model)
+    cohorts = [e for e in events if e["kind"] == "cohort_sampled"]
+    stragglers = [e for e in events if e["kind"] == "straggler_masked"]
+    degraded = [e for e in events if e["kind"] == "round_degraded"]
+    joins = [e for e in events if e["kind"] == "client_join"]
+    leaves = [e for e in events if e["kind"] == "client_leave"]
+    if cohorts or stragglers or degraded or joins or leaves:
+        part: dict[str, Any] = {}
+        if cohorts:
+            last = cohorts[-1]
+            part["cohorts"] = {
+                "iterations": len(cohorts),
+                "population": last.get("population"),
+                "slots": last.get("slots"),
+                "active_final": last.get("active"),
+                "mean_reliability_final": last.get("mean_reliability"),
+            }
+        if stragglers:
+            masked: set[int] = set()
+            for e in stragglers:
+                masked.update(e.get("clients", []))
+            part["stragglers"] = {
+                "rounds": len(stragglers),
+                "masked_total": sum(len(e.get("clients", []))
+                                    for e in stragglers),
+                "distinct_clients": len(masked),
+            }
+        if degraded:
+            part["degraded_rounds"] = {
+                "count": len(degraded),
+                "quorum": degraded[-1].get("quorum"),
+                "last_on_time": degraded[-1].get("on_time"),
+            }
+        if joins or leaves:
+            part["churn"] = {
+                "joins": sum(len(e.get("clients", [])) for e in joins),
+                "leaves": sum(len(e.get("clients", [])) for e in leaves),
+            }
+        out["participation"] = part
+
     # -- resilience ------------------------------------------------------
     # transport healing / preemption / divergence / checkpoint integrity
     # (feddrift_tpu/resilience/, docs/RESILIENCE.md)
@@ -402,6 +444,31 @@ def render(summary: dict[str, Any]) -> str:
                  f"suspected now: {faults['last_suspected']}")
     else:
         L.append("  none recorded")
+
+    part = summary.get("participation")
+    if part:
+        L.append("")
+        L.append("participation:")
+        co = part.get("cohorts")
+        if co:
+            L.append(f"  cohorts: {co['iterations']} iterations x "
+                     f"{co['slots']} slots over population "
+                     f"{co['population']} (active at end: "
+                     f"{co['active_final']}, mean reliability "
+                     f"{co['mean_reliability_final']})")
+        st = part.get("stragglers")
+        if st:
+            L.append(f"  stragglers: {st['masked_total']} masked across "
+                     f"{st['rounds']} rounds "
+                     f"({st['distinct_clients']} distinct clients)")
+        dg = part.get("degraded_rounds")
+        if dg:
+            L.append(f"  degraded rounds: {dg['count']} (quorum "
+                     f"{dg['quorum']}, last on-time {dg['last_on_time']}) "
+                     "— params kept, see quorum_miss alerts")
+        ch = part.get("churn")
+        if ch:
+            L.append(f"  churn: {ch['joins']} joins, {ch['leaves']} leaves")
 
     res = summary.get("resilience")
     if res:

@@ -160,9 +160,21 @@ class FailureDetector:
             obs.registry().gauge("suspected_clients").set(len(now))
             self._last_suspected = now
 
-    def observe_many(self, masks: np.ndarray) -> None:
-        for row in np.asarray(masks):
-            self.observe(row)
+    def observe_many(self, masks: np.ndarray,
+                     observed: np.ndarray | None = None) -> None:
+        """Fold a ``[R, C]`` stack of participation rows.
+
+        ``observed`` (same shape, bool) marks which clients actually had a
+        liveness poll each round. Passing participation masks of SAMPLED
+        rounds without it silently treats every unsampled client as
+        absent — the false-suspicion bug this signature exists to prevent;
+        omit it only when every client is polled every round (the dense
+        lockstep mode).
+        """
+        obs_rows = (np.asarray(observed) if observed is not None
+                    else [None] * len(np.asarray(masks)))
+        for row, orow in zip(np.asarray(masks), obs_rows):
+            self.observe(row, orow)
 
     @property
     def suspected(self) -> np.ndarray:
@@ -175,6 +187,82 @@ class FailureDetector:
             "suspected": self.suspected.tolist(),
             "max_absent_streak": int(self.absent_streak.max(initial=0)),
         }
+
+
+class StragglerInjector:
+    """Deterministic per-(member, round) simulated report latencies.
+
+    Two straggler shapes compose (communication-survey taxonomy):
+    *transient* — any member independently misses the deadline with
+    ``prob`` in any round (network hiccups, device load); *persistent* —
+    a fixed ``slow_frac`` of the population (chosen once from ``seed``)
+    misses it with probability ``SLOW_MISS_PROB`` every round (weak
+    hardware, bad links — TurboSVM-FL's "lazy clients"). Latencies are a
+    pure function of ``(seed, member, round)``: reproducible, resumable,
+    and precomputable for a whole fused iteration.
+    """
+
+    SLOW_MISS_PROB = 0.9
+
+    def __init__(self, population: int, prob: float = 0.0,
+                 slow_frac: float = 0.0, deadline: float = 1.0,
+                 seed: int = 0) -> None:
+        if not 0.0 <= prob < 1.0:
+            raise ValueError(f"straggler prob must be in [0, 1), got {prob}")
+        if not 0.0 <= slow_frac <= 1.0:
+            raise ValueError("slow_frac must be in [0, 1]")
+        self.P = population
+        self.p = prob
+        self.deadline = float(deadline)
+        self.seed = seed
+        rng = np.random.RandomState((seed * 5_000_011 + 17) % (2**31 - 1))
+        self.slow = rng.random_sample(population) < slow_frac
+        # per-member miss probability: transient everywhere, persistent on top
+        self.miss_prob = np.where(self.slow, self.SLOW_MISS_PROB, prob)
+
+    def latencies(self, round_idx: int) -> np.ndarray:
+        """[P] simulated latencies for one global round: on-time members
+        report well inside the deadline, stragglers past it."""
+        rng = np.random.RandomState(
+            (self.seed * 4_000_037 + round_idx) % (2**31 - 1))
+        u = rng.random_sample(self.P)
+        miss = rng.random_sample(self.P) < self.miss_prob
+        on_time_lat = 0.2 * self.deadline * (0.5 + u)   # [0.1, 0.3]·deadline
+        late_lat = self.deadline * (1.5 + u)            # comfortably late
+        return np.where(miss, late_lat, on_time_lat)
+
+
+class ChurnSchedule:
+    """Deterministic per-iteration join/leave/flap membership churn.
+
+    Each iteration every active member leaves with ``leave_prob`` and
+    every inactive member (re)joins with ``join_prob`` — flapping emerges
+    from the composition. Draws are a pure function of ``(seed, t)``, so
+    a resumed run (whose registry checkpoint carries the active set)
+    replays the identical churn the killed run would have seen.
+    """
+
+    def __init__(self, population: int, leave_prob: float = 0.0,
+                 join_prob: float = 0.0, seed: int = 0) -> None:
+        for p in (leave_prob, join_prob):
+            if not 0.0 <= p < 1.0:
+                raise ValueError("churn probabilities must be in [0, 1)")
+        self.P = population
+        self.leave_prob = leave_prob
+        self.join_prob = join_prob
+        self.seed = seed
+
+    def events(self, t: int, active: np.ndarray) -> tuple[np.ndarray,
+                                                          np.ndarray]:
+        """(joins, leaves) index arrays for iteration t given the current
+        active mask."""
+        rng = np.random.RandomState(
+            (self.seed * 6_000_101 + t) % (2**31 - 1))
+        u = rng.random_sample(self.P)
+        active = np.asarray(active, dtype=bool)
+        leaves = np.where(active & (u < self.leave_prob))[0]
+        joins = np.where(~active & (u < self.join_prob))[0]
+        return joins, leaves
 
 
 class ByzantineInjector:

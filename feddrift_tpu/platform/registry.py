@@ -1,0 +1,235 @@
+"""Client registry + cohort sampling: population-scale round membership.
+
+The reference loop (and this repo's legacy dense mode) assumes every
+registered client participates in every round — fine for 10 clients,
+structurally wrong for production FL, where a server samples a small cohort
+from a huge registered population and completes the round with whichever
+subset reports on time (the framing of the communication-perspective
+survey, arXiv:2405.20431, and the "lazy client" problem of TurboSVM-FL,
+arXiv:2401.12012).
+
+This module is the host side of that architecture:
+
+- ``ClientRegistry`` tracks 10^2-10^5 registered clients as dense numpy
+  columns (active flag, last-seen round, consecutive sampled-but-silent
+  streak, reliability EWMA, cluster assignment + per-step assignment
+  history, drift-detector arm accuracy). O(P) memory, O(cohort) updates
+  per round — nothing here ever touches the device.
+- ``CohortSampler`` draws a fixed-size cohort per iteration as a pure
+  function of ``(seed, t)`` and the current active set: runs are bitwise
+  reproducible and a resumed run replays the exact cohort schedule the
+  killed run would have drawn.
+
+Absence semantics (the FailureDetector fix generalized): only a client
+that was SAMPLED and then missed the deadline accrues ``absent_streak``;
+an unsampled client is *unknown*, not absent — its streak, reliability
+and drift-detector arm are untouched. This is deliberately different
+from the PR 3 dead-client story, where non-participation of a dense-pool
+member is itself evidence.
+
+Event kinds emitted here: ``cohort_sampled``, ``client_join``,
+``client_leave``.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from feddrift_tpu import obs
+
+
+class ClientRegistry:
+    """Host-side state for every registered client of a population."""
+
+    def __init__(self, population: int, num_steps: int,
+                 reliability_alpha: float = 0.2) -> None:
+        if population < 1:
+            raise ValueError("population must be >= 1")
+        self.P = population
+        self.alpha = reliability_alpha
+        self.active = np.ones(population, dtype=bool)
+        self.joined_round = np.zeros(population, dtype=np.int64)
+        self.last_seen_round = np.full(population, -1, dtype=np.int64)
+        self.last_sampled_round = np.full(population, -1, dtype=np.int64)
+        # consecutive sampled-but-silent rounds (deadline misses); reset by
+        # any on-time participation, untouched while unsampled
+        self.absent_streak = np.zeros(population, dtype=np.int64)
+        self.reliability = np.ones(population, dtype=np.float64)
+        # -1 = never assigned; updated from the algorithm's writeback
+        self.cluster = np.full(population, -1, dtype=np.int64)
+        # per-time-step assignment history [P, T1]; -1 = not sampled then.
+        # The sparse accuracy bookkeeping: a cohort member's training
+        # weights over past steps are reconstructed from ITS OWN history,
+        # never from whatever client happened to sit in its device slot.
+        self.assign_hist = np.full((population, num_steps), -1,
+                                   dtype=np.int32)
+        # drift-detector arm: the member's last observed best accuracy
+        # (NaN = never observed -> a fresh sample can never fire a
+        # drift trigger from a phantom baseline)
+        self.arm_acc = np.full(population, np.nan, dtype=np.float64)
+
+    # -- membership -----------------------------------------------------
+    def apply_churn(self, joins: np.ndarray, leaves: np.ndarray,
+                    iteration: int) -> None:
+        """Apply one iteration's membership changes (index arrays). One
+        event per kind per iteration — member lists ride on the event, so
+        heavy churn over 10^5 clients stays a two-line record."""
+        joins = np.asarray(joins, dtype=int)
+        leaves = np.asarray(leaves, dtype=int)
+        joins = joins[~self.active[joins]] if joins.size else joins
+        leaves = leaves[self.active[leaves]] if leaves.size else leaves
+        if joins.size:
+            self.active[joins] = True
+            self.joined_round[joins] = iteration
+            # a rejoin is a fresh start: stale absence evidence from the
+            # member's previous life must not mark it suspect on arrival
+            self.absent_streak[joins] = 0
+            obs.emit("client_join", clients=joins.tolist(),
+                     active=int(self.active.sum()))
+            obs.registry().counter("client_joins").inc(int(joins.size))
+        if leaves.size:
+            self.active[leaves] = False
+            obs.emit("client_leave", clients=leaves.tolist(),
+                     active=int(self.active.sum()))
+            obs.registry().counter("client_leaves").inc(int(leaves.size))
+
+    # -- per-round bookkeeping -------------------------------------------
+    def record_round(self, members: np.ndarray, on_time: np.ndarray,
+                     round_idx: int) -> None:
+        """Fold one round's realized cohort participation into the
+        per-member state. ``members`` [K] (entries < 0 = phantom slots),
+        ``on_time`` [K] bool. Only sampled members are touched."""
+        members = np.asarray(members)
+        on_time = np.asarray(on_time, dtype=bool)
+        valid = members >= 0
+        m, ot = members[valid], on_time[valid]
+        self.last_sampled_round[m] = round_idx
+        self.last_seen_round[np.compress(ot, m)] = round_idx
+        self.absent_streak[m] = np.where(ot, 0, self.absent_streak[m] + 1)
+        self.reliability[m] = ((1.0 - self.alpha) * self.reliability[m]
+                               + self.alpha * ot)
+
+    def suspected(self, patience: int) -> np.ndarray:
+        """Member ids past the sampled-but-silent patience threshold."""
+        return np.where(self.absent_streak >= patience)[0]
+
+    # -- algorithm state bridge ------------------------------------------
+    def cohort_view(self, members: np.ndarray) -> tuple[np.ndarray,
+                                                        np.ndarray]:
+        """(assign_hist [K, T1], arm_acc [K]) for the sampled members;
+        phantom slots get all-unknown rows."""
+        members = np.asarray(members)
+        K = members.shape[0]
+        hist = np.full((K, self.assign_hist.shape[1]), -1, dtype=np.int32)
+        arm = np.full(K, np.nan, dtype=np.float64)
+        valid = members >= 0
+        hist[valid] = self.assign_hist[members[valid]]
+        arm[valid] = self.arm_acc[members[valid]]
+        return hist, arm
+
+    def writeback(self, t: int, members: np.ndarray, assign: np.ndarray,
+                  arm_acc: np.ndarray | None = None) -> None:
+        """Store the iteration's clustering outcome back per member."""
+        members = np.asarray(members)
+        valid = members >= 0
+        m = members[valid]
+        a = np.asarray(assign)[valid]
+        self.cluster[m] = a
+        self.assign_hist[m, t] = a
+        if arm_acc is not None:
+            self.arm_acc[m] = np.asarray(arm_acc, dtype=np.float64)[valid]
+
+    def remap_model(self, op: str, a: int, b: int = -1) -> None:
+        """Propagate a pool-structure change to every member's stored
+        assignment — including members NOT in the current cohort, whose
+        history would otherwise point at a slot whose params were merged
+        away or reinitialized. ``("merge", base, second)`` rewrites
+        second -> base; ``("clear", m, -1)`` forgets assignments to m (the
+        slot was LRU-reused or deleted: those members are *unknown* again,
+        not silently riding a fresh model)."""
+        if op == "merge":
+            self.cluster[self.cluster == b] = a
+            self.assign_hist[self.assign_hist == b] = a
+        elif op == "clear":
+            self.cluster[self.cluster == a] = -1
+            self.assign_hist[self.assign_hist == a] = -1
+        else:
+            raise ValueError(f"unknown remap op {op!r}")
+
+    def reserved_models(self) -> set[int]:
+        """Models currently assigned to ANY active member — the LRU
+        allocator must not clobber a model that only looks unused because
+        its clients were not sampled this iteration."""
+        cl = self.cluster[self.active]
+        return {int(m) for m in np.unique(cl[cl >= 0])}
+
+    # -- checkpointing ---------------------------------------------------
+    def state_dict(self) -> dict:
+        return {
+            "active": self.active, "joined_round": self.joined_round,
+            "last_seen_round": self.last_seen_round,
+            "last_sampled_round": self.last_sampled_round,
+            "absent_streak": self.absent_streak,
+            "reliability": self.reliability, "cluster": self.cluster,
+            "assign_hist": self.assign_hist, "arm_acc": self.arm_acc,
+        }
+
+    def load_state_dict(self, d: dict) -> None:
+        for k, dtype in (("active", bool), ("joined_round", np.int64),
+                         ("last_seen_round", np.int64),
+                         ("last_sampled_round", np.int64),
+                         ("absent_streak", np.int64),
+                         ("reliability", np.float64), ("cluster", np.int64),
+                         ("assign_hist", np.int32), ("arm_acc", np.float64)):
+            setattr(self, k, np.asarray(d[k], dtype=dtype))
+
+    def summary(self) -> dict:
+        return {
+            "population": self.P,
+            "active": int(self.active.sum()),
+            "ever_sampled": int((self.last_sampled_round >= 0).sum()),
+            "mean_reliability": round(float(self.reliability.mean()), 4),
+            "max_absent_streak": int(self.absent_streak.max(initial=0)),
+        }
+
+
+class CohortSampler:
+    """Seeded per-iteration cohort draws over the registry's active set.
+
+    The draw is a pure function of ``(seed, t, active set)`` — no mutable
+    RNG state — so a run killed after iteration t and resumed from its
+    checkpoint draws the identical cohort schedule for t+1, t+2, ... The
+    sampled ids are returned SORTED: slot order is arbitrary for the
+    device program, and sorting makes the full-participation case
+    (population == cohort) the identity layout — bitwise-identical to the
+    legacy dense path.
+    """
+
+    def __init__(self, registry: ClientRegistry, slots: int,
+                 seed: int = 0) -> None:
+        if slots < 1:
+            raise ValueError("cohort slots must be >= 1")
+        self.registry = registry
+        self.slots = slots
+        self.seed = seed
+
+    def sample(self, t: int) -> np.ndarray:
+        """[slots] member ids for iteration t; -1 pads slots beyond the
+        active population (their device rows train masked and carry zero
+        aggregation weight). Emits one ``cohort_sampled`` event."""
+        active = np.where(self.registry.active)[0]
+        rng = np.random.RandomState(
+            (self.seed * 9_999_991 + t * 7_919 + 12_345) % (2**31 - 1))
+        k = min(self.slots, active.size)
+        members = np.full(self.slots, -1, dtype=np.int64)
+        if k:
+            members[:k] = np.sort(active[rng.choice(active.size, k,
+                                                    replace=False)])
+        obs.emit("cohort_sampled", members=members[members >= 0].tolist(),
+                 sampled=int(k), slots=self.slots,
+                 population=self.registry.P, active=int(active.size),
+                 mean_reliability=round(
+                     float(self.registry.reliability[members[:k]].mean())
+                     if k else 0.0, 4))
+        obs.registry().counter("cohorts_sampled").inc()
+        return members

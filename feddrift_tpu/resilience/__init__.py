@@ -17,6 +17,13 @@ event bus (`feddrift_tpu/obs/`):
 - **numeric** (`divergence`): ``DivergenceGuard`` — NaN/Inf and
   loss-spike detection on the fetched round losses, rollback to the
   pre-round pool params, abort after K consecutive rollbacks.
+- **participation** (`participation`): ``ParticipationPolicy`` — the
+  deadline + quorum closing rule for population-scale cohort-sampled
+  rounds: stragglers are masked out of the aggregation
+  (``straggler_masked``), and a round below quorum degrades gracefully
+  to keeping the previous parameters (``round_degraded``); pairs with
+  ``platform/registry.py`` (client registry + cohort sampler) and
+  ``platform/faults.py::StragglerInjector`` / ``ChurnSchedule``.
 - **adversarial** (`robust_agg`): a registry of Byzantine-tolerant
   per-cluster aggregators (median, trimmed mean, Krum/multi-Krum,
   norm clipping, weak-DP noise) over the ``[M, C, ...]`` update stack,
@@ -41,6 +48,10 @@ from feddrift_tpu.resilience.robust_agg import (  # noqa: F401
 from feddrift_tpu.resilience.divergence import (  # noqa: F401
     DivergenceError,
     DivergenceGuard,
+)
+from feddrift_tpu.resilience.participation import (  # noqa: F401
+    ParticipationPolicy,
+    RoundOutcome,
 )
 from feddrift_tpu.resilience.preempt import PreemptionHandler  # noqa: F401
 from feddrift_tpu.resilience.reconnect import ReconnectingBrokerClient  # noqa: F401

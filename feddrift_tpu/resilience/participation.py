@@ -1,0 +1,81 @@
+"""Deadline-based partial aggregation: the round closes on time, not on
+the slowest client.
+
+The reference server blocks on a receive barrier until EVERY client of
+the round reports (check_whether_all_receive) — one straggler stalls the
+world. Production FL closes the round at a deadline with whichever cohort
+subset made it, provided a quorum did (arXiv:2405.20431 §scalability).
+
+``ParticipationPolicy`` is that closing rule as a small pure object:
+given the cohort's simulated report latencies it returns the on-time
+mask, and degrades the round gracefully when fewer than
+``quorum_frac * cohort_size`` members made the deadline — the caller
+keeps the previous parameters (the masked aggregation of an all-zero
+participation row is exactly "keep prev params" on every aggregator of
+``resilience/robust_agg.py``) and emits ``round_degraded``.
+
+Masked-out stragglers are *sampled-but-silent*: they accrue absence
+evidence in the ``ClientRegistry``, unlike unsampled members, which stay
+unknown. Event emission lives with the caller-facing ``close_round`` so
+every decision leaves ``straggler_masked`` / ``round_degraded`` evidence
+in ``events.jsonl``.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+
+import numpy as np
+
+from feddrift_tpu import obs
+
+
+@dataclass
+class RoundOutcome:
+    """One closed round: who made it, and whether quorum did."""
+    on_time: np.ndarray        # [K] bool over cohort slots
+    degraded: bool             # True = below quorum, keep prev params
+    quorum: int                # the floor that was applied
+    stragglers: np.ndarray     # member ids masked for missing the deadline
+
+
+class ParticipationPolicy:
+    """Deadline + quorum closing rule for cohort-sampled rounds."""
+
+    def __init__(self, deadline: float, quorum_frac: float,
+                 cohort_size: int) -> None:
+        if deadline <= 0:
+            raise ValueError("deadline must be > 0")
+        if not 0.0 < quorum_frac <= 1.0:
+            raise ValueError("quorum_frac must be in (0, 1]")
+        self.deadline = float(deadline)
+        self.quorum = max(1, math.ceil(quorum_frac * cohort_size))
+
+    def close_round(self, members: np.ndarray,
+                    latencies: np.ndarray | None,
+                    round_idx: int) -> RoundOutcome:
+        """Close one round. ``members`` [K] (< 0 = phantom slot),
+        ``latencies`` [K] simulated report latencies (None = everyone
+        reports instantly). Emits the evidence events."""
+        members = np.asarray(members)
+        valid = members >= 0
+        if latencies is None:
+            on_time = valid.copy()
+        else:
+            on_time = valid & (np.asarray(latencies) <= self.deadline)
+        stragglers = members[valid & ~on_time]
+        degraded = int(on_time.sum()) < self.quorum
+        if stragglers.size:
+            obs.emit("straggler_masked", part_round=int(round_idx),
+                     clients=stragglers.tolist(),
+                     on_time=int(on_time.sum()), deadline=self.deadline)
+            obs.registry().counter("stragglers_masked").inc(
+                int(stragglers.size))
+        if degraded:
+            obs.emit("round_degraded", part_round=int(round_idx),
+                     on_time=int(on_time.sum()), quorum=self.quorum,
+                     stragglers=stragglers.tolist())
+            obs.registry().counter("rounds_degraded").inc()
+        return RoundOutcome(on_time=on_time, degraded=degraded,
+                            quorum=self.quorum, stragglers=stragglers)

@@ -91,15 +91,26 @@ class Experiment:
         # client axis is padded to a multiple of the mesh size with phantom
         # clients whose time weights stay zero — they train masked and
         # contribute n=0 to aggregation, so results are identical.
+        # Population mode flips the residency story: the dataset covers the
+        # whole registered population HOST-side, and only the sampled
+        # cohort's shard is staged into the fixed-shape [C_pad, T1, N, ...]
+        # device stacks each iteration (_prepare_cohort) — XLA program
+        # shapes depend on the cohort, never on the population.
+        self.population_mode = cfg.population_size > 0
         n_dev = self.mesh.devices.size
-        C = cfg.client_num_in_total
+        C = cfg.device_clients
         self.C_pad = ((C + n_dev - 1) // n_dev) * n_dev
         pad = self.C_pad - C
         x_np, y_np = self.ds.x, self.ds.y
-        if pad:
-            x_np = np.concatenate([x_np, np.repeat(x_np[:1], pad, 0)], axis=0)
-            y_np = np.concatenate([y_np, np.repeat(y_np[:1], pad, 0)], axis=0)
-        if cfg.stream_data:
+        if self.population_mode:
+            self._x_pop, self._y_pop = x_np, y_np
+            self.x = self.y = None
+        elif cfg.stream_data:
+            if pad:
+                x_np = np.concatenate([x_np, np.repeat(x_np[:1], pad, 0)],
+                                      axis=0)
+                y_np = np.concatenate([y_np, np.repeat(y_np[:1], pad, 0)],
+                                      axis=0)
             # host-resident: only a [C, 2, N, ...] window (current + next
             # step) is staged into HBM per iteration (data/prefetch.py)
             self._x_host, self._y_host = x_np, y_np
@@ -107,9 +118,21 @@ class Experiment:
             self._view_iter = None
             self._view_next_t = -1
         else:
+            if pad:
+                x_np = np.concatenate([x_np, np.repeat(x_np[:1], pad, 0)],
+                                      axis=0)
+                y_np = np.concatenate([y_np, np.repeat(y_np[:1], pad, 0)],
+                                      axis=0)
             self.x = shard_client_arrays(self.mesh, jnp.asarray(x_np))
             self.y = shard_client_arrays(self.mesh, jnp.asarray(y_np))
         self.algo = make_algorithm(cfg, self.ds, self.pool, self.step)
+        if self.population_mode and not getattr(self.algo, "supports_cohort",
+                                                False):
+            raise ValueError(
+                f"population_size > 0 needs a cohort-capable algorithm "
+                f"(per-client state expressible as registry columns); "
+                f"{cfg.concept_drift_algo!r}/{cfg.concept_drift_algo_arg!r} "
+                f"is not")
         if cfg.stream_data and not self.algo.supports_streaming:
             raise ValueError(
                 f"stream_data requires a current-step-window algorithm "
@@ -150,6 +173,40 @@ class Experiment:
                 if (out_dir and self.is_coordinator) else None,
             ).attach(self.events)
         self.algo.bind(self.x, self.y, self.logger, self.C_pad)
+        # Population-scale participation (platform/registry.py,
+        # resilience/participation.py): host-side registry of every
+        # registered client, a seeded per-iteration cohort sampler, and a
+        # deadline+quorum closing rule; straggler/churn injectors are the
+        # chaos for this layer. cfg forbids the dense-pool fault/byzantine
+        # injectors here — their client indices mean device slots.
+        self.registry = self.sampler = None
+        self.straggler = self.churn = self.participation = None
+        self._cohort_members = None
+        self._slot_valid = None
+        if self.population_mode:
+            from feddrift_tpu.platform.faults import (ChurnSchedule,
+                                                      StragglerInjector)
+            from feddrift_tpu.platform.registry import (ClientRegistry,
+                                                        CohortSampler)
+            from feddrift_tpu.resilience.participation import \
+                ParticipationPolicy
+            P = cfg.population_size
+            self.registry = ClientRegistry(P, num_steps=self.ds.num_steps + 1)
+            self.sampler = CohortSampler(self.registry, cfg.cohort_slots,
+                                         seed=cfg.cohort_seed)
+            if cfg.straggler_prob > 0 or cfg.straggler_slow_frac > 0:
+                self.straggler = StragglerInjector(
+                    P, cfg.straggler_prob, cfg.straggler_slow_frac,
+                    deadline=cfg.round_deadline, seed=cfg.straggler_seed)
+            if cfg.churn_leave_prob > 0 or cfg.churn_join_prob > 0:
+                self.churn = ChurnSchedule(P, cfg.churn_leave_prob,
+                                           cfg.churn_join_prob,
+                                           seed=cfg.churn_seed)
+            self.participation = ParticipationPolicy(
+                cfg.round_deadline, cfg.quorum_frac,
+                cfg.cohort_size or cfg.client_num_in_total)
+            self._slot_valid = np.ones(self.C_pad, dtype=bool)
+            self._slot_valid[self.C_:] = False
         from feddrift_tpu.platform.faults import (ByzantineInjector,
                                                   FailureDetector,
                                                   FaultInjector)
@@ -188,8 +245,12 @@ class Experiment:
         # re-materializing the dataset. Size-gated so a thousand-client
         # scaling run does not bloat its first event line.
         concepts = getattr(self.ds, "concepts", None)
+        # In population mode the first C_ concept columns are NOT the
+        # cohort slots' clients (slots are re-sampled per iteration), so
+        # no dense concept matrix is recorded; the per-iteration
+        # cluster_assign events carry the member ids + live oracle scores.
         concept_matrix = (concepts[:, : self.C_].tolist()
-                          if concepts is not None
+                          if concepts is not None and not self.population_mode
                           and concepts[:, : self.C_].size <= 20000 else None)
         self.events.emit(
             "run_start", dataset=cfg.dataset, model=cfg.model,
@@ -197,7 +258,8 @@ class Experiment:
             clients=self.C_, num_models=self.pool.num_models,
             comm_round=cfg.comm_round, train_iterations=cfg.train_iterations,
             backend=jax.default_backend(), compute_dtype=cfg.compute_dtype,
-            seed=cfg.seed, concept_matrix=concept_matrix)
+            seed=cfg.seed, concept_matrix=concept_matrix,
+            population=cfg.population_size or None)
         if cfg.debug_checks:
             from feddrift_tpu.utils.invariants import enable_nan_debugging
             enable_nan_debugging()
@@ -306,17 +368,35 @@ class Experiment:
     def _log_metrics(self, t: int, idx, train_correct, train_loss, total,
                      tcorrect, tloss, ttotal) -> dict:
         """Assemble + log the reference's metric schema from per-client
-        vectors (Train/Test Acc+Loss, per-client series, Plurality)."""
+        vectors (Train/Test Acc+Loss, per-client series, Plurality).
+
+        Population mode: phantom cohort slots (no member behind them) hold
+        copies of another member's data and are masked out of every
+        aggregate — the reported numbers are cohort metrics, a sampled
+        estimate of the population's."""
+        v = getattr(self, "_slot_valid", None)
+        if v is not None and not v[: self.C_].all():
+            vv = v[: self.C_]
+            train_correct = np.where(vv, train_correct, 0)
+            train_loss = np.where(vv, train_loss, 0.0)
+            tcorrect = np.where(vv, tcorrect, 0)
+            tloss = np.where(vv, tloss, 0.0)
+            total = np.where(vv, np.asarray(total), 0)
+            ttotal = np.where(vv, np.asarray(ttotal), 0)
+        tot = max(float(np.asarray(total).sum()), 1.0)
+        ttot = max(float(np.asarray(ttotal).sum()), 1.0)
         metrics = {
             "round": self.global_round,
             "iteration": t,
-            "Train/Acc": float(train_correct.sum() / total.sum()),
-            "Train/Loss": float(train_loss.sum() / total.sum()),
-            "Test/Acc": float(tcorrect.sum() / ttotal.sum()),
-            "Test/Loss": float(tloss.sum() / ttotal.sum()),
+            "Train/Acc": float(train_correct.sum() / tot),
+            "Train/Loss": float(train_loss.sum() / tot),
+            "Test/Acc": float(tcorrect.sum() / ttot),
+            "Test/Loss": float(tloss.sum() / ttot),
         }
         if self.cfg.report_client:
             for c in range(self.C_):
+                if v is not None and not v[c]:
+                    continue        # phantom slot: no client behind it
                 metrics[f"Train/Acc-CL-{c}"] = float(train_correct[c] / total[c])
                 metrics[f"Test/Acc-CL-{c}"] = float(tcorrect[c] / ttotal[c])
                 metrics[f"Plurality/CL-{c}"] = int(idx[c])
@@ -329,7 +409,9 @@ class Experiment:
 
     @property
     def C_(self) -> int:
-        return self.cfg.client_num_in_total
+        """Device-visible client-axis size: the sampled cohort in
+        population mode, every client in legacy dense mode."""
+        return self.cfg.device_clients
 
     def _pad_clients(self, arr: jnp.ndarray, axis: int = 1,
                      value: float = 0.0) -> jnp.ndarray:
@@ -342,11 +424,98 @@ class Experiment:
         return jnp.pad(arr, widths, constant_values=value)
 
     # ------------------------------------------------------------------
+    # population mode: cohort lifecycle (one cohort per iteration — the
+    # boundary where data windows and optimizer states change anyway)
+    def _prepare_cohort(self, t: int) -> None:
+        """Churn the registry, draw the seeded cohort, stage its shard
+        into the fixed-shape device stacks, and reload the algorithm's
+        per-slot state from the members' registry columns."""
+        cfg = self.cfg
+        if self.churn is not None:
+            joins, leaves = self.churn.events(t, self.registry.active)
+            self.registry.apply_churn(joins, leaves, t)
+        members = self.sampler.sample(t)
+        self._cohort_members = members
+        valid = members >= 0
+        self._slot_valid = np.zeros(self.C_pad, dtype=bool)
+        self._slot_valid[: self.C_] = valid
+        # Gather [C_pad, T1, N, ...]: phantom slots (inactive population
+        # shortfall + mesh padding) borrow member 0's rows — they train
+        # masked, are stale-excluded from decisions and metrics-masked.
+        idx = np.zeros(self.C_pad, dtype=np.int64)
+        idx[: self.C_] = np.where(valid, members, 0)
+        self.x = shard_client_arrays(self.mesh, jnp.asarray(self._x_pop[idx]))
+        self.y = shard_client_arrays(self.mesh, jnp.asarray(self._y_pop[idx]))
+        self.algo.rebind_data(self.x, self.y)
+        hist, arm = self.registry.cohort_view(members)
+        self.algo.load_cohort_state(
+            t, members, hist, arm,
+            reserved_models=self.registry.reserved_models())
+        # Staleness evidence for the clustering layer: consecutive
+        # sampled-but-silent rounds per member (an unsampled member never
+        # accrued any — unknown, not absent), suspicion past the same
+        # patience the dense-mode FailureDetector uses.
+        ages = np.zeros(self.C_, dtype=np.int64)
+        ages[valid] = self.registry.absent_streak[members[valid]]
+        self.algo.set_client_staleness(
+            ages, tuple(np.where(ages >= cfg.failure_patience)[0].tolist()))
+
+    def _population_masks(self, t: int, rounds) -> "np.ndarray | None":
+        """Per-round participation over the cohort axis: the deadline+
+        quorum closing rule over injected straggler latencies. Returns
+        None — the legacy maskless program signature — when no straggler
+        or churn chaos is configured (full cohort participation), which is
+        what keeps the full-participation path bitwise-identical to the
+        dense mode."""
+        cfg = self.cfg
+        members = self._cohort_members
+        valid = members >= 0
+        if self.straggler is None and self.churn is None:
+            for r in rounds:
+                self.registry.record_round(members, valid,
+                                           t * cfg.comm_round + int(r))
+            return None
+        masks = np.zeros((len(rounds), self.C_pad), dtype=np.float32)
+        for i, r in enumerate(rounds):
+            gr = t * cfg.comm_round + int(r)
+            lat = None
+            if self.straggler is not None:
+                pop_lat = self.straggler.latencies(gr)
+                lat = np.where(valid, pop_lat[np.where(valid, members, 0)],
+                               np.inf)
+            outcome = self.participation.close_round(members, lat, gr)
+            self.registry.record_round(members, outcome.on_time, gr)
+            if not outcome.degraded:
+                masks[i, : self.C_] = outcome.on_time.astype(np.float32)
+            # degraded: the all-zero row makes the round a no-op that
+            # still advances the RNG/eval cadence — every aggregator of
+            # resilience/robust_agg.py keeps prev params for n == 0 rows
+        return masks
+
+    def _cohort_writeback(self, t: int) -> None:
+        """After end_iteration: persist the cohort's clustering outcome
+        per member, replaying pool-structure changes (merges, slot reuse)
+        onto members outside the cohort first."""
+        self.algo.save_cohort_state(t)
+        drain = getattr(self.algo, "drain_model_remaps", None)
+        if drain is not None:
+            for op, a, b in drain():
+                self.registry.remap_model(op, a, b)
+        assign = np.asarray(self.algo.test_model_idx(t))
+        self.registry.writeback(t, self._cohort_members, assign,
+                                self.algo.cohort_arm_acc(t))
+        if self.logger:
+            self.logger.set_summary("Population", self.registry.summary())
+
+    # ------------------------------------------------------------------
     def run_iteration(self, t: int) -> None:
         cfg = self.cfg
         t0 = time.time()
         self.events.set_context(iteration=t, round=self.global_round)
         self.events.emit("iteration_start")
+        if self.population_mode:
+            with self.tracer.phase("cohort"):
+                self._prepare_cohort(t)
         if self.divergence_guard is not None:
             # the time step changes the training window/concept: losses
             # legitimately re-spike, so the spike baseline starts fresh
@@ -388,6 +557,8 @@ class Experiment:
 
         with self.tracer.phase("cluster"):
             self.algo.end_iteration(t)
+        if self.population_mode:
+            self._cohort_writeback(t)
         if self.cfg.checkpoint_every_iteration and self.out_dir:
             self.save_checkpoint(t)
             self.events.emit("checkpoint_save", path=self.ckpt_path())
@@ -402,8 +573,10 @@ class Experiment:
         # client-examples, the FL-semantics unit (multiply by models for
         # device examples: the pool trains M x C pairs).
         B = min(cfg.batch_size, self.ds.samples_per_step)
-        examples = cfg.comm_round * cfg.epochs * B * \
-            min(cfg.client_num_per_round, self.C_)
+        participants = ((cfg.cohort_size or cfg.client_num_in_total)
+                        if self.population_mode
+                        else min(cfg.client_num_per_round, self.C_))
+        examples = cfg.comm_round * cfg.epochs * B * participants
         self.events.emit(
             "iteration_end", wall_s=round(wall, 4), rounds=cfg.comm_round,
             examples=examples,
@@ -436,6 +609,10 @@ class Experiment:
         (t, round) pair. Realized participation feeds the failure detector.
         """
         cfg = self.cfg
+        if self.population_mode:
+            # the cohort IS the round's sample; participation is governed
+            # by the deadline/quorum policy, not dense-pool subsampling
+            return self._population_masks(t, rounds)
         sampling = cfg.client_num_per_round < self.C_
         if not sampling and self.fault_injector is None:
             return None
@@ -759,10 +936,16 @@ class Experiment:
         if not self.is_coordinator:
             return        # pool params are replicated; one writer suffices
         from feddrift_tpu.utils.checkpoint import save_checkpoint
+        algo_state = self.algo.state_dict()
+        if self.population_mode:
+            # the registry rides in the algo pickle under a reserved key:
+            # same atomic generation, no checkpoint format change
+            algo_state = {**algo_state,
+                          "__registry__": self.registry.state_dict()}
         save_checkpoint(
             self.ckpt_path(), config_json=self.cfg.to_json(),
             iteration=completed_iteration, global_round=self.global_round,
-            pool_params=self.pool.params, algo_state=self.algo.state_dict())
+            pool_params=self.pool.params, algo_state=algo_state)
 
     @classmethod
     def resume(cls, cfg: ExperimentConfig, out_dir: str, mesh=None,
@@ -774,7 +957,11 @@ class Experiment:
         exp = cls(cfg, mesh=mesh, use_wandb=use_wandb, out_dir=out_dir)
         state = load_checkpoint(os.path.join(out_dir, "ckpt"), exp.pool.params)
         exp.pool.params = state["pool_params"]
-        exp.algo.load_state_dict(state["algo_state"])
+        algo_state = dict(state["algo_state"])
+        reg_state = algo_state.pop("__registry__", None)
+        if reg_state is not None and exp.registry is not None:
+            exp.registry.load_state_dict(reg_state)
+        exp.algo.load_state_dict(algo_state)
         exp.global_round = state["global_round"]
         exp.start_iteration = state["iteration"] + 1
         # A crash may have logged part of iteration start_iteration AFTER

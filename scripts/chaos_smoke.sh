@@ -23,6 +23,10 @@
 #    the alert monitor raises (alert_raised in events.jsonl AND a line in
 #    alerts.jsonl), then run the `lineage` CLI on the same run and assert
 #    the genealogy renders and `report` surfaces the alerts section.
+# 6) participation domain — a 10^3-population SEA run with cohort-sampled
+#    rounds, 20% injected stragglers and join/leave churn completes,
+#    masks stragglers out of the aggregation (straggler_masked in
+#    events.jsonl) and renders the `report` participation section.
 #
 # Usage: scripts/chaos_smoke.sh            (~2-3 min on one CPU core)
 set -euo pipefail
@@ -33,12 +37,12 @@ OUT=$(mktemp -d)
 trap 'rm -rf "$OUT"' EXIT
 RUN="$OUT/run"
 
-echo "== [1/5] chaos transport e2e (drop_prob=0.2 + broker kill/restart) =="
+echo "== [1/6] chaos transport e2e (drop_prob=0.2 + broker kill/restart) =="
 timeout -k 10 300 python -m pytest tests/test_resilience.py -q \
     -p no:cacheprovider -p no:randomly \
     -k "ChaosEndToEnd or survives_broker_kill or heartbeat_missed"
 
-echo "== [2/5] preemption: SIGTERM a real run, then --auto_resume =="
+echo "== [2/6] preemption: SIGTERM a real run, then --auto_resume =="
 ARGS=(--dataset sine --model fnn --concept_drift_algo win-1
       --concept_num 2 --client_num_in_total 4 --client_num_per_round 4
       --train_iterations 6 --comm_round 8 --epochs 2
@@ -75,15 +79,15 @@ print(f"resume OK: {len(rows)} metric rows, final Test/Acc="
       f"{rows[-1]['Test/Acc']:.4f}")
 EOF
 
-echo "== [3/5] event taxonomy consistency (strict: no dead kinds) =="
+echo "== [3/6] event taxonomy consistency (strict: no dead kinds) =="
 python scripts/check_events_schema.py --strict
 
-echo "== [4/5] byzantine smoke: trimmed_mean defends where mean fails =="
+echo "== [4/6] byzantine smoke: trimmed_mean defends where mean fails =="
 timeout -k 10 300 python -m pytest tests/test_robust_agg.py -q \
     -p no:cacheprovider -p no:randomly \
     -k "trimmed_mean_defends_where_mean_fails"
 
-echo "== [5/5] decision observability: kill clients -> alerts + lineage =="
+echo "== [5/6] decision observability: kill clients -> alerts + lineage =="
 LRUN="$OUT/lineage-run"
 timeout -k 10 300 python - "$LRUN" <<'EOF'
 import sys
@@ -116,5 +120,24 @@ grep -q "assignment timeline" "$OUT/lineage.txt" \
 python -m feddrift_tpu report "$LRUN" > "$OUT/report.txt"
 grep -q "alerts:" "$OUT/report.txt" \
     || { echo "report missing alerts section"; exit 1; }
+
+echo "== [6/6] participation: 10^3 population, 20% stragglers + churn =="
+PRUN="$OUT/population-run"
+timeout -k 10 300 python -m feddrift_tpu run \
+    --dataset sea --model fnn --concept_drift_algo softcluster \
+    --concept_drift_algo_arg H_A_C_1_10_0 --concept_num 4 \
+    --population_size 1000 --cohort_size 10 --cohort_overprovision 2 \
+    --straggler_prob 0.2 --straggler_slow_frac 0.05 \
+    --churn_leave_prob 0.02 --churn_join_prob 0.05 \
+    --train_iterations 4 --comm_round 6 --epochs 2 --sample_num 40 \
+    --batch_size 20 --frequency_of_the_test 3 --report_client 0 \
+    --checkpoint_every_iteration false --flat_out_dir --out_dir "$PRUN"
+grep -q cohort_sampled "$PRUN/events.jsonl" \
+    || { echo "missing cohort_sampled events"; exit 1; }
+grep -q straggler_masked "$PRUN/events.jsonl" \
+    || { echo "missing straggler_masked events"; exit 1; }
+python -m feddrift_tpu report "$PRUN" > "$OUT/preport.txt"
+grep -q "participation:" "$OUT/preport.txt" \
+    || { echo "report missing participation section"; exit 1; }
 
 echo "chaos_smoke: ALL OK"

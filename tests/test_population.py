@@ -1,0 +1,489 @@
+"""Population-scale cohort rounds (ISSUE 6): client registry, seeded
+cohort sampling, deadline/quorum participation, straggler + churn chaos.
+
+The load-bearing guarantees under test:
+
+- bitwise equivalence of the full-participation cohort path with the
+  legacy dense path, on both the per-round and fused programs;
+- compile-count invariance: growing the population 10^2 -> 10^4 at fixed
+  cohort triggers zero steady-state recompiles (PR 1 detector);
+- unknown != absent: an unsampled member never accrues absence evidence
+  (the FailureDetector false-suspicion regression, and the registry's
+  generalization of it);
+- a killed + resumed run replays the identical cohort schedule;
+- chaos e2e: 20% stragglers + churn over a 10^3 population completes
+  within 0.10 of the fault-free run, with evidence events.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+
+import numpy as np
+import pytest
+
+from feddrift_tpu import obs
+from feddrift_tpu.config import ExperimentConfig
+from feddrift_tpu.obs.alerts import AlertMonitor, default_rules
+from feddrift_tpu.platform.faults import (ChurnSchedule, FailureDetector,
+                                          StragglerInjector)
+from feddrift_tpu.platform.registry import ClientRegistry, CohortSampler
+from feddrift_tpu.resilience.participation import ParticipationPolicy
+
+
+@pytest.fixture(autouse=True)
+def _fresh_bus():
+    """Memory-only event bus per test so event asserts are hermetic."""
+    obs.configure(None)
+    yield
+    obs.configure(None)
+
+
+def _events(kind):
+    return obs.get_bus().events(kind)
+
+
+# ----------------------------------------------------------------------
+class TestClientRegistry:
+    def test_absence_only_for_sampled(self):
+        reg = ClientRegistry(6, num_steps=4)
+        reg.record_round([0, 1, 2], [True, False, True], 0)
+        assert reg.absent_streak.tolist() == [0, 1, 0, 0, 0, 0]
+        # member 1 NOT sampled this round: its streak must not move
+        reg.record_round([0, 3, 4], [True, True, True], 1)
+        assert reg.absent_streak[1] == 1
+        # sampled-but-silent again: accrues; on-time resets
+        reg.record_round([1, 2], [False, True], 2)
+        assert reg.absent_streak[1] == 2
+        reg.record_round([1], [True], 3)
+        assert reg.absent_streak[1] == 0
+        assert reg.suspected(2).tolist() == []
+
+    def test_reliability_ewma_and_rejoin_reset(self):
+        reg = ClientRegistry(4, num_steps=3)
+        for r in range(5):
+            reg.record_round([0, 1], [True, False], r)
+        assert reg.reliability[0] == pytest.approx(1.0)
+        assert reg.reliability[1] < 0.5
+        assert reg.absent_streak[1] == 5
+        reg.apply_churn(joins=[], leaves=[1], iteration=1)
+        assert not reg.active[1]
+        reg.apply_churn(joins=[1], leaves=[], iteration=2)
+        # a rejoin is a fresh start: old absence evidence cleared
+        assert reg.active[1] and reg.absent_streak[1] == 0
+        assert len(_events("client_leave")) == 1
+        assert _events("client_join")[0]["clients"] == [1]
+
+    def test_writeback_history_and_remaps(self):
+        reg = ClientRegistry(5, num_steps=4)
+        reg.writeback(0, np.array([0, 1, 2]), np.array([0, 1, 1]))
+        reg.writeback(1, np.array([0, 3, -1]), np.array([2, 1, 7]))
+        assert reg.cluster.tolist() == [2, 1, 1, 1, -1]
+        assert reg.assign_hist[0].tolist() == [0, 2, -1, -1]
+        assert reg.reserved_models() == {1, 2}
+        reg.remap_model("merge", 0, 1)          # 1 -> 0 everywhere
+        assert reg.cluster.tolist() == [2, 0, 0, 0, -1]
+        assert reg.assign_hist[1, 0] == 0
+        reg.remap_model("clear", 2)             # model 2 reused: unknown
+        assert reg.cluster[0] == -1 and reg.assign_hist[0, 1] == -1
+
+    def test_cohort_view_phantom_rows(self):
+        reg = ClientRegistry(3, num_steps=3)
+        reg.writeback(0, np.array([2]), np.array([1]), np.array([0.75]))
+        hist, arm = reg.cohort_view(np.array([2, -1]))
+        assert hist[0].tolist() == [1, -1, -1]
+        assert hist[1].tolist() == [-1, -1, -1]
+        assert arm[0] == pytest.approx(0.75) and np.isnan(arm[1])
+
+    def test_state_roundtrip(self):
+        reg = ClientRegistry(4, num_steps=3)
+        reg.record_round([0, 1], [True, False], 0)
+        reg.writeback(0, np.array([0, 1]), np.array([0, 1]))
+        reg2 = ClientRegistry(4, num_steps=3)
+        reg2.load_state_dict(reg.state_dict())
+        for k, v in reg.state_dict().items():
+            np.testing.assert_array_equal(np.asarray(v),
+                                          np.asarray(reg2.state_dict()[k]))
+
+
+class TestCohortSampler:
+    def test_deterministic_sorted_schedule(self):
+        reg = ClientRegistry(50, num_steps=3)
+        s1 = CohortSampler(reg, 8, seed=3)
+        s2 = CohortSampler(ClientRegistry(50, num_steps=3), 8, seed=3)
+        for t in range(4):
+            a, b = s1.sample(t), s2.sample(t)
+            np.testing.assert_array_equal(a, b)
+            assert (np.diff(a) > 0).all()       # sorted, no repeats
+        assert not np.array_equal(s1.sample(0), s1.sample(1))
+
+    def test_full_population_is_identity(self):
+        reg = ClientRegistry(6, num_steps=3)
+        assert CohortSampler(reg, 6, seed=0).sample(2).tolist() == \
+            list(range(6))
+
+    def test_excludes_inactive_and_pads(self):
+        reg = ClientRegistry(6, num_steps=3)
+        reg.apply_churn([], [0, 1, 2, 3], iteration=0)
+        members = CohortSampler(reg, 4, seed=0).sample(0)
+        assert members[:2].tolist() == [4, 5]
+        assert members[2:].tolist() == [-1, -1]
+        ev = _events("cohort_sampled")[-1]
+        assert ev["sampled"] == 2 and ev["slots"] == 4 and ev["active"] == 2
+
+
+class TestStragglerChurn:
+    def test_straggler_deterministic_and_slow_bias(self):
+        a = StragglerInjector(200, prob=0.1, slow_frac=0.3, deadline=1.0,
+                              seed=7)
+        b = StragglerInjector(200, prob=0.1, slow_frac=0.3, deadline=1.0,
+                              seed=7)
+        np.testing.assert_array_equal(a.latencies(5), b.latencies(5))
+        miss = np.zeros(200)
+        for r in range(30):
+            miss += a.latencies(r) > 1.0
+        assert miss[a.slow].mean() > 20         # ~0.9 miss rate
+        assert miss[~a.slow].mean() < 8         # ~0.1 miss rate
+
+    def test_churn_deterministic_flap(self):
+        c = ChurnSchedule(100, leave_prob=0.3, join_prob=0.4, seed=1)
+        active = np.ones(100, dtype=bool)
+        j1, l1 = c.events(0, active)
+        j2, l2 = ChurnSchedule(100, 0.3, 0.4, seed=1).events(0, active)
+        np.testing.assert_array_equal(l1, l2)
+        assert j1.size == 0 and l1.size > 0     # all active: only leaves
+        active[l1] = False
+        j3, _ = c.events(1, active)
+        assert j3.size > 0                      # flap: leavers can rejoin
+
+
+class TestParticipationPolicy:
+    def test_deadline_masks_stragglers(self):
+        pol = ParticipationPolicy(deadline=1.0, quorum_frac=0.5,
+                                  cohort_size=4)
+        members = np.array([3, 5, 9, -1])
+        out = pol.close_round(members, np.array([0.2, 1.7, 0.4, 0.1]), 11)
+        assert out.on_time.tolist() == [True, False, True, False]
+        assert not out.degraded and out.stragglers.tolist() == [5]
+        ev = _events("straggler_masked")[-1]
+        assert ev["clients"] == [5] and ev["part_round"] == 11
+        assert not _events("round_degraded")
+
+    def test_quorum_degrades_gracefully(self):
+        pol = ParticipationPolicy(deadline=1.0, quorum_frac=0.75,
+                                  cohort_size=4)
+        out = pol.close_round(np.array([1, 2, 3, 4]),
+                              np.array([0.2, 9.0, 9.0, 9.0]), 3)
+        assert out.degraded and out.quorum == 3
+        ev = _events("round_degraded")[-1]
+        assert ev["on_time"] == 1 and ev["quorum"] == 3
+        assert sorted(ev["stragglers"]) == [2, 3, 4]
+
+    def test_no_latencies_means_everyone_on_time(self):
+        pol = ParticipationPolicy(1.0, 0.5, 4)
+        out = pol.close_round(np.array([1, 2, -1, -1]), None, 0)
+        assert out.on_time.tolist() == [True, True, False, False]
+
+
+# ----------------------------------------------------------------------
+class TestFailureDetectorSampling:
+    """Regression: absence semantics under client sampling — an unsampled
+    client must never accrue absence/suspicion (false-suspicion bug);
+    only sampled-but-silent clients do."""
+
+    def test_unsampled_never_suspected(self):
+        det = FailureDetector(6, patience=2)
+        observed = np.zeros(6, dtype=bool)
+        observed[[0, 1]] = True
+        part = np.zeros(6)
+        part[[0, 1]] = 1.0
+        for _ in range(5):      # clients 2-5 unsampled for 5 rounds
+            det.observe(part, observed)
+        assert det.suspected.tolist() == []
+        assert det.absent_streak[2:].tolist() == [0, 0, 0, 0]
+
+    def test_sampled_but_silent_is_suspected(self):
+        det = FailureDetector(4, patience=2)
+        observed = np.array([True, True, False, False])
+        part = np.array([1.0, 0.0, 0.0, 0.0])   # 1 polled and silent
+        det.observe(part, observed)
+        det.observe(part, observed)
+        assert det.suspected.tolist() == [1]
+
+    def test_observe_many_carries_observed(self):
+        det = FailureDetector(4, patience=2)
+        masks = np.zeros((3, 4))
+        masks[:, 0] = 1.0
+        observed = np.zeros((3, 4), dtype=bool)
+        observed[:, :2] = True                   # only 0, 1 ever polled
+        det.observe_many(masks, observed)
+        assert det.suspected.tolist() == [1]     # 2, 3 stay unknown
+        # legacy call without observed = every client polled every round
+        det2 = FailureDetector(4, patience=2)
+        det2.observe_many(masks)
+        assert det2.suspected.tolist() == [1, 2, 3]
+
+
+class TestQuorumMissAlert:
+    def _degraded(self, it):
+        return {"kind": "round_degraded", "iteration": it, "round": it,
+                "on_time": 1, "quorum": 5, "stragglers": [1, 2]}
+
+    def test_fires_on_repeat(self):
+        mon = AlertMonitor(rules=default_rules(quorum_miss_threshold=2,
+                                               quorum_miss_window=3))
+        mon.observe(self._degraded(1))
+        assert [a["rule"] for a in mon.alerts] == []
+        mon.observe(self._degraded(1))
+        assert [a["rule"] for a in mon.alerts] == ["quorum_miss"]
+        assert mon.alerts[0]["severity"] == "crit"
+        assert mon.alerts[0]["count"] == 2
+
+    def test_stays_quiet_outside_window(self):
+        mon = AlertMonitor(rules=default_rules(quorum_miss_threshold=2,
+                                               quorum_miss_window=2))
+        mon.observe(self._degraded(1))
+        mon.observe(self._degraded(8))           # first fell out of window
+        assert mon.alerts == []
+
+    def test_cooldown(self):
+        mon = AlertMonitor(rules=default_rules(quorum_miss_threshold=1,
+                                               quorum_miss_window=3))
+        mon.observe(self._degraded(1))
+        mon.observe(self._degraded(2))           # within cooldown=2
+        mon.observe(self._degraded(3))           # cooldown elapsed
+        assert [a["rule"] for a in mon.alerts] == ["quorum_miss"] * 2
+
+
+# ----------------------------------------------------------------------
+class TestConfigValidation:
+    def test_population_smaller_than_cohort_rejected(self):
+        with pytest.raises(ValueError, match="population_size"):
+            ExperimentConfig(population_size=5, cohort_size=8)
+
+    def test_dense_fault_injection_rejected(self):
+        with pytest.raises(ValueError, match="fault injection"):
+            ExperimentConfig(population_size=100, fault_dropout_prob=0.1)
+
+    def test_byzantine_rejected(self):
+        with pytest.raises(ValueError, match="byzantine"):
+            ExperimentConfig(population_size=100, byzantine_clients="0,1")
+
+    def test_cohort_incapable_algorithm_rejected(self):
+        from feddrift_tpu.simulation.runner import Experiment
+        cfg = ExperimentConfig(
+            dataset="sea", model="fnn", concept_drift_algo="aue",
+            population_size=20, cohort_size=4, train_iterations=2,
+            comm_round=2, sample_num=8, batch_size=8, report_client=0)
+        with pytest.raises(ValueError, match="cohort-capable"):
+            Experiment(cfg)
+
+
+# ----------------------------------------------------------------------
+def _base_cfg(**overrides):
+    base = dict(
+        dataset="sine", model="fnn", concept_num=2,
+        concept_drift_algo="softcluster", concept_drift_algo_arg="mmacc_10",
+        client_num_in_total=5, client_num_per_round=5,
+        train_iterations=3, comm_round=4, epochs=2, sample_num=24,
+        batch_size=12, frequency_of_the_test=2, report_client=0,
+        checkpoint_every_iteration=False, seed=0)
+    base.update(overrides)
+    return ExperimentConfig(**base)
+
+
+def _run(cfg, out_dir=None):
+    from feddrift_tpu.simulation.runner import Experiment
+    exp = Experiment(cfg, out_dir=out_dir)
+    exp.run()
+    return exp
+
+
+def _history(exp):
+    """metrics.jsonl rows minus wall-clock noise."""
+    return [{k: v for k, v in row.items() if k != "_ts"}
+            for row in exp.logger.history]
+
+
+def _leaves(params):
+    import jax
+    return jax.tree_util.tree_leaves(params)
+
+
+class TestPopulationRuns:
+    @pytest.mark.parametrize("chunk_rounds", [False, True],
+                             ids=["per_round", "fused"])
+    def test_full_participation_bitwise_matches_dense(self, chunk_rounds):
+        """population == cohort, no chaos: the cohort path must reproduce
+        the legacy dense trajectory bit for bit on both program paths."""
+        dense = _run(_base_cfg(chunk_rounds=chunk_rounds))
+        pop = _run(_base_cfg(chunk_rounds=chunk_rounds,
+                             population_size=5, cohort_size=5))
+        assert _history(pop) == _history(dense)
+        for a, b in zip(_leaves(dense.pool.params), _leaves(pop.pool.params)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_all_degraded_rounds_keep_params(self):
+        """Every round below quorum: params must come out of the iteration
+        exactly as they went in (the all-zero mask is a masked no-op)."""
+        import jax
+        from feddrift_tpu.simulation.runner import Experiment
+        cfg = _base_cfg(population_size=40, cohort_size=5,
+                        straggler_prob=0.6, quorum_frac=1.0,
+                        train_iterations=1)
+        exp = Experiment(cfg)
+        before = [np.asarray(l).copy() for l in _leaves(exp.pool.params)]
+        exp.run()
+        degraded = _events("round_degraded")
+        assert len(degraded) == cfg.comm_round   # every round missed quorum
+        for a, b in zip(before, _leaves(exp.pool.params)):
+            np.testing.assert_array_equal(a, np.asarray(b))
+
+    def test_stragglers_are_masked_not_fatal(self):
+        cfg = _base_cfg(population_size=40, cohort_size=5,
+                        cohort_overprovision=2, straggler_prob=0.3)
+        exp = _run(cfg)
+        assert _events("straggler_masked")
+        assert not _events("round_degraded")     # overprovision held quorum
+        assert exp.logger.last("Test/Acc") is not None
+        # registry saw the misses: stragglers' reliability dipped
+        assert exp.registry.summary()["mean_reliability"] < 1.0
+
+    def test_resume_replays_cohort_schedule(self, tmp_path):
+        """kill -> --auto_resume must draw the identical cohorts and land
+        on the identical metrics (sampler is a pure fn of (seed, t) and
+        the registry rides in the checkpoint)."""
+        from feddrift_tpu.simulation.runner import Experiment
+
+        def cohorts(run_dir):
+            evs = [json.loads(l)
+                   for l in open(os.path.join(run_dir, "events.jsonl"))]
+            return [(e["iteration"], e["members"]) for e in evs
+                    if e["kind"] == "cohort_sampled"]
+
+        cfg = _base_cfg(population_size=30, cohort_size=5,
+                        straggler_prob=0.2, churn_leave_prob=0.05,
+                        churn_join_prob=0.05, train_iterations=4,
+                        checkpoint_every_iteration=True)
+        full_dir = str(tmp_path / "full")
+        full = _run(cfg, out_dir=full_dir)
+
+        part_dir = str(tmp_path / "resumed")
+        exp = Experiment(cfg, out_dir=part_dir)
+        exp.run_iteration(0)
+        exp.run_iteration(1)
+        exp.events.close()                       # simulate the kill
+        resumed = Experiment.resume(cfg, part_dir)
+        assert resumed.start_iteration == 2
+        resumed.run()
+
+        assert cohorts(part_dir) == cohorts(full_dir)
+        assert _history(resumed)[-1] == _history(full)[-1]
+        for a, b in zip(_leaves(full.pool.params),
+                        _leaves(resumed.pool.params)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_compile_count_invariance_over_population(self):
+        """10^2 -> 10^4 population at fixed cohort: identical program
+        signatures, zero steady-state recompiles (the PR 1 detector)."""
+        compiles = {}
+        for population in (100, 10000):
+            obs.configure(None)
+            obs.registry().reset()
+            cfg = _base_cfg(population_size=population, cohort_size=5,
+                            cohort_overprovision=1, straggler_prob=0.1,
+                            churn_leave_prob=0.01, churn_join_prob=0.02,
+                            train_iterations=3, sample_num=12, batch_size=8)
+            _run(cfg)
+            snap = obs.registry().snapshot()
+            assert not any(k.startswith("jit_recompiles")
+                           for k in snap), snap
+            compiles[population] = {k: v for k, v in snap.items()
+                                    if k.startswith("jit_compiles")}
+        assert compiles[100] == compiles[10000]
+
+    def test_churn_emits_membership_events(self):
+        cfg = _base_cfg(population_size=60, cohort_size=5,
+                        churn_leave_prob=0.2, churn_join_prob=0.3,
+                        train_iterations=3)
+        exp = _run(cfg)
+        assert _events("client_leave") and _events("client_join")
+        summ = exp.registry.summary()
+        assert 0 < summ["active"] <= 60
+
+
+@pytest.mark.slow
+class TestChaosEndToEnd:
+    def test_population_chaos_within_tolerance_of_clean(self):
+        """Acceptance: 10^3 population, 20% stragglers + churn completes
+        within 0.10 final accuracy of the fault-free run, with
+        cohort_sampled + straggler_masked evidence."""
+        base = dict(
+            dataset="sea", model="fnn", concept_num=4,
+            concept_drift_algo="softcluster",
+            concept_drift_algo_arg="H_A_C_1_10_0",
+            population_size=1000, cohort_size=10, cohort_overprovision=2,
+            train_iterations=4, comm_round=6, epochs=3, sample_num=40,
+            batch_size=20, frequency_of_the_test=3, lr=0.03,
+            report_client=0, checkpoint_every_iteration=False, seed=0)
+        clean = _run(ExperimentConfig(**base))
+        obs.configure(None)
+        chaotic = _run(ExperimentConfig(
+            **base, straggler_prob=0.2, straggler_slow_frac=0.05,
+            churn_leave_prob=0.02, churn_join_prob=0.05))
+        assert _events("cohort_sampled")
+        assert _events("straggler_masked")
+        acc_clean = clean.logger.last("Test/Acc")
+        acc_chaos = chaotic.logger.last("Test/Acc")
+        assert acc_chaos >= acc_clean - 0.10, (acc_clean, acc_chaos)
+
+
+class TestPopscaleRegressGate:
+    def test_throughput_tolerance_and_zero_recompile_gate(self):
+        from feddrift_tpu.obs.regress import compare
+        base = {"popscale": [{"population": 100, "rounds_per_sec": 100.0,
+                              "steady_recompiles": 0}]}
+        ok = compare({"popscale": [{"population": 100,
+                                    "rounds_per_sec": 95.0,
+                                    "steady_recompiles": 0}]}, base)
+        ps = {r["metric"]: r for r in ok if r["metric"].startswith("popscale")}
+        assert ps["popscale[100].rounds_per_s"]["status"] == "ok"
+        assert ps["popscale[100].steady_recompiles"]["status"] == "ok"
+        bad = compare({"popscale": [{"population": 100,
+                                     "rounds_per_sec": 50.0,
+                                     "steady_recompiles": 2}]}, base)
+        ps = {r["metric"]: r for r in bad
+              if r["metric"].startswith("popscale")}
+        assert ps["popscale[100].rounds_per_s"]["status"] == "regress"
+        # the zero-recompile gate is absolute, not tolerance-based
+        assert ps["popscale[100].steady_recompiles"]["status"] == "regress"
+
+    def test_committed_artifact_passes_self_regress(self):
+        from feddrift_tpu.obs.regress import compare, load_bench
+        art = load_bench(os.path.join(os.path.dirname(__file__), "..",
+                                      "POPSCALE_r06.json"))
+        rows = compare(art, art)
+        assert all(r["status"] != "regress" for r in rows)
+        assert any(r["metric"].startswith("popscale") for r in rows)
+
+
+class TestReportParticipation:
+    def test_report_renders_participation_section(self, tmp_path):
+        from feddrift_tpu.obs.report import render, summarize
+        cfg = _base_cfg(concept_drift_algo="win-1", concept_num=1,
+                        population_size=30, cohort_size=4,
+                        cohort_overprovision=1, straggler_prob=0.3,
+                        churn_leave_prob=0.1, churn_join_prob=0.1,
+                        train_iterations=2)
+        run_dir = str(tmp_path / "run")
+        _run(cfg, out_dir=run_dir)
+        summary = summarize(run_dir)
+        part = summary["participation"]
+        assert part["cohorts"]["population"] == 30
+        assert part["stragglers"]["masked_total"] > 0
+        assert part["churn"]["joins"] + part["churn"]["leaves"] > 0
+        text = render(summary)
+        assert "participation:" in text
+        assert "stragglers:" in text
