@@ -454,6 +454,77 @@ def _popscale_bench(backend: str, smoke: bool) -> list:
     return out
 
 
+def _hierarchy_bench(smoke: bool) -> list:
+    """Broker bytes/round per wire codec (ISSUE 8: verified compression on
+    the update path). Backend-independent by design — the codecs are numpy
+    on the wire, so the measurement is the negotiated sender/receiver pair
+    over the real TCP broker, read off the broker_bytes_out counter (delta,
+    not reset: the registry also carries this process's compile counters).
+
+    The COMM artifact the `regress` gate checks: bytes/round per codec must
+    not grow past the bytes tolerance, and every lossy codec must keep its
+    >= 3x reduction over uncompressed."""
+    import numpy as np
+
+    from feddrift_tpu import obs
+    from feddrift_tpu.comm.compress import (WIRE_CODECS, UpdateReceiver,
+                                            UpdateSender)
+    from feddrift_tpu.comm.netbroker import NetworkBroker, NetworkBrokerClient
+
+    rng = np.random.RandomState(8)
+    # mnist-fnn-shaped update (784 -> 128 -> 10): ~406 KB of float32 per
+    # round — large enough that payload, not JSON framing, is what's timed
+    shapes = [(784, 128), (128,), (128, 10), (10,)]
+    layers = [rng.randn(*s).astype(np.float32) for s in shapes]
+    rounds = 3 if smoke else 10
+
+    def run(codec):
+        obs.configure(None)
+        ctr = obs.registry().counter("broker_bytes_out", transport="netbroker")
+        before = ctr.value
+        broker = NetworkBroker()
+        try:
+            ctx = NetworkBrokerClient(broker.host, broker.port)
+            crx = NetworkBrokerClient(broker.host, broker.port)
+            rx = UpdateReceiver(crx, "bench/update")
+            tx = UpdateSender(ctx, "bench/update", codec=codec)
+            for c in (ctx, crx):   # TCP subscribe is async: loopback sync
+                q = c.subscribe("__sync__")
+                c.publish("__sync__", "ready")
+                assert q.get(timeout=10) == "ready"
+            tx.offer()
+            rx.serve_ctl(timeout=10.0)
+            assert tx.wait_accept(timeout=10.0) == codec
+            for r in range(rounds):
+                for i, base_arr in enumerate(layers):
+                    # evolving weights so the delta chain sees realistic
+                    # round-over-round updates, not a constant tensor
+                    arr = base_arr + 0.01 * r
+                    tx.send(f"w{i}", arr)
+                    assert rx.recv(timeout=10.0) is not None
+            ctx.close(); crx.close()
+        finally:
+            broker.close()
+        return ctr.value - before
+
+    out = []
+    raw = None
+    for codec in WIRE_CODECS:
+        total = run(codec)
+        if codec == "none":
+            raw = total
+        out.append({
+            "codec": codec,
+            "rounds": rounds,
+            "bytes_total": int(total),
+            "bytes_per_round": round(total / rounds, 1),
+            "ratio_vs_none": (round(raw / total, 2) if raw else None),
+        })
+        print(json.dumps({"partial": f"hierarchy@{codec}", **out[-1]}),
+              file=sys.stderr)
+    return out
+
+
 def _conv_cfg(smoke: bool, **overrides):
     base = dict(
         dataset="cifar10", model="resnet8",
@@ -568,6 +639,10 @@ def main() -> None:
         # runs); committed as POPSCALE_r0*.json and gated by `regress`
         "popscale": (_popscale_bench(backend, smoke)
                      if "--popscale" in sys.argv else None),
+        # two-tier wire axis (opt-in: pure-wire TCP broker measurement);
+        # committed as COMM_r0*.json and gated by `regress`
+        "hierarchy": (_hierarchy_bench(smoke)
+                      if "--hierarchy" in sys.argv else None),
     }
     print(json.dumps(out))
     if conv is not None and "error" in conv:

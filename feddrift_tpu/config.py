@@ -198,6 +198,40 @@ class ExperimentConfig:
     churn_join_prob: float = 0.0
     churn_seed: int = 0
 
+    # --- hierarchical two-tier aggregation (platform/hierarchical.py,
+    # platform/faults.py::EdgeFaultInjector; docs/RESILIENCE.md
+    # "Hierarchical aggregation"). hierarchy_edges > 0 routes every round
+    # through client -> edge -> server: each edge closes its round with
+    # edge_robust_agg applied WITHIN its group, then the server applies
+    # server_robust_agg ACROSS the edge summaries — f Byzantine clients
+    # inside one edge are contained at that edge, a fully compromised edge
+    # is rejected at the top tier.
+    hierarchy_edges: int = 0           # E edge groups; 0 = flat legacy path
+    hierarchy_assign: str = "contiguous"  # contiguous | round_robin
+    edge_robust_agg: str = "mean"      # within-edge aggregator (robust_agg registry)
+    server_robust_agg: str = "mean"    # cross-edge aggregator (robust_agg registry)
+    edge_quorum_frac: float = 0.5      # min fraction of live edges per round
+    # Seeded edge-level fault injection: transient crash, stall past the
+    # round_deadline, or a corrupted (sign-flipped) summary, each drawn
+    # independently per edge per round.
+    edge_crash_prob: float = 0.0
+    edge_stall_prob: float = 0.0
+    edge_corrupt_prob: float = 0.0
+    edge_fault_seed: int = 0
+    # Scheduled permanent edge kill (global round index; -1 = never):
+    # clients of the dead edge are deterministically re-homed to surviving
+    # edges from the next round on (edge_rehomed evidence).
+    edge_kill_round: int = -1
+    edge_kill_edge: int = 0
+
+    # --- wire compression (comm/compress.py; docs/RESILIENCE.md) ---------
+    # Codec applied to client->edge (and edge->server) update diffs. The
+    # lossy effect is simulated inside the device program (the aggregate
+    # sees exactly what decode(encode(update)) would yield); real framing +
+    # sha256 digests ride the broker path (bench.py --hierarchy, tests).
+    compress_codec: str = "none"       # none | int8 | topk | delta
+    compress_topk_frac: float = 0.4    # fraction of coordinates kept by topk
+
     # --- decision observability (obs/alerts.py; docs/OBSERVABILITY.md) --
     # Live rule-based health monitor tapping the event bus: cluster-count
     # churn, oracle-ARI collapse, divergence+Byzantine co-occurrence,
@@ -264,6 +298,38 @@ class ExperimentConfig:
             raise ValueError("alert_window must be >= 1")
         if self.alert_churn_threshold < 1:
             raise ValueError("alert_churn_threshold must be >= 1")
+        if self.hierarchy_edges < 0:
+            raise ValueError("hierarchy_edges must be >= 0")
+        if self.hierarchy_edges > 0:
+            if self.hierarchy_edges > self.device_clients:
+                raise ValueError(
+                    f"hierarchy_edges={self.hierarchy_edges} > device client "
+                    f"axis {self.device_clients}")
+            if self.hierarchy_assign not in ("contiguous", "round_robin"):
+                raise ValueError(
+                    f"unknown hierarchy_assign {self.hierarchy_assign!r}")
+            for name in (self.edge_robust_agg, self.server_robust_agg):
+                if name not in ("mean", "median", "trimmed_mean", "krum",
+                                "multi_krum", "norm_clip"):
+                    raise ValueError(f"unknown tier aggregator {name!r}")
+            if self.robust_agg != "mean":
+                raise ValueError(
+                    "hierarchy_edges > 0 replaces the flat aggregator with "
+                    "edge_robust_agg/server_robust_agg; leave robust_agg at "
+                    "'mean'")
+            if not 0.0 < self.edge_quorum_frac <= 1.0:
+                raise ValueError("edge_quorum_frac must be in (0, 1]")
+            for p in (self.edge_crash_prob, self.edge_stall_prob,
+                      self.edge_corrupt_prob):
+                if not 0.0 <= p < 1.0:
+                    raise ValueError("edge fault probabilities must be in [0, 1)")
+            if self.edge_kill_round >= 0 \
+                    and not 0 <= self.edge_kill_edge < self.hierarchy_edges:
+                raise ValueError("edge_kill_edge out of range")
+        if self.compress_codec not in ("none", "int8", "topk", "delta"):
+            raise ValueError(f"unknown compress_codec {self.compress_codec!r}")
+        if not 0.0 < self.compress_topk_frac <= 1.0:
+            raise ValueError("compress_topk_frac must be in (0, 1]")
 
     # ------------------------------------------------------------------
     @property

@@ -57,8 +57,10 @@ import jax.numpy as jnp
 import optax
 
 from feddrift_tpu import obs
+from feddrift_tpu.comm.compress import simulate_codec
 from feddrift_tpu.core.functional import confusion_matrix, cross_entropy, tree_select
 from feddrift_tpu.platform.faults import BYZ_MODES, apply_byzantine_updates
+from feddrift_tpu.platform.hierarchical import two_tier_aggregate
 from feddrift_tpu.resilience.robust_agg import RobustAggConfig, aggregate
 
 
@@ -119,6 +121,20 @@ class TrainStep:
     # read when a byz_modes vector is passed into the round.
     byz_scale: float = 10.0
     byz_std: float = 1.0
+    # Static: two-tier hierarchical aggregation (platform/hierarchical.py).
+    # hier_edges > 0 replaces the flat aggregation with client -> edge ->
+    # server: edge_agg within each group, server_agg across the edge
+    # summaries — both drawn from the same robust_agg registry. The edge
+    # loop is Python-unrolled, so the round program specializes on E.
+    hier_edges: int = 0
+    edge_agg: str = "mean"
+    server_agg: str = "mean"
+    # Static: in-program wire-codec simulation (comm/compress.py): the
+    # submitted update stack becomes decode(encode(update)) before any
+    # aggregation, so the training trajectory reflects exactly the loss
+    # the negotiated codec introduces on the broker path.
+    codec: str = "none"
+    codec_topk_frac: float = 0.4
     # Static: XLA cost-capture level for the tracked programs
     # (obs/costmodel.py CAPTURE_LEVELS). "lowered" re-lowers each program
     # once at first compile to read cost_analysis() (FLOPs / bytes
@@ -246,7 +262,8 @@ class TrainStep:
     # ------------------------------------------------------------------
     def _round_body(self, params, opt_states, key, x, y, time_w, sample_w,
                     feat_mask, lr_scale, client_mask=None, byz_modes=None,
-                    stale_params=None):
+                    stale_params=None, edge_ids=None, edge_mask=None,
+                    edge_modes=None, codec_prev=None):
         """One communication round (untraced body shared by train_round and
         the fused train_iteration_eval scan).
 
@@ -262,6 +279,17 @@ class TrainStep:
         (self.robust_agg) sees exactly what a malicious client would send.
         stale_params: each client's previous-round submission ([M, C, ...]),
         needed only when stale_replay can occur.
+
+        edge_ids [C] int32 / edge_mask [E] / edge_modes [E]: the two-tier
+        hierarchy operands (platform/hierarchical.py::two_tier_aggregate),
+        used only when ``self.hier_edges > 0``. codec_prev [M, C, ...]:
+        last round's decoded diff stack, the delta codec's carry (None ->
+        zeros: round 0 deltas against the broadcast params).
+
+        Returns ``(new_params, new_opt, client_params, n, losses,
+        agg_stats, new_codec_prev)`` — agg_stats is [M, 3] on the flat
+        path and [1 + E, M, 3] (server tier in row 0) on the hierarchy
+        path; new_codec_prev is None unless codec == "delta".
         """
         if client_mask is not None:
             time_w = time_w * client_mask[None, :, None]
@@ -290,23 +318,49 @@ class TrainStep:
                 client_params, params, byz_modes, stale_params,
                 jax.random.fold_in(key, 7919), self.byz_scale, self.byz_std)
 
+        # Wire-codec simulation AFTER the adversary: the defense sees the
+        # compressed version of whatever each client (honest or not) sent.
+        new_codec_prev = None
+        if self.codec != "none":
+            diffs = jax.tree_util.tree_map(
+                lambda cp, g: cp - g[:, None], client_params, params)
+            if self.codec == "delta" and codec_prev is None:
+                codec_prev = jax.tree_util.tree_map(jnp.zeros_like, diffs)
+            decoded, new_codec_prev = simulate_codec(
+                diffs, self.codec, self.codec_topk_frac, codec_prev)
+            client_params = jax.tree_util.tree_map(
+                lambda g, d: g[:, None] + d, params, decoded)
+
         # Masked per-cluster aggregation over the client axis
         # (AggregatorSoftCluster.py:149-185): the registered robust_agg
         # strategy — "mean" is the historical weighted FedAvg, bit for bit.
         # With a sharded client axis the sums become ICI all-reduces.
-        new_params, agg_stats = aggregate(
-            self.robust_agg, client_params, n, params,
-            jax.random.fold_in(key, 104729), self.robust_cfg)
-        return new_params, new_opt, client_params, n, losses, agg_stats
+        # hier_edges > 0 routes the same stack through the two-tier path:
+        # edge_agg within each group, server_agg across edge summaries.
+        if self.hier_edges > 0 and edge_ids is not None:
+            new_params, agg_stats = two_tier_aggregate(
+                self.edge_agg, self.server_agg, client_params, n, params,
+                edge_ids, self.hier_edges, edge_mask, edge_modes,
+                jax.random.fold_in(key, 104729), self.robust_cfg,
+                self.byz_scale, self.byz_std)
+        else:
+            new_params, agg_stats = aggregate(
+                self.robust_agg, client_params, n, params,
+                jax.random.fold_in(key, 104729), self.robust_cfg)
+        return (new_params, new_opt, client_params, n, losses, agg_stats,
+                new_codec_prev)
 
     def train_round(self, params, opt_states, key, x, y, time_w, sample_w,
                     feat_mask, lr_scale, client_mask=None, byz_modes=None,
-                    stale_params=None, *, keep_client_params: bool = True,
+                    stale_params=None, edge_ids=None, edge_mask=None,
+                    edge_modes=None, codec_prev=None, *,
+                    keep_client_params: bool = True,
                     with_agg_stats: bool = False):
         """One communication round. Returns (new_params [M, ...],
         new_opt_states, client_params [M, C, ...], n [M, C], mean_loss [M, C])
         plus, when ``with_agg_stats``, the robust-aggregation stats
-        [M, 3] = (active, rejected, clipped) per cluster.
+        ([M, 3] flat, [1 + E, M, 3] hierarchical) and the delta-codec
+        carry (None unless codec == "delta").
 
         ``keep_client_params=False`` drops the per-client parameter output
         (returned as None): only CFL-family algorithms need the [M, C, ...]
@@ -316,16 +370,19 @@ class TrainStep:
         """
         kind = self._note_signature(
             "train_round", params, opt_states, x, y, time_w, sample_w,
-            feat_mask, client_mask, byz_modes, stale_params,
+            feat_mask, client_mask, byz_modes, stale_params, edge_ids,
+            edge_mask, edge_modes, codec_prev,
             static=(keep_client_params,))
         self._capture_cost(
             kind, "train_round", type(self)._train_round_jit,
             (params, opt_states, key, x, y, time_w, sample_w, feat_mask,
-             lr_scale, client_mask, byz_modes, stale_params),
+             lr_scale, client_mask, byz_modes, stale_params, edge_ids,
+             edge_mask, edge_modes, codec_prev),
             {"keep_client_params": keep_client_params})
         out = self._train_round_jit(
             params, opt_states, key, x, y, time_w, sample_w, feat_mask,
-            lr_scale, client_mask, byz_modes, stale_params,
+            lr_scale, client_mask, byz_modes, stale_params, edge_ids,
+            edge_mask, edge_modes, codec_prev,
             keep_client_params=keep_client_params)
         return out if with_agg_stats else out[:5]
 
@@ -333,15 +390,17 @@ class TrainStep:
              static_argnames=("keep_client_params",))
     def _train_round_jit(self, params, opt_states, key, x, y, time_w,
                          sample_w, feat_mask, lr_scale, client_mask=None,
-                         byz_modes=None, stale_params=None, *,
+                         byz_modes=None, stale_params=None, edge_ids=None,
+                         edge_mask=None, edge_modes=None, codec_prev=None, *,
                          keep_client_params: bool = True):
         out = self._round_body(params, opt_states, key, x, y, time_w,
                                sample_w, feat_mask, lr_scale, client_mask,
-                               byz_modes, stale_params)
+                               byz_modes, stale_params, edge_ids, edge_mask,
+                               edge_modes, codec_prev)
         if keep_client_params:
             return out
-        new_params, new_opt, _client_params, n, losses, agg_stats = out
-        return new_params, new_opt, None, n, losses, agg_stats
+        new_params, new_opt, _client_params, n, losses, agg_stats, cprev = out
+        return new_params, new_opt, None, n, losses, agg_stats, cprev
 
     @staticmethod
     def eval_rounds(R: int, freq: int) -> list[int]:
@@ -354,7 +413,8 @@ class TrainStep:
 
     def train_iteration_eval(self, params, opt_states, iter_key, x, y, time_w,
                              sample_w, feat_mask, lr_scale, R: int, freq: int,
-                             t, client_masks=None, byz_modes=None, *,
+                             t, client_masks=None, byz_modes=None,
+                             edge_ids=None, edge_masks=None, edge_byz=None, *,
                              byz_stale: bool = False,
                              with_agg_stats: bool = False):
         """ALL R communication rounds of a time step + every scheduled eval
@@ -370,23 +430,30 @@ class TrainStep:
         (ByzantineInjector.schedule). ``byz_stale=True`` makes the scan
         carry every client's previous submission so stale_replay attacks
         replay it (costs one extra [M, C, ...] buffer in the carry).
-        ``with_agg_stats`` additionally returns the per-round [R, M, 3]
-        robust-aggregation stats.
+        edge_ids [R, C] / edge_masks [R, E] / edge_byz [R, E]: per-round
+        hierarchy operands (edge ids vary across rounds only after a
+        re-home; faults are precomputed host-side like byz_modes). The
+        delta codec's decoded-diff carry rides the scan automatically
+        when ``self.codec == "delta"``.
+        ``with_agg_stats`` additionally returns the per-round stats
+        ([R, M, 3] flat, [R, 1 + E, M, 3] hierarchical).
         """
         kind = self._note_signature(
             "train_iteration_eval", params, opt_states, x, y, time_w,
-            sample_w, feat_mask, client_masks, byz_modes,
+            sample_w, feat_mask, client_masks, byz_modes, edge_ids,
+            edge_masks, edge_byz,
             static=(R, freq, byz_stale))
         self._capture_cost(
             kind, "train_iteration_eval",
             type(self)._train_iteration_eval_jit,
             (params, opt_states, iter_key, x, y, time_w, sample_w,
-             feat_mask, lr_scale, R, freq, t, client_masks, byz_modes),
+             feat_mask, lr_scale, R, freq, t, client_masks, byz_modes,
+             edge_ids, edge_masks, edge_byz),
             {"byz_stale": byz_stale})
         out = self._train_iteration_eval_jit(
             params, opt_states, iter_key, x, y, time_w, sample_w, feat_mask,
-            lr_scale, R, freq, t, client_masks, byz_modes,
-            byz_stale=byz_stale)
+            lr_scale, R, freq, t, client_masks, byz_modes, edge_ids,
+            edge_masks, edge_byz, byz_stale=byz_stale)
         return out if with_agg_stats else out[:6]
 
     @partial(jax.jit, static_argnums=(0, 10, 11), donate_argnums=(1, 2),
@@ -394,7 +461,9 @@ class TrainStep:
     def _train_iteration_eval_jit(self, params, opt_states, iter_key, x, y,
                                   time_w, sample_w, feat_mask, lr_scale,
                                   R: int, freq: int, t, client_masks=None,
-                                  byz_modes=None, *, byz_stale: bool = False):
+                                  byz_modes=None, edge_ids=None,
+                                  edge_masks=None, edge_byz=None, *,
+                                  byz_stale: bool = False):
         """ALL R communication rounds of a time step + every scheduled eval
         as ONE device program.
 
@@ -427,16 +496,18 @@ class TrainStep:
                      jnp.zeros((M, C), jnp.int32), jnp.zeros((M, C), jnp.float32))
 
         def one(carry, rx):
-            r, cm, bz = rx
+            r, cm, bz, eid, em, eb = rx
+            p, o, bufs = carry[:3]
+            rest = carry[3:]
+            stale = cprev = None
             if byz_stale:
-                p, o, bufs, stale = carry
-            else:
-                p, o, bufs = carry
-                stale = None
+                stale, rest = rest[0], rest[1:]
+            if self.codec == "delta":
+                cprev = rest[0]
             key = jax.random.fold_in(iter_key, r)
-            p, o, cp, n, losses, agg_stats = self._round_body(
+            p, o, cp, n, losses, agg_stats, cprev_new = self._round_body(
                 p, o, key, x, y, time_w, sample_w, feat_mask, lr_scale, cm,
-                bz, stale)
+                bz, stale, eid, em, eb, cprev)
 
             is_eval = ((r % freq) == 0) | (r == R - 1)
             slot = jnp.where(r == R - 1, E - 1, r // freq)
@@ -452,7 +523,11 @@ class TrainStep:
                           jax.lax.dynamic_update_index_in_dim(b, m, slot, 0),
                           b)
                 for b, m in zip(bufs, mats))
-            out_carry = ((p, o, bufs, cp) if byz_stale else (p, o, bufs))
+            out_carry = (p, o, bufs)
+            if byz_stale:
+                out_carry = out_carry + (cp,)
+            if self.codec == "delta":
+                out_carry = out_carry + (cprev_new,)
             return out_carry, (n, losses, agg_stats)
 
         bufs0 = tuple(jnp.zeros((E, M, C), d) for d in
@@ -465,9 +540,16 @@ class TrainStep:
                 lambda l: jnp.broadcast_to(
                     l[:, None], (l.shape[0], C, *l.shape[1:])), params)
             carry0 = carry0 + (stale0,)
+        if self.codec == "delta":
+            # round 0 deltas against the broadcast params (zero history)
+            cprev0 = jax.tree_util.tree_map(
+                lambda l: jnp.zeros((l.shape[0], C, *l.shape[1:]), l.dtype),
+                params)
+            carry0 = carry0 + (cprev0,)
         carry, (ns, ls, stats) = jax.lax.scan(
             one, carry0,
-            (jnp.arange(R, dtype=jnp.int32), client_masks, byz_modes))
+            (jnp.arange(R, dtype=jnp.int32), client_masks, byz_modes,
+             edge_ids, edge_masks, edge_byz))
         params, opt_states, bufs = carry[0], carry[1], carry[2]
         total = jnp.full((C,), x.shape[2], dtype=jnp.int32)
         return params, opt_states, ns[-1], ls[-1], bufs, total, stats

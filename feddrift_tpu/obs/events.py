@@ -91,6 +91,14 @@ EVENT_KINDS = frozenset({
     "client_leave",         # members left the registered population
     "straggler_masked",     # sampled members missed the round deadline
     "round_degraded",       # on-time cohort below quorum: params kept
+    # hierarchical two-tier aggregation + wire compression
+    # (platform/hierarchical.py, platform/faults.py::EdgeFaultInjector,
+    # comm/compress.py, simulation/runner.py)
+    "edge_aggregated",      # per-round per-tier aggregation evidence
+    "edge_failed",          # edge crash/stall/corrupt/kill this round
+    "edge_rehomed",         # dead edge's clients re-homed to survivors
+    "update_compressed",    # one update frame sent through a lossy codec
+    "compress_corrupt",     # frame failed digest verification; nacked
 })
 
 RING_SIZE = 4096

@@ -35,6 +35,7 @@ DEFAULT_TOL = {
     "wall": 0.30,        # fail if wall_s > baseline * (1 + tol)
     "acc": 0.02,         # fail if final_test_acc < baseline - tol
     "compiles": 0.0,     # fail if steady-state compiles > baseline + tol
+    "bytes": 0.25,       # fail if bytes_per_round > baseline * (1 + tol)
 }
 
 
@@ -177,6 +178,35 @@ def compare(candidate: dict, baseline: dict,
                                      "population size"))
     elif isinstance(bps, list):
         skip("popscale", "candidate lacks the popscale axis")
+
+    # two-tier wire axis (bench.py --hierarchy; COMM artifacts): broker
+    # bytes/round per codec under the bytes ceiling, plus an ABSOLUTE
+    # >= 3x reduction floor for every lossy codec — a codec that stops
+    # compressing is a regression even if the baseline also regressed.
+    ch, bh = candidate.get("hierarchy"), baseline.get("hierarchy")
+    if isinstance(ch, list) and isinstance(bh, list):
+        by_codec = {e.get("codec"): e for e in bh if isinstance(e, dict)}
+        for e in ch:
+            if not isinstance(e, dict):
+                continue
+            cd = e.get("codec")
+            be = by_codec.get(cd)
+            if be is None:
+                skip(f"hierarchy[{cd}]", "codec missing in baseline")
+                continue
+            bv, cv = be.get("bytes_per_round"), e.get("bytes_per_round")
+            if bv and cv:
+                ceil = bv * (1.0 + tol["bytes"])
+                rows.append(row(f"hierarchy[{cd}].bytes_per_round", bv, cv,
+                                f"<= {ceil:.0f}", cv > ceil))
+            ratio = e.get("ratio_vs_none")
+            if cd != "none" and ratio is not None:
+                rows.append(row(f"hierarchy[{cd}].ratio_vs_none",
+                                be.get("ratio_vs_none"), ratio, ">= 3",
+                                ratio < 3.0,
+                                note="compression floor vs uncompressed"))
+    elif isinstance(bh, list):
+        skip("hierarchy", "candidate lacks the hierarchy axis")
     return rows
 
 
@@ -228,6 +258,9 @@ def main(argv: list[str] | None = None) -> int:
                     default=DEFAULT_TOL["compiles"],
                     help="absolute extra steady-state compiles tolerated "
                          "(default %(default)s)")
+    ap.add_argument("--tol-bytes", type=float, default=DEFAULT_TOL["bytes"],
+                    help="relative wire bytes/round growth tolerated "
+                         "(default %(default)s)")
     ap.add_argument("--json", action="store_true", help="machine-readable")
     args = ap.parse_args(argv)
 
@@ -240,7 +273,8 @@ def main(argv: list[str] | None = None) -> int:
 
     rows = compare(candidate, baseline,
                    tol={"rounds": args.tol_rounds, "wall": args.tol_wall,
-                        "acc": args.tol_acc, "compiles": args.tol_compiles})
+                        "acc": args.tol_acc, "compiles": args.tol_compiles,
+                        "bytes": args.tol_bytes})
     regressed = any(r["status"] == "regress" for r in rows)
     if args.json:
         print(json.dumps({"regressed": regressed, "rows": rows,

@@ -31,6 +31,8 @@ RESILIENCE_KINDS = ("conn_reconnect", "publish_retry", "heartbeat_missed",
                     "divergence_detected", "checkpoint_corrupt")
 ROBUSTNESS_KINDS = ("byzantine_injected", "robust_agg_applied",
                     "acc_stale_excluded", "quorum_revive")
+HIERARCHY_KINDS = ("edge_aggregated", "edge_failed", "edge_rehomed",
+                   "update_compressed", "compress_corrupt")
 
 
 def _load_jsonl(path: str) -> list[dict]:
@@ -265,6 +267,61 @@ def summarize(run_dir: str) -> dict[str, Any]:
         if qrev:
             rob["quorum_revives"] = len(qrev)
         out["robustness"] = rob
+
+    # -- hierarchy --------------------------------------------------------
+    # two-tier edge aggregation + wire compression
+    # (platform/hierarchical.py, comm/compress.py; docs/RESILIENCE.md
+    # Hierarchical aggregation)
+    eagg = [e for e in events if e["kind"] == "edge_aggregated"]
+    efail = [e for e in events if e["kind"] == "edge_failed"]
+    ereh = [e for e in events if e["kind"] == "edge_rehomed"]
+    comp_ev = [e for e in events if e["kind"] == "update_compressed"]
+    corrupt = [e for e in events if e["kind"] == "compress_corrupt"]
+    if eagg or efail or ereh or comp_ev or corrupt:
+        hier: dict[str, Any] = {}
+        if eagg:
+            last = eagg[-1]
+            hier["tiers"] = {
+                "rounds": len(eagg),
+                "edges": len(last.get("edge_active") or []),
+                "edge_strategy": last.get("edge_strategy"),
+                "server_strategy": last.get("server_strategy"),
+                "edge_rejected_total": sum(e.get("edge_rejected", 0)
+                                           for e in eagg),
+                "server_rejected_total": sum(e.get("server_rejected", 0)
+                                             for e in eagg),
+            }
+        if efail:
+            by_reason: dict[str, int] = {}
+            for e in efail:
+                r = e.get("reason", "?")
+                by_reason[r] = by_reason.get(r, 0) + 1
+            hier["edge_failures"] = {"count": len(efail),
+                                     "by_reason": by_reason}
+        if ereh:
+            hier["rehomed"] = {
+                "events": len(ereh),
+                "clients_total": sum(len(e.get("clients", []))
+                                     for e in ereh),
+                "last": {"edge": ereh[-1].get("edge"),
+                         "targets": ereh[-1].get("targets")},
+            }
+        if comp_ev:
+            by_codec: dict[str, dict[str, int]] = {}
+            for e in comp_ev:
+                d = by_codec.setdefault(e.get("codec", "?"),
+                                        {"frames": 0, "raw_bytes": 0,
+                                         "wire_bytes": 0})
+                d["frames"] += 1
+                d["raw_bytes"] += e.get("raw_bytes", 0)
+                d["wire_bytes"] += e.get("wire_bytes", 0)
+            hier["compression"] = {
+                c: {**d, "ratio": round(d["raw_bytes"]
+                                        / max(d["wire_bytes"], 1), 2)}
+                for c, d in by_codec.items()}
+        if corrupt:
+            hier["corrupt_frames"] = len(corrupt)
+        out["hierarchy"] = hier
 
     # -- cost model (obs/costmodel.py) -----------------------------------
     # XLA's own accounting per compiled program + live HBM watermarks
@@ -503,6 +560,35 @@ def render(summary: dict[str, Any]) -> str:
                      f"decisions: {s['decisions']})")
         if rob.get("quorum_revives"):
             L.append(f"  quorum revives: {rob['quorum_revives']}")
+
+    hier = summary.get("hierarchy")
+    if hier:
+        L.append("")
+        L.append("hierarchy:")
+        ti = hier.get("tiers")
+        if ti:
+            L.append(f"  two-tier rounds: {ti['rounds']} over "
+                     f"{ti['edges']} edges (edge={ti['edge_strategy']}, "
+                     f"server={ti['server_strategy']}); rejected "
+                     f"edge={ti['edge_rejected_total']} "
+                     f"server={ti['server_rejected_total']}")
+        ef = hier.get("edge_failures")
+        if ef:
+            reasons = ", ".join(f"{r}×{n}"
+                                for r, n in sorted(ef["by_reason"].items()))
+            L.append(f"  edge failures: {ef['count']} ({reasons})")
+        rh = hier.get("rehomed")
+        if rh:
+            L.append(f"  re-homed: {rh['clients_total']} clients across "
+                     f"{rh['events']} events (last: edge "
+                     f"{rh['last']['edge']} → {rh['last']['targets']})")
+        for codec, d in sorted((hier.get("compression") or {}).items()):
+            L.append(f"  wire {codec}: {d['frames']} frames, "
+                     f"{d['raw_bytes']} → {d['wire_bytes']} bytes "
+                     f"({d['ratio']}x)")
+        if hier.get("corrupt_frames"):
+            L.append(f"  corrupt frames detected: {hier['corrupt_frames']} "
+                     "(nacked, re-sent uncompressed)")
 
     al = summary.get("alerts")
     if al:

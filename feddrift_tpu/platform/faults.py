@@ -323,6 +323,87 @@ class ByzantineInjector:
         return np.stack([self.modes(int(r)) for r in rounds])
 
 
+class EdgeFaultInjector:
+    """Deterministic per-round fault draws for the EDGE tier
+    (platform/hierarchical.py two-tier rounds).
+
+    Edges are failure domains, so they get the full client failure
+    taxonomy one level up: *crash* (the edge aggregator misses the round
+    entirely), *stall* (it reports past the round deadline and is masked
+    by the edge-level ``ParticipationPolicy``), *corrupt* (it submits a
+    sign-flipped summary — the Byzantine-edge case the server-tier robust
+    aggregator exists to reject), plus permanent ``kill`` (the edge is
+    gone; its clients are re-homed by ``EdgeMap``). Draws are a pure
+    function of ``(seed, round)`` — reproducible, resumable, and
+    precomputable for a whole fused iteration.
+    """
+
+    PRIME = 7_000_003
+
+    def __init__(self, num_edges: int, crash_prob: float = 0.0,
+                 stall_prob: float = 0.0, corrupt_prob: float = 0.0,
+                 deadline: float = 1.0, seed: int = 0) -> None:
+        for p in (crash_prob, stall_prob, corrupt_prob):
+            if not 0.0 <= p < 1.0:
+                raise ValueError(f"edge fault prob must be in [0, 1), got {p}")
+        self.E = int(num_edges)
+        self.crash_prob = crash_prob
+        self.stall_prob = stall_prob
+        self.corrupt_prob = corrupt_prob
+        self.deadline = float(deadline)
+        self.seed = seed
+        self.dead = np.zeros(self.E, dtype=bool)
+
+    def kill(self, edge: int, round_idx: int = 0) -> None:
+        """Permanently fail an edge aggregator (not coming back)."""
+        if self.dead[edge]:
+            return
+        self.dead[edge] = True
+        obs.emit("edge_failed", fault_round=int(round_idx), edges=[int(edge)],
+                 reason="killed")
+        obs.registry().counter("edge_faults", reason="killed").inc()
+
+    def _draws(self, round_idx: int) -> np.ndarray:
+        rng = np.random.RandomState(
+            (self.seed * self.PRIME + round_idx) % (2 ** 31 - 1))
+        return rng.random_sample((4, self.E))
+
+    def crashes(self, round_idx: int) -> np.ndarray:
+        """[E] bool: edges missing this round entirely (transient crash
+        draws plus permanently dead edges). Emits per-round evidence for
+        the transient crashes only — kills are reported at kill() time."""
+        transient = (self._draws(round_idx)[0] < self.crash_prob) & ~self.dead
+        if transient.any():
+            obs.emit("edge_failed", fault_round=int(round_idx),
+                     edges=np.nonzero(transient)[0].tolist(), reason="crash")
+            obs.registry().counter("edge_faults", reason="crash").inc(
+                int(transient.sum()))
+        return transient | self.dead
+
+    def latencies(self, round_idx: int) -> np.ndarray:
+        """[E] simulated edge report latencies: stalled edges land past
+        the deadline (masked by the edge ParticipationPolicy), healthy
+        ones well inside it."""
+        d = self._draws(round_idx)
+        stall = d[1] < self.stall_prob
+        on_time = 0.2 * self.deadline * (0.5 + d[3])
+        late = self.deadline * (1.5 + d[3])
+        return np.where(stall & ~self.dead, late, on_time)
+
+    def corrupt_modes(self, round_idx: int) -> np.ndarray:
+        """[E] int32 corrupt-summary codes (0 = honest): a corrupted edge
+        sign-flips its summary, the edge-level analog of a Byzantine
+        client — containment is the SERVER aggregator's job."""
+        corrupt = (self._draws(round_idx)[2] < self.corrupt_prob) & ~self.dead
+        modes = np.where(corrupt, BYZ_MODES["sign_flip"], 0).astype(np.int32)
+        if corrupt.any():
+            obs.emit("edge_failed", fault_round=int(round_idx),
+                     edges=np.nonzero(corrupt)[0].tolist(), reason="corrupt")
+            obs.registry().counter("edge_faults", reason="corrupt").inc(
+                int(corrupt.sum()))
+        return modes
+
+
 def apply_byzantine_updates(client_params, global_params, modes,
                             stale_params, key, scale, std):
     """Corrupt the submitted update stack according to per-client modes.

@@ -1,12 +1,26 @@
-"""Hierarchical (cloud-edge-client) FL and decentralized online learners.
+"""Hierarchical (cloud-edge-client) FL: two-tier robust aggregation.
 
 Re-design of fedml_api/standalone/hierarchical_fl/trainer.py (groups of
 clients average per-edge every ``group_comm_round`` rounds, edges average
 globally) and fedml_api/standalone/decentralized/{client_dsgd,
 client_pushsum}.py (online gossip learners over a topology).
 
-On TPU the group structure is a [C] -> group-id map and both averaging
-levels are segment-sum reductions — one program, no edge processes.
+On TPU the group structure is a [C] -> edge-id map and both aggregation
+tiers run inside the round program — one XLA program, no edge processes.
+``two_tier_aggregate`` is the runner-driven path (core/step.py): each
+edge closes its round with the ``resilience/robust_agg.py`` registry
+applied WITHIN the group (masked rows, trimmed mean / Krum / clipping
+per edge), then the server applies a second, independent robust
+aggregator ACROSS the edge summaries. Containment follows from
+composition: f Byzantine clients inside one edge can at worst corrupt
+that edge's summary, which the server tier then treats as one corrupted
+row among E.
+
+``EdgeMap`` is the host-side failure-domain bookkeeping: the [C] slot ->
+edge assignment plus deterministic re-homing of a dead edge's clients to
+the survivors (the registry ``remap`` pattern of PR 6 applied to edges).
+Edge ids ride into the device program as a plain traced operand, so a
+re-home never changes an XLA program shape.
 """
 
 from __future__ import annotations
@@ -15,23 +29,51 @@ from functools import partial
 
 import jax
 import jax.numpy as jnp
+import numpy as np
+
+from feddrift_tpu import obs
+from feddrift_tpu.resilience.robust_agg import aggregate
 
 
 @partial(jax.jit, static_argnames=("num_groups",))
-def group_average(client_params, n, group_ids, num_groups: int):
+def group_average(client_params, n, group_ids, num_groups: int,
+                  prev_group_params=None):
     """Per-group weighted average (the edge aggregation).
 
     client_params: [C, ...] pytree; n: [C]; group_ids: [C] int.
     Returns ([G, ...] group params, [G] group weights).
+
+    A group whose total weight is zero (every member masked out) KEEPS
+    ``prev_group_params`` for that row instead of dividing toward zero —
+    the same masked-row rule robust_agg.weighted_mean applies at the top
+    tier. Without a previous value the unweighted mean of the member rows
+    is used (and a group with no members at all falls back to zeros,
+    the historical degenerate).
     """
     seg_n = jax.ops.segment_sum(n, group_ids, num_segments=num_groups)
-    def avg(leaf):
+    ones = jnp.ones_like(n)
+    seg_cnt = jax.ops.segment_sum(ones, group_ids, num_segments=num_groups)
+
+    def avg(leaf, prev_leaf=None):
+        shape = (-1,) + (1,) * (leaf.ndim - 1)
         wb = n.reshape((-1,) + (1,) * (leaf.ndim - 1))
         seg = jax.ops.segment_sum(leaf * wb, group_ids,
                                   num_segments=num_groups)
-        return seg / jnp.maximum(seg_n.reshape((-1,) + (1,) * (leaf.ndim - 1)),
-                                 1e-12)
-    return jax.tree_util.tree_map(avg, client_params), seg_n
+        seg = seg / jnp.maximum(seg_n.reshape(shape), 1e-12)
+        if prev_leaf is None:
+            # unweighted membership mean as the empty-weight fallback
+            fallback = jax.ops.segment_sum(leaf, group_ids,
+                                           num_segments=num_groups)
+            fallback = fallback / jnp.maximum(seg_cnt.reshape(shape), 1e-12)
+        else:
+            fallback = prev_leaf
+        return jnp.where(seg_n.reshape(shape) > 0, seg, fallback)
+
+    if prev_group_params is None:
+        out = jax.tree_util.tree_map(avg, client_params)
+    else:
+        out = jax.tree_util.tree_map(avg, client_params, prev_group_params)
+    return out, seg_n
 
 
 @partial(jax.jit, static_argnames=())
@@ -52,20 +94,126 @@ def scatter_groups(group_params, group_ids):
 class HierarchicalSchedule:
     """Round cadence of hierarchical_fl/trainer.py: every round ends with an
     edge (group) average; every ``global_period`` rounds the edges average
-    globally."""
+    globally. Carries the last group params so a fully-masked group keeps
+    its previous value (group_average's empty-group rule)."""
 
     def __init__(self, num_groups: int, group_ids, global_period: int) -> None:
         self.num_groups = num_groups
         self.group_ids = jnp.asarray(group_ids)
         self.global_period = global_period
+        self._last_group_params = None
 
     def end_of_round(self, client_params, n, round_idx: int):
         g_params, g_n = group_average(client_params, n, self.group_ids,
-                                      self.num_groups)
+                                      self.num_groups,
+                                      self._last_group_params)
         if (round_idx + 1) % self.global_period == 0:
             g = global_average(g_params, g_n)
             g_params = jax.tree_util.tree_map(
                 lambda leaf: jnp.broadcast_to(leaf[None],
                                               (self.num_groups, *leaf.shape)),
                 g)
+        self._last_group_params = g_params
         return scatter_groups(g_params, self.group_ids)
+
+
+# ---------------------------------------------------------------------------
+# runner-driven two-tier robust aggregation (core/step.py round body)
+
+def two_tier_aggregate(edge_agg: str, server_agg: str, client_params, n,
+                       prev_params, edge_ids, num_edges: int, edge_mask,
+                       edge_modes, key, rcfg, byz_scale: float = 10.0,
+                       byz_std: float = 1.0):
+    """Client -> edge -> server aggregation, robust at BOTH tiers.
+
+    client_params: [M, C, ...] pytree of per-client params;
+    n: [M, C] aggregation weights; prev_params: [M, ...];
+    edge_ids: [C] int (slot -> edge); edge_mask: [E] float or None
+    (0 = edge crashed/stalled this round); edge_modes: [E] int or None
+    (nonzero = corrupt-summary fault code, platform/faults.py BYZ_MODES).
+
+    The edge loop is Python-unrolled (E is static and small), each tier
+    calling the same ``aggregate`` registry the flat path uses: a
+    fully-masked edge keeps prev params AND carries zero weight into the
+    server tier; an all-edges-masked round keeps prev params outright
+    (no NaN, no zero-divide). Returns ``(new_params [M, ...],
+    stats [1 + E, M, 3])`` with the server tier in row 0.
+    """
+    edge_summaries, edge_stats, edge_w = [], [], []
+    for e in range(num_edges):
+        w_e = n * (edge_ids == e)
+        agg_e, stats_e = aggregate(edge_agg, client_params, w_e, prev_params,
+                                   jax.random.fold_in(key, 600_011 + e), rcfg)
+        edge_summaries.append(agg_e)
+        edge_stats.append(stats_e)
+        edge_w.append(w_e.sum(axis=1))
+    edge_stack = jax.tree_util.tree_map(
+        lambda *ls: jnp.stack(ls, axis=1), *edge_summaries)   # [M, E, ...]
+    w = jnp.stack(edge_w, axis=1)                             # [M, E]
+    if edge_mask is not None:
+        w = w * edge_mask[None, :]
+    if edge_modes is not None:
+        from feddrift_tpu.platform.faults import apply_byzantine_updates
+        edge_stack = apply_byzantine_updates(
+            edge_stack, prev_params, edge_modes, None,
+            jax.random.fold_in(key, 900_001), byz_scale, byz_std)
+    server_params, server_stats = aggregate(
+        server_agg, edge_stack, w, prev_params,
+        jax.random.fold_in(key, 104_729), rcfg)
+    stats = jnp.stack([server_stats] + edge_stats, axis=0)    # [1+E, M, 3]
+    return server_params, stats
+
+
+class EdgeMap:
+    """Host-side [C] slot -> edge assignment with deterministic re-homing.
+
+    ``contiguous`` keeps neighbouring slots on the same edge (the
+    geographic reading); ``round_robin`` stripes them. When an edge dies
+    permanently its slots are re-dealt round-robin over the survivors —
+    a pure function of (initial assignment, dead set), so every replica
+    of the run re-homes identically (the PR 6 registry-remap property).
+    """
+
+    def __init__(self, num_clients: int, num_edges: int,
+                 assign: str = "contiguous") -> None:
+        if not 0 < num_edges <= num_clients:
+            raise ValueError("need 0 < num_edges <= num_clients")
+        self.num_clients = int(num_clients)
+        self.num_edges = int(num_edges)
+        if assign == "contiguous":
+            self._initial = (np.arange(num_clients) * num_edges
+                             // num_clients).astype(np.int32)
+        elif assign == "round_robin":
+            self._initial = (np.arange(num_clients)
+                             % num_edges).astype(np.int32)
+        else:
+            raise ValueError(f"unknown assign {assign!r}")
+        self.ids = self._initial.copy()
+        self._dead: frozenset[int] = frozenset()
+
+    def rehome(self, dead, round_idx: int = 0) -> int:
+        """Re-home the slots of newly-dead edges onto survivors; no-op
+        when the dead set is unchanged. Returns the number of slots
+        moved (``edge_rehomed`` evidence is emitted per dead edge)."""
+        dead_set = frozenset(int(e) for e in np.flatnonzero(np.asarray(dead))) \
+            if not isinstance(dead, (set, frozenset)) else frozenset(dead)
+        if dead_set == self._dead:
+            return 0
+        self._dead = dead_set
+        survivors = [e for e in range(self.num_edges) if e not in dead_set]
+        ids = self._initial.copy()
+        moved = 0
+        if survivors:
+            orphan = np.flatnonzero(np.isin(ids, list(dead_set)))
+            for i, slot in enumerate(orphan):
+                ids[slot] = survivors[i % len(survivors)]
+            moved = int(orphan.size)
+            for e in sorted(dead_set):
+                slots = np.flatnonzero(self._initial == e)
+                if slots.size:
+                    obs.emit("edge_rehomed", fault_round=int(round_idx),
+                             edge=int(e),
+                             clients=[int(s) for s in slots],
+                             targets=[int(ids[s]) for s in slots])
+        self.ids = ids
+        return moved

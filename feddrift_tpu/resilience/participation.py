@@ -54,10 +54,17 @@ class ParticipationPolicy:
 
     def close_round(self, members: np.ndarray,
                     latencies: np.ndarray | None,
-                    round_idx: int) -> RoundOutcome:
+                    round_idx: int, entity: str = "client") -> RoundOutcome:
         """Close one round. ``members`` [K] (< 0 = phantom slot),
         ``latencies`` [K] simulated report latencies (None = everyone
-        reports instantly). Emits the evidence events."""
+        reports instantly). Emits the evidence events.
+
+        The same closing rule serves both tiers of a hierarchical round:
+        with ``entity="edge"`` the members are edge aggregators, a late
+        one leaves ``edge_failed`` (reason "stall") evidence instead of
+        ``straggler_masked``, and a below-quorum round degrades with
+        ``tier="edge"`` — the caller keeps previous params either way.
+        """
         members = np.asarray(members)
         valid = members >= 0
         if latencies is None:
@@ -67,15 +74,25 @@ class ParticipationPolicy:
         stragglers = members[valid & ~on_time]
         degraded = int(on_time.sum()) < self.quorum
         if stragglers.size:
-            obs.emit("straggler_masked", part_round=int(round_idx),
-                     clients=stragglers.tolist(),
-                     on_time=int(on_time.sum()), deadline=self.deadline)
-            obs.registry().counter("stragglers_masked").inc(
-                int(stragglers.size))
+            if entity == "edge":
+                obs.emit("edge_failed", fault_round=int(round_idx),
+                         edges=stragglers.tolist(), reason="stall",
+                         on_time=int(on_time.sum()), deadline=self.deadline)
+                obs.registry().counter("edge_faults", reason="stall").inc(
+                    int(stragglers.size))
+            else:
+                obs.emit("straggler_masked", part_round=int(round_idx),
+                         clients=stragglers.tolist(),
+                         on_time=int(on_time.sum()), deadline=self.deadline)
+                obs.registry().counter("stragglers_masked").inc(
+                    int(stragglers.size))
         if degraded:
-            obs.emit("round_degraded", part_round=int(round_idx),
-                     on_time=int(on_time.sum()), quorum=self.quorum,
-                     stragglers=stragglers.tolist())
+            payload = {"part_round": int(round_idx),
+                       "on_time": int(on_time.sum()), "quorum": self.quorum,
+                       "stragglers": stragglers.tolist()}
+            if entity != "client":
+                payload["tier"] = entity
+            obs.emit("round_degraded", **payload)
             obs.registry().counter("rounds_degraded").inc()
         return RoundOutcome(on_time=on_time, degraded=degraded,
                             quorum=self.quorum, stragglers=stragglers)

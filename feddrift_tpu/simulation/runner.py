@@ -81,6 +81,13 @@ class Experiment:
                 dp_stddev=cfg.robust_dp_stddev),
             byz_scale=cfg.byzantine_scale,
             byz_std=cfg.byzantine_std,
+            # Static: two-tier hierarchical aggregation + in-program wire
+            # codec simulation (platform/hierarchical.py, comm/compress.py).
+            hier_edges=cfg.hierarchy_edges,
+            edge_agg=cfg.edge_robust_agg,
+            server_agg=cfg.server_robust_agg,
+            codec=cfg.compress_codec,
+            codec_topk_frac=cfg.compress_topk_frac,
             # Static: XLA cost-capture level (obs/costmodel.py) — each
             # tracked program's first compile also harvests cost_analysis
             # (and memory_analysis under "compiled") into program_cost
@@ -222,11 +229,36 @@ class Experiment:
                               prob=cfg.byzantine_prob,
                               seed=cfg.byzantine_seed)
             if byz_clients else None)
+        # Two-tier hierarchy (platform/hierarchical.py): a host-side edge
+        # map over the padded client axis, an edge-level fault injector
+        # (crash/stall/corrupt + scheduled kill), and the same deadline +
+        # quorum closing rule as population rounds applied at edge
+        # granularity.
+        self.hierarchy = cfg.hierarchy_edges > 0
+        self.edge_map = self.edge_fault = self.edge_participation = None
+        if self.hierarchy:
+            from feddrift_tpu.platform.faults import EdgeFaultInjector
+            from feddrift_tpu.platform.hierarchical import EdgeMap
+            from feddrift_tpu.resilience.participation import \
+                ParticipationPolicy
+            E = cfg.hierarchy_edges
+            self.edge_map = EdgeMap(self.C_pad, E, assign=cfg.hierarchy_assign)
+            if (cfg.edge_crash_prob > 0 or cfg.edge_stall_prob > 0
+                    or cfg.edge_corrupt_prob > 0 or cfg.edge_kill_round >= 0):
+                self.edge_fault = EdgeFaultInjector(
+                    E, cfg.edge_crash_prob, cfg.edge_stall_prob,
+                    cfg.edge_corrupt_prob, deadline=cfg.round_deadline,
+                    seed=cfg.edge_fault_seed)
+                self.edge_participation = ParticipationPolicy(
+                    cfg.round_deadline, cfg.edge_quorum_frac, E)
         # robust_agg_applied events only when a defense is actually on —
         # plain "mean" runs keep their historical event stream.
-        self._robust_active = (cfg.robust_agg != "mean"
-                               or cfg.robust_dp_stddev > 0)
+        self._robust_active = (
+            cfg.robust_agg != "mean" or cfg.robust_dp_stddev > 0
+            or (self.hierarchy and (cfg.edge_robust_agg != "mean"
+                                    or cfg.server_robust_agg != "mean")))
         self._byz_stale = None   # last round's client submissions (stale_replay)
+        self._codec_prev = None  # delta codec: last round's decoded diffs
         self.key = experiment_key(cfg.seed)
         self.global_round = 0
         self.start_iteration = 0
@@ -524,6 +556,7 @@ class Experiment:
         # iteration boundary (fresh optimizers, possibly re-clustered pool)
         # resets the replay buffer like it resets the optimizer states
         self._byz_stale = None
+        self._codec_prev = None  # delta baseline resets with the round state
         if self.failure_detector is not None:
             # Hand the clustering layer each client's absence age + the
             # current suspect set BEFORE its create/merge decisions, so
@@ -699,19 +732,77 @@ class Experiment:
 
     def _emit_robust_stats(self, agg_stats, round_idx: int) -> None:
         """One robust_agg_applied event per round from the device's [M, 3]
-        (active, rejected, clipped) stats."""
+        (active, rejected, clipped) stats. Hierarchical rounds hand a
+        [1+E, M, 3] tier stack (server tier row 0, one row per edge):
+        those emit edge_aggregated with the per-tier evidence, then fall
+        through with the server row and the server-tier strategy."""
         s = np.asarray(agg_stats)
+        strategy = self.cfg.robust_agg
+        if s.ndim == 3:
+            server, edges = s[0], s[1:]
+            self.events.emit(
+                "edge_aggregated", round=round_idx,
+                edge_strategy=self.cfg.edge_robust_agg,
+                server_strategy=self.cfg.server_robust_agg,
+                edge_active=edges[:, :, 0].sum(axis=1).astype(int).tolist(),
+                edge_rejected=int(edges[:, :, 1].sum()),
+                server_active=server[:, 0].astype(int).tolist(),
+                server_rejected=int(server[:, 1].sum()))
+            obs.registry().counter("edge_aggregations").inc(len(edges))
+            if not self._robust_active:
+                return
+            s, strategy = server, self.cfg.server_robust_agg
         rejected, clipped = int(s[:, 1].sum()), int(s[:, 2].sum())
         self.events.emit(
             "robust_agg_applied", round=round_idx,
-            strategy=self.cfg.robust_agg,
+            strategy=strategy,
             active=s[:, 0].astype(int).tolist(),
             rejected=rejected, clipped=clipped)
         reg = obs.registry()
-        reg.counter("robust_rejected_updates",
-                    strategy=self.cfg.robust_agg).inc(rejected)
-        reg.counter("robust_clipped_updates",
-                    strategy=self.cfg.robust_agg).inc(clipped)
+        reg.counter("robust_rejected_updates", strategy=strategy).inc(rejected)
+        reg.counter("robust_clipped_updates", strategy=strategy).inc(clipped)
+
+    def _edge_state(self, t: int, rounds):
+        """Host-side edge plan for ``rounds`` of step ``t``: the per-round
+        client->edge assignment [R, C_pad], the edge participation mask
+        [R, E] (None without an injector), and the edge corruption modes
+        [R, E] (None when nothing corrupts).
+
+        Ordering per round: a scheduled kill lands first (edge_failed,
+        reason "killed"), this round runs with the CURRENT assignment and
+        the dead/crashed/stalled edges masked (below edge quorum the whole
+        mask row zeroes — every aggregator keeps previous params on an
+        all-masked tier), and only then are the dead edge's clients
+        re-homed, so they contribute through surviving edges from the NEXT
+        round — matching how a real orchestrator learns of the loss."""
+        cfg = self.cfg
+        E = cfg.hierarchy_edges
+        R = len(rounds)
+        ids = np.zeros((R, self.C_pad), dtype=np.int32)
+        inj = self.edge_fault
+        masks = np.ones((R, E), dtype=np.float32) if inj is not None else None
+        byz = None
+        for i, r in enumerate(rounds):
+            gr = t * cfg.comm_round + int(r)
+            if inj is not None and cfg.edge_kill_round >= 0 \
+                    and gr >= cfg.edge_kill_round:
+                inj.kill(cfg.edge_kill_edge, gr)   # idempotent past the round
+            ids[i] = self.edge_map.ids
+            if inj is None:
+                continue
+            crash = inj.crashes(gr)
+            members = np.where(crash, -1, np.arange(E))
+            outcome = self.edge_participation.close_round(
+                members, inj.latencies(gr), gr, entity="edge")
+            masks[i] = (np.zeros(E, dtype=np.float32) if outcome.degraded
+                        else outcome.on_time.astype(np.float32))
+            modes = inj.corrupt_modes(gr)
+            if modes.any():
+                if byz is None:
+                    byz = np.zeros((R, E), dtype=np.int32)
+                byz[i] = modes
+            self.edge_map.rehome(inj.dead, gr)   # effective next round
+        return ids, masks, byz
 
     def _run_rounds(self, t: int, opt_states) -> None:
         """Per-round host loop: algorithms that steer every round."""
@@ -724,6 +815,12 @@ class Experiment:
                 lambda l: jnp.broadcast_to(
                     l[:, None], (l.shape[0], self.C_pad, *l.shape[1:])),
                 self.pool.params)
+        if self.step.codec == "delta" and self._codec_prev is None:
+            # zero baseline diffs so round 0 shares the rounds' jit signature
+            self._codec_prev = jax.tree_util.tree_map(
+                lambda l: jnp.zeros((l.shape[0], self.C_pad, *l.shape[1:]),
+                                    l.dtype),
+                self.pool.params)
         keep_cp = self.algo.needs_client_params or (
             byz is not None and byz.has_stale)
         for r in range(cfg.comm_round):
@@ -733,20 +830,29 @@ class Experiment:
             sw = self._pad_clients(sw, value=1.0)
             cm = self._client_masks(t, [r])
             bm = self._byz_modes([r], t)
+            eids = emasks = ebyz = None
+            if self.hierarchy:
+                eids, emasks, ebyz = self._edge_state(t, [r])
             prev_params = self.pool.params
             with self.tracer.phase("train_round"):
-                new_params, opt_states, client_params, n, losses, agg_stats = \
-                    self.step.train_round(
-                        prev_params, opt_states, round_key(self.key, t, r),
-                        self.x, self.y, tw, sw, fm, lr_scale,
-                        None if cm is None else jnp.asarray(cm[0]),
-                        None if bm is None else jnp.asarray(bm[0]),
-                        self._byz_stale if (byz is not None and byz.has_stale)
-                        else None,
-                        keep_client_params=keep_cp, with_agg_stats=True)
+                (new_params, opt_states, client_params, n, losses, agg_stats,
+                 codec_prev) = self.step.train_round(
+                    prev_params, opt_states, round_key(self.key, t, r),
+                    self.x, self.y, tw, sw, fm, lr_scale,
+                    None if cm is None else jnp.asarray(cm[0]),
+                    None if bm is None else jnp.asarray(bm[0]),
+                    self._byz_stale if (byz is not None and byz.has_stale)
+                    else None,
+                    None if eids is None else jnp.asarray(eids[0]),
+                    None if emasks is None else jnp.asarray(emasks[0]),
+                    None if ebyz is None else jnp.asarray(ebyz[0]),
+                    self._codec_prev,
+                    keep_client_params=keep_cp, with_agg_stats=True)
                 if byz is not None and byz.has_stale:
                     self._byz_stale = client_params
-                if self._robust_active:
+                if self.step.codec == "delta":
+                    self._codec_prev = codec_prev
+                if self._robust_active or self.hierarchy:
                     self._emit_robust_stats(
                         multihost.fetch(agg_stats), self.global_round)
                 if cfg.trace_sync:
@@ -836,6 +942,11 @@ class Experiment:
         g0 = self.global_round
         cms = self._client_masks(t, range(R))
         bms = self._byz_modes(range(R), t)
+        eids = emasks = ebyz = None
+        if self.hierarchy:
+            # whole-step edge plan up front: kills/re-homes land between
+            # scanned rounds exactly as they would on the per-round path
+            eids, emasks, ebyz = self._edge_state(t, range(R))
         byz_stale = self.byzantine is not None and self.byzantine.has_stale
         # The fused program DONATES its params input (HBM economy), so the
         # divergence rollback target must live on host: a numpy snapshot of
@@ -850,9 +961,13 @@ class Experiment:
                     tw, sw, fm, lr_scale, R, freq, jnp.int32(t_idx),
                     None if cms is None else jnp.asarray(cms),
                     None if bms is None else jnp.asarray(bms),
+                    None if eids is None else jnp.asarray(eids),
+                    None if emasks is None else jnp.asarray(emasks),
+                    None if ebyz is None else jnp.asarray(ebyz),
                     byz_stale=byz_stale, with_agg_stats=True)
-            if self._robust_active:
-                # one bulk [R, M, 3] fetch -> one event per fused round
+            if self._robust_active or self.hierarchy:
+                # one bulk [R, M, 3] (hierarchy: [R, 1+E, M, 3]) fetch
+                # -> one event per fused round
                 for rr, row in enumerate(np.asarray(
                         multihost.fetch(agg_stats))):
                     self._emit_robust_stats(row, g0 + rr)

@@ -27,6 +27,11 @@
 #    rounds, 20% injected stragglers and join/leave churn completes,
 #    masks stragglers out of the aggregation (straggler_masked in
 #    events.jsonl) and renders the `report` participation section.
+# 7) hierarchy domain — a 10^3-population two-tier run (3 edge groups,
+#    per-tier trimmed_mean, int8 wire codec) loses an entire edge mid-run;
+#    asserts the run completes, the dead edge's clients are re-homed
+#    (edge_failed reason=killed then edge_rehomed in events.jsonl), no
+#    accuracy NaN, and `report` renders the hierarchy section.
 #
 # Usage: scripts/chaos_smoke.sh            (~2-3 min on one CPU core)
 set -euo pipefail
@@ -37,12 +42,12 @@ OUT=$(mktemp -d)
 trap 'rm -rf "$OUT"' EXIT
 RUN="$OUT/run"
 
-echo "== [1/6] chaos transport e2e (drop_prob=0.2 + broker kill/restart) =="
+echo "== [1/7] chaos transport e2e (drop_prob=0.2 + broker kill/restart) =="
 timeout -k 10 300 python -m pytest tests/test_resilience.py -q \
     -p no:cacheprovider -p no:randomly \
     -k "ChaosEndToEnd or survives_broker_kill or heartbeat_missed"
 
-echo "== [2/6] preemption: SIGTERM a real run, then --auto_resume =="
+echo "== [2/7] preemption: SIGTERM a real run, then --auto_resume =="
 ARGS=(--dataset sine --model fnn --concept_drift_algo win-1
       --concept_num 2 --client_num_in_total 4 --client_num_per_round 4
       --train_iterations 6 --comm_round 8 --epochs 2
@@ -79,15 +84,15 @@ print(f"resume OK: {len(rows)} metric rows, final Test/Acc="
       f"{rows[-1]['Test/Acc']:.4f}")
 EOF
 
-echo "== [3/6] event taxonomy consistency (strict: no dead kinds) =="
+echo "== [3/7] event taxonomy consistency (strict: no dead kinds) =="
 python scripts/check_events_schema.py --strict
 
-echo "== [4/6] byzantine smoke: trimmed_mean defends where mean fails =="
+echo "== [4/7] byzantine smoke: trimmed_mean defends where mean fails =="
 timeout -k 10 300 python -m pytest tests/test_robust_agg.py -q \
     -p no:cacheprovider -p no:randomly \
     -k "trimmed_mean_defends_where_mean_fails"
 
-echo "== [5/6] decision observability: kill clients -> alerts + lineage =="
+echo "== [5/7] decision observability: kill clients -> alerts + lineage =="
 LRUN="$OUT/lineage-run"
 timeout -k 10 300 python - "$LRUN" <<'EOF'
 import sys
@@ -121,7 +126,7 @@ python -m feddrift_tpu report "$LRUN" > "$OUT/report.txt"
 grep -q "alerts:" "$OUT/report.txt" \
     || { echo "report missing alerts section"; exit 1; }
 
-echo "== [6/6] participation: 10^3 population, 20% stragglers + churn =="
+echo "== [6/7] participation: 10^3 population, 20% stragglers + churn =="
 PRUN="$OUT/population-run"
 timeout -k 10 300 python -m feddrift_tpu run \
     --dataset sea --model fnn --concept_drift_algo softcluster \
@@ -139,5 +144,43 @@ grep -q straggler_masked "$PRUN/events.jsonl" \
 python -m feddrift_tpu report "$PRUN" > "$OUT/preport.txt"
 grep -q "participation:" "$OUT/preport.txt" \
     || { echo "report missing participation section"; exit 1; }
+
+echo "== [7/7] hierarchy: 10^3 population, kill edge 0 mid-run =="
+HRUN="$OUT/hierarchy-run"
+timeout -k 10 300 python -m feddrift_tpu run \
+    --dataset sea --model fnn --concept_drift_algo softcluster \
+    --concept_drift_algo_arg H_A_C_1_10_0 --concept_num 4 \
+    --population_size 1000 --cohort_size 10 --cohort_overprovision 2 \
+    --hierarchy_edges 3 --edge_robust_agg trimmed_mean \
+    --server_robust_agg trimmed_mean --compress_codec int8 \
+    --edge_kill_round 3 --edge_kill_edge 0 \
+    --train_iterations 4 --comm_round 6 --epochs 2 --sample_num 40 \
+    --batch_size 20 --frequency_of_the_test 3 --report_client 0 \
+    --checkpoint_every_iteration false --flat_out_dir --out_dir "$HRUN"
+python - "$HRUN" <<'EOF'
+import json, sys
+run = sys.argv[1]
+evs = [json.loads(l) for l in open(f"{run}/events.jsonl")]
+failed = [e for e in evs if e["kind"] == "edge_failed"
+          and e.get("reason") == "killed"]
+assert failed, "missing edge_failed(reason=killed) event"
+rehomed = [e for e in evs if e["kind"] == "edge_rehomed"]
+assert rehomed, "missing edge_rehomed event"
+assert rehomed[0].get("clients"), "edge_rehomed carries no clients"
+aggs = [e for e in evs if e["kind"] == "edge_aggregated"]
+assert aggs, "missing edge_aggregated events"
+rows = [json.loads(l) for l in open(f"{run}/metrics.jsonl")]
+import math
+assert rows and all(math.isfinite(r["Test/Acc"]) for r in rows), \
+    "non-finite accuracy after edge loss"
+print(f"edge failover OK: {len(failed)} killed, "
+      f"{len(rehomed[0]['clients'])} clients re-homed, "
+      f"final Test/Acc={rows[-1]['Test/Acc']:.4f}")
+EOF
+python -m feddrift_tpu report "$HRUN" > "$OUT/hreport.txt"
+grep -q "hierarchy:" "$OUT/hreport.txt" \
+    || { echo "report missing hierarchy section"; exit 1; }
+grep -q "re-homed:" "$OUT/hreport.txt" \
+    || { echo "report missing re-homed line"; exit 1; }
 
 echo "chaos_smoke: ALL OK"
