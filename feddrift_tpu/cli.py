@@ -19,6 +19,7 @@ checkpoint.
     python -m feddrift_tpu report runs/my-run --follow  # live tail + alerts
     python -m feddrift_tpu lineage runs/my-run  # cluster genealogy + oracle ARI
     python -m feddrift_tpu regress bench_new.json --baseline BENCH_r05.json
+    python -m feddrift_tpu critical_path runs/my-run  # round segment breakdown
 
 Logging is configured in exactly one place (obs.setup_logging), driven by
 the ``--log_level`` flag every subcommand accepts.
@@ -156,12 +157,21 @@ def main(argv: list[str] | None = None) -> int:
     reg_p.add_argument("--tol-wall", type=float, default=None)
     reg_p.add_argument("--tol-acc", type=float, default=None)
     reg_p.add_argument("--tol-compiles", type=float, default=None)
+    reg_p.add_argument("--tol-host-overhead", type=float, default=None)
     reg_p.add_argument("--json", action="store_true")
+
+    cp_p = sub.add_parser(
+        "critical_path",
+        help="per-round segment breakdown + dominant-segment / straggler "
+             "attribution from a run dir's spans.jsonl + events.jsonl "
+             "(obs/critical_path.py)")
+    cp_p.add_argument("run_dir")
+    cp_p.add_argument("--json", action="store_true")
 
     # --log_level is also accepted after the subcommand for convenience
     # (SUPPRESS default: an absent post-subcommand flag must not clobber a
     # pre-subcommand one — both write the same namespace attribute)
-    for p in (run_p, res_p, rep_p, reg_p, lin_p):
+    for p in (run_p, res_p, rep_p, reg_p, lin_p, cp_p):
         p.add_argument("--log_level", type=str, default=argparse.SUPPRESS,
                        help=argparse.SUPPRESS)
 
@@ -192,13 +202,19 @@ def main(argv: list[str] | None = None) -> int:
         # pure host-side: no jax / backend initialisation needed
         from feddrift_tpu.obs.regress import main as regress_main
         argv_r = [args.candidate, "--baseline", args.baseline]
-        for flag in ("tol_rounds", "tol_wall", "tol_acc", "tol_compiles"):
+        for flag in ("tol_rounds", "tol_wall", "tol_acc", "tol_compiles",
+                     "tol_host_overhead"):
             v = getattr(args, flag)
             if v is not None:
                 argv_r += [f"--{flag.replace('_', '-')}", str(v)]
         if args.json:
             argv_r.append("--json")
         return regress_main(argv_r)
+
+    if args.cmd == "critical_path":
+        # pure host-side: no jax / backend initialisation needed
+        from feddrift_tpu.obs.critical_path import main as cp_main
+        return cp_main([args.run_dir] + (["--json"] if args.json else []))
 
     if getattr(args, "platform", ""):
         import jax

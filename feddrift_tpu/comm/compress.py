@@ -249,16 +249,39 @@ class UpdateSender:
         self.offer()
         return self.wait_accept(timeout)
 
-    def send(self, name: str, arr: np.ndarray) -> dict:
-        """Encode + publish one array; returns the frame sent."""
+    def send(self, name: str, arr: np.ndarray, trace=None) -> dict:
+        """Encode + publish one array; returns the frame sent.
+
+        ``trace`` (optional causal context, ``obs.spans``) is continued:
+        the frame carries this hop's own context (digest-safe — the
+        digest covers only the payload keys) so the receiver can link its
+        ``recv_update`` span back to this ``send_update`` span. With no
+        inbound context a new root trace is started whenever span
+        recording is armed, so every update is followable by default in
+        an instrumented run.
+        """
         arr = np.asarray(arr, np.float32)
         self._fid += 1
         fid = self._fid
+        tctx = None
+        if trace is not None:
+            tctx = obs.spans.child_of(trace)
+        elif obs.spans.get_recorder().enabled:
+            tctx = obs.spans.new_trace()
+        t0, p0 = time.time(), time.perf_counter()
         frame = encode_frame(arr, self.codec, name=name, fid=fid,
                              topk_frac=self.topk_frac,
                              prev=self._prev.get(name))
+        if tctx is not None:
+            frame["trace"] = tctx
         wire = json.dumps(frame)
-        self.client.publish(self.topic, wire)
+        if tctx is not None:
+            self.client.publish(self.topic, wire, trace=tctx)
+            obs.spans.record("send_update", t0, time.perf_counter() - p0,
+                             cat="comm", topic=self.topic, update=name,
+                             codec=self.codec, **tctx)
+        else:
+            self.client.publish(self.topic, wire)
         if self.codec == "delta":
             self._prev[name] = decode_frame(frame, prev=self._prev.get(name))
         if self.codec != "none":
@@ -307,6 +330,10 @@ class UpdateReceiver:
         self._q = client.subscribe(topic)
         self._ctl = client.subscribe(_ctl_rx(topic))
         self._prev: dict[str, np.ndarray] = {}     # delta reconstruction
+        # causal context of the last successful recv (this hop's OWN
+        # context, parent-linked to the sender's): a relay forwards it so
+        # the chain stays connected client -> edge -> server
+        self.last_trace: Optional[dict] = None
 
     def serve_ctl(self, timeout: float = 0.0) -> Optional[str]:
         """Answer pending offers; returns the last accepted codec."""
@@ -329,6 +356,7 @@ class UpdateReceiver:
             wire = self._q.get(timeout=timeout)
         except queue.Empty:
             return None
+        t0, p0 = time.time(), time.perf_counter()
         try:
             frame = json.loads(wire)
             name = str(frame.get("name", "update"))
@@ -342,6 +370,13 @@ class UpdateReceiver:
                                 json.dumps({"t": "nack", "fid": int(fid)}))
             return None
         self._prev[name] = arr
+        fctx = frame.get("trace")
+        if isinstance(fctx, dict):
+            tctx = obs.spans.child_of(fctx)
+            self.last_trace = tctx
+            obs.spans.record("recv_update", t0, time.perf_counter() - p0,
+                             cat="comm", topic=self.topic, update=name,
+                             **tctx)
         return name, arr
 
 

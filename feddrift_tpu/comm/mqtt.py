@@ -318,7 +318,9 @@ class MqttBrokerClient:
                 self._send(subscribe_packet(self._next_pid(), topic))
         return q
 
-    def publish(self, topic: str, payload: str) -> None:
+    def publish(self, topic: str, payload: str, trace=None) -> None:
+        # ``trace`` accepted for Broker-interface parity; MQTT 3.1.1 has
+        # no frame metadata to carry it, the context rides the payload.
         self._send(publish_packet(topic, payload.encode("utf-8")))
 
     def unsubscribe(self, topic: str, q: queue.Queue) -> None:

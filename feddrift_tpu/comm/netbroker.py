@@ -37,9 +37,11 @@ import queue
 import struct
 import socket
 import threading
+import time
 from collections import defaultdict
 
 from feddrift_tpu import obs
+from feddrift_tpu.obs import spans as obs_spans
 
 
 class TcpFanoutServer:
@@ -233,17 +235,19 @@ class NetworkBroker(TcpFanoutServer):
                     t = threading.Timer(
                         delay, self._route_and_ack,
                         (conn, topic, d.get("payload", ""),
-                         d.get("seq"), copies))
+                         d.get("seq"), copies, d.get("trace")))
                     t.daemon = True
                     t.start()
                     continue
                 self._route_and_ack(conn, topic, d.get("payload", ""),
-                                    d.get("seq"), copies)
+                                    d.get("seq"), copies, d.get("trace"))
 
     def _route_and_ack(self, conn: socket.socket, topic: str, payload: str,
-                       seq, copies: int = 1) -> None:
-        frame = (json.dumps({"topic": topic, "payload": payload})
-                 + "\n").encode()
+                       seq, copies: int = 1, trace=None) -> None:
+        routed = {"topic": topic, "payload": payload}
+        if trace is not None:               # trace context rides every hop
+            routed["trace"] = trace
+        frame = (json.dumps(routed) + "\n").encode()
         with self._lock:
             targets = list(self._subs.get(topic, ()))
         for _ in range(copies):
@@ -319,6 +323,13 @@ class NetworkBrokerClient:
                     qs = list(self._queues.get(d.get("topic"), ()))
                 for q in qs:
                     q.put(d.get("payload", ""))
+                tctx = d.get("trace")
+                if qs and isinstance(tctx, dict):
+                    # continue the frame's causal chain onto this
+                    # process's span lane (no-op unless spans are armed)
+                    obs_spans.record("broker_deliver", time.time(), 0.0,
+                                     cat="comm", topic=d.get("topic"),
+                                     **obs_spans.child_of(tctx))
         except (OSError, ValueError):
             pass                            # socket closed
         finally:
@@ -342,17 +353,33 @@ class NetworkBrokerClient:
                 self._send({"op": "sub", "topic": topic})
         return q
 
-    def publish(self, topic: str, payload: str) -> int:
-        """Acked publish; returns the sequence number being tracked."""
+    def publish(self, topic: str, payload: str, trace=None) -> int:
+        """Acked publish; returns the sequence number being tracked.
+
+        ``trace`` (optional dict from ``obs.spans.new_trace``/``child_of``)
+        rides the pub frame to the broker and on to every subscriber, and
+        this hop records its own ``broker_publish`` span continuing it —
+        the wire link of the client->edge->server causal chain.
+        """
         with self._qlock:
             self._seq += 1
             seq = self._seq
             self._pending[seq] = (topic, payload)
             while len(self._pending) > self.PENDING_MAX:
                 self._pending.pop(next(iter(self._pending)))
+        frame = {"op": "pub", "topic": topic, "payload": payload, "seq": seq}
+        if trace is not None:
+            tctx = obs_spans.child_of(trace)
+            frame["trace"] = tctx
+            t0, p0 = time.time(), time.perf_counter()
+            # keep the pending entry on OSError: a retry layer resends it
+            self._send(frame)
+            obs_spans.record("broker_publish", t0,
+                             time.perf_counter() - p0, cat="comm",
+                             topic=topic, **tctx)
+            return seq
         try:
-            self._send({"op": "pub", "topic": topic,
-                        "payload": payload, "seq": seq})
+            self._send(frame)
         except OSError:
             # keep the pending entry: a retry layer resends it on reconnect
             raise

@@ -62,7 +62,10 @@ class Broker:
             self._subs[topic].append(q)
         return q
 
-    def publish(self, topic: str, payload: str) -> None:
+    def publish(self, topic: str, payload: str, trace=None) -> None:
+        # ``trace`` is accepted for interface parity with the network
+        # clients (comm/netbroker.py): in-process delivery has no wire
+        # hop worth a span, the context already rides the payload.
         # puts happen under the lock (queue.Queue is unbounded, so this
         # can't block): otherwise a concurrent unsubscribe could deregister
         # a queue between the snapshot and the put, losing the message into

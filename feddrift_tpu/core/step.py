@@ -48,6 +48,7 @@ the POPSCALE regress axis gate.
 
 from __future__ import annotations
 
+import time
 from dataclasses import dataclass, field
 from functools import partial
 from typing import Callable
@@ -379,11 +380,17 @@ class TrainStep:
              lr_scale, client_mask, byz_modes, stale_params, edge_ids,
              edge_mask, edge_modes, codec_prev),
             {"keep_client_params": keep_client_params})
+        t0w, p0 = time.time(), time.perf_counter()
         out = self._train_round_jit(
             params, opt_states, key, x, y, time_w, sample_w, feat_mask,
             lr_scale, client_mask, byz_modes, stale_params, edge_ids,
             edge_mask, edge_modes, codec_prev,
             keep_client_params=keep_client_params)
+        if kind is not None:
+            # first dispatch of a signature traces+compiles synchronously:
+            # its duration is the compile cost, worth its own trace slice
+            obs.spans.record("jit_compile", t0w, time.perf_counter() - p0,
+                             cat="round", fn="train_round", event=kind)
         return out if with_agg_stats else out[:5]
 
     @partial(jax.jit, static_argnums=0,
@@ -450,10 +457,15 @@ class TrainStep:
              feat_mask, lr_scale, R, freq, t, client_masks, byz_modes,
              edge_ids, edge_masks, edge_byz),
             {"byz_stale": byz_stale})
+        t0w, p0 = time.time(), time.perf_counter()
         out = self._train_iteration_eval_jit(
             params, opt_states, iter_key, x, y, time_w, sample_w, feat_mask,
             lr_scale, R, freq, t, client_masks, byz_modes, edge_ids,
             edge_masks, edge_byz, byz_stale=byz_stale)
+        if kind is not None:
+            obs.spans.record("jit_compile", t0w, time.perf_counter() - p0,
+                             cat="round", fn="train_iteration_eval",
+                             event=kind)
         return out if with_agg_stats else out[:6]
 
     @partial(jax.jit, static_argnums=(0, 10, 11), donate_argnums=(1, 2),

@@ -201,7 +201,10 @@ class AlertMonitor:
         self.state: dict[str, Any] = {}       # rule scratch (best_ari, ...)
         self.alerts: list[dict] = []          # every raised record
         self.iteration = 0
-        self._lock = threading.Lock()
+        # re-entrant: _raise (lock held) emits alert_raised through the
+        # bus, and if that write trips the size-cap rotation the bus taps
+        # this same thread with the obs_rotated record -> observe again
+        self._lock = threading.RLock()
         self._last_fired: dict[str, int] = {}
         tracked = set(CHURN_KINDS) | {"byzantine_injected"}
         for r in self.rules:
@@ -244,8 +247,10 @@ class AlertMonitor:
 
     def _raise(self, rule: Rule, payload: dict) -> None:
         # lock already held; bus emission happens with OUR lock held but
-        # the bus lock free (taps run unlocked), and observe() drops
-        # alert_raised before taking the lock, so no re-entry.
+        # the bus lock free (taps run unlocked). observe() drops
+        # alert_raised before taking the lock, and the one genuine
+        # re-entry — a size-cap rotation tripped by the alert_raised
+        # write taps us back with obs_rotated — is safe on the RLock.
         self._last_fired[rule.name] = self.iteration
         fields = {"rule": rule.name, "severity": rule.severity, **payload}
         if self.bus is not None:

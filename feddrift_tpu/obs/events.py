@@ -99,21 +99,32 @@ EVENT_KINDS = frozenset({
     "edge_rehomed",         # dead edge's clients re-homed to survivors
     "update_compressed",    # one update frame sent through a lossy codec
     "compress_corrupt",     # frame failed digest verification; nacked
+    # causal tracing / round critical path (simulation/runner.py,
+    # obs/events.py + obs/spans.py rotation)
+    "round_breakdown",      # per-iteration segment split + dispatch gap
+    "obs_rotated",          # a size-capped JSONL sink rotated a generation
 })
 
 RING_SIZE = 4096
 
 
 class EventBus:
-    """Appends typed events to an optional JSONL sink + an in-memory ring."""
+    """Appends typed events to an optional JSONL sink + an in-memory ring.
 
-    def __init__(self, path: str | None = None) -> None:
+    ``max_bytes`` (0 = unbounded, the default) size-caps the sink: a
+    write past the cap rotates the file to ``<path>.1`` (one generation
+    kept) and emits a loud ``obs_rotated`` event into the fresh file.
+    """
+
+    def __init__(self, path: str | None = None, max_bytes: int = 0) -> None:
         self._lock = threading.Lock()
         self._context: dict[str, Any] = {}
         self.ring: collections.deque = collections.deque(maxlen=RING_SIZE)
         self._taps: list = []
         self._fh = None
         self.path = path
+        self.max_bytes = int(max_bytes)
+        self.rotations = 0
         if path:
             os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
             self._fh = open(path, "a")
@@ -125,13 +136,22 @@ class EventBus:
             raise ValueError(
                 f"unknown event kind {kind!r}; add it to obs.events.EVENT_KINDS "
                 "and document it in docs/OBSERVABILITY.md")
+        rotated_bytes = 0
         with self._lock:
             rec = {"_ts": time.time(), "kind": kind, **self._context, **fields}
             self.ring.append(rec)
             if self._fh is not None:
                 self._fh.write(json.dumps(rec, default=_json_default) + "\n")
                 self._fh.flush()
+                if self.max_bytes and self._fh.tell() >= self.max_bytes:
+                    rotated_bytes = self._rotate_locked()
             taps = tuple(self._taps)
+        if rotated_bytes:
+            # re-entrant emit AFTER the (non-reentrant) lock is released;
+            # the fresh file is far below the cap, so this cannot recurse
+            self.emit("obs_rotated", file=os.path.basename(self.path),
+                      rotated_bytes=rotated_bytes,
+                      generation=self.rotations)
         # Taps (the live alert monitor) run AFTER the bus lock is
         # released: a tap may legally re-enter emit() (alert_raised), and
         # a slow tap must not serialize hot-path emitters. A failing tap
@@ -142,6 +162,19 @@ class EventBus:
             except Exception:   # noqa: BLE001 — observability stays passive
                 pass
         return rec
+
+    def _rotate_locked(self) -> int:
+        """Swap the sink to a fresh file (caller holds the lock); returns
+        the size of the rotated-out generation."""
+        size = self._fh.tell()
+        self._fh.close()
+        try:
+            os.replace(self.path, self.path + ".1")
+        except OSError:
+            pass
+        self._fh = open(self.path, "a")
+        self.rotations += 1
+        return size
 
     def add_tap(self, fn) -> None:
         """Register a callable observing every emitted record (called on
@@ -204,14 +237,14 @@ def get_bus() -> EventBus:
     return _bus
 
 
-def configure(path: str | None) -> EventBus:
+def configure(path: str | None, max_bytes: int = 0) -> EventBus:
     """Install a fresh default bus writing to ``path`` (None = memory-only).
 
     Closes the previous bus's sink. Returns the new bus.
     """
     global _bus
     with _bus_lock:
-        old, _bus = _bus, EventBus(path)
+        old, _bus = _bus, EventBus(path, max_bytes=max_bytes)
         old.close()
     return _bus
 

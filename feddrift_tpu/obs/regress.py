@@ -36,6 +36,7 @@ DEFAULT_TOL = {
     "acc": 0.02,         # fail if final_test_acc < baseline - tol
     "compiles": 0.0,     # fail if steady-state compiles > baseline + tol
     "bytes": 0.25,       # fail if bytes_per_round > baseline * (1 + tol)
+    "host_overhead": 0.10,   # fail if host_overhead_frac > baseline + tol
 }
 
 
@@ -86,6 +87,7 @@ def extract_metrics(bench: dict) -> dict[str, float | None]:
         "final_test_acc": bench.get("final_test_acc"),
         "jit_compiles": comp,
         "jit_recompiles": rec,
+        "host_overhead_frac": bench.get("host_overhead_frac"),
     }
 
 
@@ -139,6 +141,20 @@ def compare(candidate: dict, baseline: dict,
         rows.append(row("final_test_acc", b["final_test_acc"],
                         c["final_test_acc"], f">= {floor:.4f}",
                         c["final_test_acc"] < floor))
+
+    # host-overhead ceiling: lower is better, absolute tolerance (a
+    # fraction in [0, 1] — relative deltas would blow up near zero).
+    # Gates the critical-path attribution loop: work moved off the
+    # device (slower dispatch, host-side stalls) raises this before it
+    # shows up in wall clock on a fast accelerator.
+    if (b["host_overhead_frac"] is None
+            or c["host_overhead_frac"] is None):
+        skip("host_overhead_frac", "missing from one side")
+    else:
+        ceil = b["host_overhead_frac"] + tol["host_overhead"]
+        rows.append(row("host_overhead_frac", b["host_overhead_frac"],
+                        c["host_overhead_frac"], f"<= {ceil:.4f}",
+                        c["host_overhead_frac"] > ceil))
 
     # steady-state compile counts: lower is better, absolute tolerance
     for metric in ("jit_compiles", "jit_recompiles"):
@@ -261,6 +277,10 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--tol-bytes", type=float, default=DEFAULT_TOL["bytes"],
                     help="relative wire bytes/round growth tolerated "
                          "(default %(default)s)")
+    ap.add_argument("--tol-host-overhead", type=float,
+                    default=DEFAULT_TOL["host_overhead"],
+                    help="absolute host_overhead_frac growth tolerated "
+                         "(default %(default)s)")
     ap.add_argument("--json", action="store_true", help="machine-readable")
     args = ap.parse_args(argv)
 
@@ -274,7 +294,8 @@ def main(argv: list[str] | None = None) -> int:
     rows = compare(candidate, baseline,
                    tol={"rounds": args.tol_rounds, "wall": args.tol_wall,
                         "acc": args.tol_acc, "compiles": args.tol_compiles,
-                        "bytes": args.tol_bytes})
+                        "bytes": args.tol_bytes,
+                        "host_overhead": args.tol_host_overhead})
     regressed = any(r["status"] == "regress" for r in rows)
     if args.json:
         print(json.dumps({"regressed": regressed, "rows": rows,

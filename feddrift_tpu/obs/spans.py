@@ -28,7 +28,8 @@ import json
 import os
 import threading
 import time
-from typing import Any, Iterator
+import uuid
+from typing import Any, Callable, Iterator
 
 import contextlib
 
@@ -38,16 +39,52 @@ RING_SIZE = 8192
 EVENTS_LANE_TID = 0
 
 
+# ----------------------------------------------------------------------
+# Trace context: the W3C-style (trace_id, span_id, parent_span_id) triple
+# that rides broker frames so one client update is followable
+# client -> compress -> wire -> edge -> server across process lanes.
+# A context is a plain JSON dict; every hop that *receives* one records
+# its own span as a child (``child_of``) and forwards its OWN context, so
+# the chain is parent-linked end to end and ``build_trace`` can emit
+# Perfetto flow arrows between the slices.
+
+def new_trace() -> dict:
+    """Root context for a fresh causal chain."""
+    return {"trace_id": uuid.uuid4().hex[:16],
+            "span_id": uuid.uuid4().hex[:16]}
+
+
+def child_of(ctx: dict | None) -> dict:
+    """Continue a received context: same trace, new span, parent linked.
+    A None/malformed context starts a new root (never raises — tracing
+    stays passive)."""
+    if not isinstance(ctx, dict) or "trace_id" not in ctx:
+        return new_trace()
+    out = {"trace_id": str(ctx["trace_id"]),
+           "span_id": uuid.uuid4().hex[:16]}
+    if ctx.get("span_id"):
+        out["parent_span_id"] = str(ctx["span_id"])
+    return out
+
+
 class SpanRecorder:
-    """Thread-safe span sink: in-memory ring + optional JSONL file."""
+    """Thread-safe span sink: in-memory ring + optional JSONL file.
+
+    ``max_bytes`` (0 = unbounded, the default) caps the JSONL sink:
+    when a write pushes the file past the cap it is rotated to
+    ``<path>.1`` (one generation kept) and a loud ``obs_rotated`` event
+    marks the boundary, so 10^5-round runs cannot fill the disk.
+    """
 
     def __init__(self, path: str | None = None, pid: int = 0,
-                 enabled: bool = True) -> None:
+                 enabled: bool = True, max_bytes: int = 0) -> None:
         self._lock = threading.Lock()
         self.ring: collections.deque = collections.deque(maxlen=RING_SIZE)
         self.pid = pid
         self.enabled = enabled
         self.path = path
+        self.max_bytes = int(max_bytes)
+        self.rotations = 0
         self._fh = None
         if path:
             os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
@@ -64,18 +101,52 @@ class SpanRecorder:
                "pid": self.pid, "tid": threading.get_ident()}
         if args:
             rec["args"] = args
+        rotated_bytes = 0
         with self._lock:
             self.ring.append(rec)
             if self._fh is not None:
                 self._fh.write(json.dumps(rec) + "\n")
                 self._fh.flush()
+                if self.max_bytes and self._fh.tell() >= self.max_bytes:
+                    rotated_bytes = self._rotate_locked()
+        if rotated_bytes:
+            # the bus lock is unrelated to ours, but emit outside our own
+            # lock anyway: an event tap may legally record a span
+            from feddrift_tpu.obs import events as _events
+            try:
+                _events.emit("obs_rotated", file=os.path.basename(self.path),
+                             rotated_bytes=rotated_bytes,
+                             generation=self.rotations)
+            except Exception:   # noqa: BLE001 — observability stays passive
+                pass
         return rec
+
+    def _rotate_locked(self) -> int:
+        """Swap the sink to a fresh file (caller holds the lock); returns
+        the size of the rotated-out generation."""
+        size = self._fh.tell()
+        self._fh.close()
+        try:
+            os.replace(self.path, self.path + ".1")
+        except OSError:
+            pass
+        self._fh = open(self.path, "a")
+        self.rotations += 1
+        return size
 
     @contextlib.contextmanager
     def span(self, name: str, cat: str = "phase",
+             on_close: Callable[[float, float], None] | None = None,
              **args: Any) -> Iterator[None]:
-        """Context manager recording the enclosed interval."""
-        if not self.enabled:
+        """Context manager recording the enclosed interval.
+
+        ``on_close(wall_start_s, duration_s)`` fires after the span is
+        recorded — the single timing code path PhaseTracer and other
+        accumulators hang their accounting on. The interval is measured
+        whenever an ``on_close`` is given, even on a disabled recorder
+        (the caller's accounting must not depend on sink state).
+        """
+        if not self.enabled and on_close is None:
             yield
             return
         t0 = time.time()
@@ -83,7 +154,10 @@ class SpanRecorder:
         try:
             yield
         finally:
-            self.record(name, t0, time.perf_counter() - p0, cat, **args)
+            dt = time.perf_counter() - p0
+            self.record(name, t0, dt, cat, **args)
+            if on_close is not None:
+                on_close(t0, dt)
 
     def spans(self, name: str | None = None) -> list[dict]:
         with self._lock:
@@ -115,12 +189,14 @@ def get_recorder() -> SpanRecorder:
     return _recorder
 
 
-def configure(path: str | None, pid: int = 0) -> SpanRecorder:
+def configure(path: str | None, pid: int = 0,
+              max_bytes: int = 0) -> SpanRecorder:
     """Install a fresh default recorder writing to ``path`` (None =
     memory-only, still enabled). Closes the previous recorder's sink."""
     global _recorder
     with _rec_lock:
-        old, _recorder = _recorder, SpanRecorder(path, pid=pid)
+        old, _recorder = _recorder, SpanRecorder(path, pid=pid,
+                                                 max_bytes=max_bytes)
         old.close()
     return _recorder
 
@@ -160,9 +236,22 @@ def build_trace(run_dir: str) -> dict:
     process). Output invariants, tested in tests/test_obs_perf.py: every
     event has name/ph/ts/pid/tid, durations are non-negative, the list is
     sorted by ts, and each (pid, tid) lane carries metadata naming it.
+
+    Spans carrying trace-context args (``span_id`` + ``parent_span_id``,
+    see ``new_trace``/``child_of``) additionally get Perfetto **flow
+    arrows** (``ph: "s"``/``"f"`` pairs sharing an id) from each parent
+    slice to its child slice — the rendering of one update's causal chain
+    across pid lanes. A run with no trace contexts emits no flow events.
     """
     spans = _load_jsonl(os.path.join(run_dir, "spans.jsonl"))
     events = _load_jsonl(os.path.join(run_dir, "events.jsonl"))
+    # rotated-out generations still belong to the timeline
+    for fname in ("spans.jsonl.1", "events.jsonl.1"):
+        extra = _load_jsonl(os.path.join(run_dir, fname))
+        if fname.startswith("spans"):
+            spans = extra + spans
+        else:
+            events = extra + events
 
     trace: list[dict] = []
     # (pid, raw tid) -> compact per-process tid; tid 0 = events lane
@@ -185,6 +274,28 @@ def build_trace(run_dir: str) -> dict:
         if s.get("args"):
             ev["args"] = s["args"]
         trace.append(ev)
+
+    # Perfetto flow arrows between trace-context-linked spans: "s" bound
+    # to the parent slice, "f" (bp "e": bind to enclosing slice) to the
+    # child. Flow pairs are matched by (cat, id); ids are sequential —
+    # each parent->child edge is its own arrow.
+    by_span_id = {ev["args"]["span_id"]: ev for ev in trace
+                  if "args" in ev and ev["args"].get("span_id")}
+    flow_id = 0
+    flows: list[dict] = []
+    for ev in trace:
+        parent_id = ev.get("args", {}).get("parent_span_id")
+        parent = by_span_id.get(parent_id) if parent_id else None
+        if parent is None or parent is ev:
+            continue
+        flow_id += 1
+        flows.append({"name": "trace", "cat": "trace", "ph": "s",
+                      "id": flow_id, "ts": parent["ts"],
+                      "pid": parent["pid"], "tid": parent["tid"]})
+        flows.append({"name": "trace", "cat": "trace", "ph": "f", "bp": "e",
+                      "id": flow_id, "ts": max(ev["ts"], parent["ts"]),
+                      "pid": ev["pid"], "tid": ev["tid"]})
+    trace.extend(flows)
 
     for e in events:
         if "_ts" not in e or "kind" not in e:

@@ -217,3 +217,44 @@ class EdgeMap:
                              targets=[int(ids[s]) for s in slots])
         self.ids = ids
         return moved
+
+
+class EdgeRelay:
+    """Host-side edge aggregator over the *wire* path.
+
+    Where real edge processes exist (broker-based deployments, the
+    hierarchy smokes), each edge runs one of these: client update frames
+    arrive on the edge's downlink topic (``comm.compress.UpdateReceiver``),
+    are averaged, and ONE edge summary is forwarded on the uplink topic
+    (``UpdateSender``) with the causal context continued from the first
+    received update — so a client update is followable
+    client → edge → server by trace-context parent links (``report
+    --trace`` renders them as Perfetto flow arrows). The in-program tier
+    (``two_tier_aggregate``) is untouched; this is its wire rendering.
+    """
+
+    def __init__(self, down, up, edge_id: int = 0) -> None:
+        self.down = down        # UpdateReceiver on the client->edge topic
+        self.up = up            # UpdateSender on the edge->server topic
+        self.edge_id = int(edge_id)
+
+    def relay_round(self, n_updates: int, timeout: float = 5.0,
+                    name: str = "edge_summary"):
+        """Collect up to ``n_updates`` client updates, mean them, forward
+        the summary upstream. Returns the frame sent, or None when no
+        update arrived in time (the server's deadline logic owns that)."""
+        arrs, tctx = [], None
+        for _ in range(int(n_updates)):
+            got = self.down.recv(timeout=timeout)
+            if got is None:
+                continue
+            _uname, arr = got
+            arrs.append(np.asarray(arr, np.float32))
+            if tctx is None:
+                tctx = self.down.last_trace    # first update anchors the chain
+        if not arrs:
+            return None
+        summary = np.mean(np.stack(arrs), axis=0)
+        obs.emit("edge_aggregated", edge=self.edge_id, wire=True,
+                 members=len(arrs))
+        return self.up.send(name, summary, trace=tctx)

@@ -50,15 +50,16 @@ log = logging.getLogger("feddrift_tpu")
 
 class _Pending:
     __slots__ = ("topic", "payload", "attempts", "last_send", "inner_seq",
-                 "session")
+                 "session", "trace")
 
-    def __init__(self, topic: str, payload: str) -> None:
+    def __init__(self, topic: str, payload: str, trace=None) -> None:
         self.topic = topic
         self.payload = payload
         self.attempts = 0
         self.last_send = 0.0
         self.inner_seq: Optional[int] = None
         self.session = -1          # session generation of the last send
+        self.trace = trace         # causal context; survives resends
 
 
 class ReconnectingBrokerClient:
@@ -219,13 +220,15 @@ class ReconnectingBrokerClient:
             self._resend(p)
 
     # -- publish path ---------------------------------------------------
-    def publish(self, topic: str, payload: str) -> None:
+    def publish(self, topic: str, payload: str, trace=None) -> None:
         """Never raises on a dead broker: the publish is buffered (bounded)
         and re-sent once the session heals — unlike the bare client, which
-        surfaces a raw ``OSError`` to the caller."""
+        surfaces a raw ``OSError`` to the caller. ``trace`` (a causal
+        context dict, obs.spans) rides the inner publish and survives
+        reconnect resends — trace continuity across a broker restart."""
         if self._closed:
             raise RuntimeError("publish on closed client")
-        p = _Pending(topic, payload)
+        p = _Pending(topic, payload, trace)
         with self._lock:
             self._next_id += 1
             self._pending[self._next_id] = p
@@ -242,7 +245,10 @@ class ReconnectingBrokerClient:
         if inner is None:
             return
         try:
-            seq = inner.publish(p.topic, p.payload)
+            if p.trace is not None:
+                seq = inner.publish(p.topic, p.payload, trace=p.trace)
+            else:
+                seq = inner.publish(p.topic, p.payload)
         except OSError:
             self._schedule_reconnect()
             return

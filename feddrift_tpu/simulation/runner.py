@@ -154,9 +154,11 @@ class Experiment:
         # is process-local so comm-broker threads and the fault injector
         # reach it without a handle on this object.
         import os
+        obs_cap = int(cfg.obs_max_file_mb * (1 << 20))   # 0 = unbounded
         self.events = obs.configure(
             os.path.join(out_dir, "events.jsonl")
-            if (out_dir and self.is_coordinator) else None)
+            if (out_dir and self.is_coordinator) else None,
+            max_bytes=obs_cap)
         # Span recorder: wall-clock intervals (phases, iterations, comm
         # publishes) next to the event stream; `report <run_dir> --trace`
         # folds both into one Perfetto-loadable trace.json. Every process
@@ -165,7 +167,7 @@ class Experiment:
         self.spans = obs.spans.configure(
             os.path.join(out_dir, "spans.jsonl")
             if (out_dir and self.is_coordinator) else None,
-            pid=jax.process_index())
+            pid=jax.process_index(), max_bytes=obs_cap)
         # Live health monitor (obs/alerts.py): a bus tap evaluating the
         # declarative rule set over every emitted event; fired alerts are
         # re-emitted as alert_raised AND appended to alerts.jsonl so a
@@ -271,6 +273,14 @@ class Experiment:
                             warmup=cfg.divergence_warmup_rounds)
             if cfg.divergence_guard else None)
         self.tracer = PhaseTracer(registry=obs.registry(), spans=self.spans)
+        # Round-breakdown accounting: per-iteration segment accumulator
+        # (cohort_prep / h2d / dispatch / device_compute / writeback /
+        # drift_decision / eval); whatever the segments do not cover is the
+        # dispatch gap — host time the device spent idle. Finalized into one
+        # round_breakdown event + host_overhead_frac gauge per iteration.
+        self._segs: dict[str, float] = {}
+        self._profiled_rounds = 0
+        self.last_round_breakdown: "dict | None" = None
         # The ground-truth concept matrix rides along in run_start for
         # synthetic datasets: obs/lineage.py scores the recorded
         # cluster_assign timeline against it (oracle ARI/purity) without
@@ -476,8 +486,11 @@ class Experiment:
         # masked, are stale-excluded from decisions and metrics-masked.
         idx = np.zeros(self.C_pad, dtype=np.int64)
         idx[: self.C_] = np.where(valid, members, 0)
-        self.x = shard_client_arrays(self.mesh, jnp.asarray(self._x_pop[idx]))
-        self.y = shard_client_arrays(self.mesh, jnp.asarray(self._y_pop[idx]))
+        with self._seg("h2d", iteration=t):
+            self.x = shard_client_arrays(self.mesh,
+                                         jnp.asarray(self._x_pop[idx]))
+            self.y = shard_client_arrays(self.mesh,
+                                         jnp.asarray(self._y_pop[idx]))
         self.algo.rebind_data(self.x, self.y)
         hist, arm = self.registry.cohort_view(members)
         self.algo.load_cohort_state(
@@ -540,14 +553,37 @@ class Experiment:
             self.logger.set_summary("Population", self.registry.summary())
 
     # ------------------------------------------------------------------
+    def _seg_add(self, name: str, dt: float) -> None:
+        self._segs[name] = self._segs.get(name, 0.0) + dt
+
+    def _seg(self, name: str, **args):
+        """Sub-span of the iteration (cat="round") that also accumulates
+        into the per-iteration round_breakdown segments."""
+        return self.spans.span(
+            name, cat="round",
+            on_close=lambda _w, dt, _n=name: self._seg_add(_n, dt), **args)
+
+    # ------------------------------------------------------------------
     def run_iteration(self, t: int) -> None:
         cfg = self.cfg
         t0 = time.time()
+        self._segs = {}
+        self._profiled_rounds = 0
         self.events.set_context(iteration=t, round=self.global_round)
         self.events.emit("iteration_start")
         if self.population_mode:
+            # cohort_prep accumulates EXCLUSIVE of the nested h2d staging
+            # span (_prepare_cohort) so the breakdown segments partition
+            # the wall time; the recorded span still covers the whole prep.
+            prep_w, prep_p = time.time(), time.perf_counter()
+            h2d_before = self._segs.get("h2d", 0.0)
             with self.tracer.phase("cohort"):
                 self._prepare_cohort(t)
+            prep_dt = time.perf_counter() - prep_p
+            self.spans.record("cohort_prep", prep_w, prep_dt, cat="round",
+                              iteration=t)
+            self._seg_add("cohort_prep", prep_dt
+                          - (self._segs.get("h2d", 0.0) - h2d_before))
         if self.divergence_guard is not None:
             # the time step changes the training window/concept: losses
             # legitimately re-spike, so the spike baseline starts fresh
@@ -564,7 +600,9 @@ class Experiment:
             self.algo.set_client_staleness(
                 self.failure_detector.absent_streak,
                 self.failure_detector.suspected)
-        with self.tracer.phase("cluster"):   # drift detection / clustering
+        with self.tracer.phase("cluster"), \
+                self._seg("drift_decision", iteration=t):
+            # drift detection / clustering
             self.algo.begin_iteration(t)
         if cfg.debug_checks:
             from feddrift_tpu.utils.invariants import check_round_inputs
@@ -588,12 +626,15 @@ class Experiment:
         else:
             self._run_rounds(t, opt_states)
 
-        with self.tracer.phase("cluster"):
+        with self.tracer.phase("cluster"), \
+                self._seg("drift_decision", iteration=t):
             self.algo.end_iteration(t)
         if self.population_mode:
-            self._cohort_writeback(t)
+            with self._seg("writeback", iteration=t):
+                self._cohort_writeback(t)
         if self.cfg.checkpoint_every_iteration and self.out_dir:
-            self.save_checkpoint(t)
+            with self._seg("writeback", iteration=t):
+                self.save_checkpoint(t)
             self.events.emit("checkpoint_save", path=self.ckpt_path())
         wall = time.time() - t0
         log.info("iteration %d done in %.1fs (Test/Acc=%.4f)", t,
@@ -623,6 +664,28 @@ class Experiment:
         # HBM watermark per iteration (silently a no-op on backends
         # without memory_stats — CPU).
         self.spans.record("iteration", t0, wall, cat="runner", iteration=t)
+        # Critical-path breakdown: the measured segments partition the
+        # iteration wall; the residual is the dispatch gap (host time in
+        # which no segment — and in particular no device wait — was
+        # running). host_overhead_frac = 1 - device_compute/wall is the
+        # fraction the accelerator sat idle; `critical_path <run_dir>` and
+        # the regress host-overhead ceiling both consume this event.
+        gap = max(wall - sum(self._segs.values()), 0.0)
+        dev = self._segs.get("device_compute", 0.0)
+        host_frac = min(max(1.0 - dev / max(wall, 1e-9), 0.0), 1.0)
+        segments = {k: round(v, 6) for k, v in sorted(self._segs.items())}
+        segments["dispatch_gap"] = round(gap, 6)
+        self.last_round_breakdown = {
+            "iteration": t, "wall_s": round(wall, 6),
+            "rounds": cfg.comm_round,
+            "profiled_rounds": self._profiled_rounds,
+            "segments": segments, "dispatch_gap_s": round(gap, 6),
+            "host_overhead_frac": round(host_frac, 6)}
+        self.events.emit("round_breakdown", **self.last_round_breakdown)
+        reg = obs.registry()
+        reg.gauge("host_overhead_frac").set(round(host_frac, 6))
+        reg.histogram("round_wall_seconds").observe(
+            wall / max(cfg.comm_round, 1))
         obs.costmodel.record_hbm_watermark(iteration=t)
         if self.out_dir and self.is_coordinator:
             # Prometheus textfile-collector snapshot, refreshed per
@@ -834,7 +897,10 @@ class Experiment:
             if self.hierarchy:
                 eids, emasks, ebyz = self._edge_state(t, [r])
             prev_params = self.pool.params
+            profiled = (cfg.trace_sync
+                        or self.global_round % cfg.profile_rounds == 0)
             with self.tracer.phase("train_round"):
+                disp0 = time.perf_counter()
                 (new_params, opt_states, client_params, n, losses, agg_stats,
                  codec_prev) = self.step.train_round(
                     prev_params, opt_states, round_key(self.key, t, r),
@@ -848,6 +914,21 @@ class Experiment:
                     None if ebyz is None else jnp.asarray(ebyz[0]),
                     self._codec_prev,
                     keep_client_params=keep_cp, with_agg_stats=True)
+                self._seg_add("dispatch", time.perf_counter() - disp0)
+                if profiled:
+                    # dispatch-to-ready sample (every cfg.profile_rounds-th
+                    # global round; trace_sync profiles every round): the
+                    # blocked wait IS the device-compute segment, and it
+                    # attributes device time to this phase instead of letting
+                    # async dispatch spill it into whichever phase blocks next
+                    blk_w, blk0 = time.time(), time.perf_counter()
+                    jax.block_until_ready(new_params)
+                    blk_dt = time.perf_counter() - blk0
+                    self.spans.record("device_compute", blk_w, blk_dt,
+                                      cat="round", iteration=t,
+                                      round=self.global_round)
+                    self._seg_add("device_compute", blk_dt)
+                    self._profiled_rounds += 1
                 if byz is not None and byz.has_stale:
                     self._byz_stale = client_params
                 if self.step.codec == "delta":
@@ -855,10 +936,6 @@ class Experiment:
                 if self._robust_active or self.hierarchy:
                     self._emit_robust_stats(
                         multihost.fetch(agg_stats), self.global_round)
-                if cfg.trace_sync:
-                    # attribute device time to this phase instead of letting
-                    # async dispatch spill it into whichever phase blocks next
-                    jax.block_until_ready(new_params)
                 if self._check_divergence(losses, n):
                     # rollback: pre-round params, fresh optimizer state (the
                     # diverged step contaminated both); skip after_round and
@@ -869,11 +946,15 @@ class Experiment:
                     self.divergence_guard.record_rollback()
                     self.global_round += 1
                     continue
+                wb0 = time.perf_counter()
                 self.pool.params = self.algo.after_round(
                     t, r, prev_params, new_params, client_params, n)
+                self._seg_add("writeback", time.perf_counter() - wb0)
             if r % cfg.frequency_of_the_test == 0 or r == cfg.comm_round - 1:
+                ev0 = time.perf_counter()
                 with self.tracer.phase("eval"):
                     self.evaluate(t, r)
+                self._seg_add("eval", time.perf_counter() - ev0)
             self.global_round += 1
 
     def _stream_view(self, t: int):
@@ -955,6 +1036,7 @@ class Experiment:
         host_prev = (jax.tree_util.tree_map(np.asarray, self.pool.params)
                      if self.divergence_guard is not None else None)
         with self.tracer.phase("train_round"):
+            disp0 = time.perf_counter()
             new_params, opt_states, n, losses, bufs, total, agg_stats = \
                 self.step.train_iteration_eval(
                     self.pool.params, opt_states, it_key, x, y,
@@ -965,14 +1047,23 @@ class Experiment:
                     None if emasks is None else jnp.asarray(emasks),
                     None if ebyz is None else jnp.asarray(ebyz),
                     byz_stale=byz_stale, with_agg_stats=True)
+            self._seg_add("dispatch", time.perf_counter() - disp0)
+            # One dispatch covers all R rounds, so one dispatch-to-ready
+            # sample covers them too (the stats/eval fetches below would
+            # block here anyway — this only attributes the wait).
+            blk_w, blk0 = time.time(), time.perf_counter()
+            jax.block_until_ready(new_params)
+            blk_dt = time.perf_counter() - blk0
+            self.spans.record("device_compute", blk_w, blk_dt, cat="round",
+                              iteration=t, round=g0)
+            self._seg_add("device_compute", blk_dt)
+            self._profiled_rounds += R
             if self._robust_active or self.hierarchy:
                 # one bulk [R, M, 3] (hierarchy: [R, 1+E, M, 3]) fetch
                 # -> one event per fused round
                 for rr, row in enumerate(np.asarray(
                         multihost.fetch(agg_stats))):
                     self._emit_robust_stats(row, g0 + rr)
-            if cfg.trace_sync:
-                jax.block_until_ready(new_params)
             if self._check_divergence(losses, n):
                 # fused granularity is the whole time step: restore the
                 # iteration-start params, skip after_round and the eval
@@ -982,8 +1073,11 @@ class Experiment:
                 self.divergence_guard.record_rollback()
                 self.global_round = g0 + R
                 return
+            wb0 = time.perf_counter()
             self.pool.params = self.algo.after_round(
                 t, R - 1, None, new_params, None, n)
+            self._seg_add("writeback", time.perf_counter() - wb0)
+        ev0 = time.perf_counter()
         with self.tracer.phase("eval"):
             C = self.C_
             bufs, total, n = multihost.fetch((bufs, total, n))
@@ -993,6 +1087,7 @@ class Experiment:
                 self._log_eval(t, corr_tr[slot][:, :C], loss_tr[slot][:, :C],
                                corr_te[slot][:, :C], loss_te[slot][:, :C],
                                total[:C])
+        self._seg_add("eval", time.perf_counter() - ev0)
         self.global_round = g0 + R
         # The final eval slot holds acc(final params, step t) and
         # acc(final params, step t+1) — offer both so end_iteration

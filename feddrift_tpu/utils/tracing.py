@@ -42,36 +42,44 @@ import time
 from collections import defaultdict
 from typing import Iterator
 
+from feddrift_tpu.obs.spans import SpanRecorder
+
 log = logging.getLogger("feddrift_tpu")
 
 
 class PhaseTracer:
     """Accumulates wall-clock per named phase; nestable, re-entrant, and
-    thread-safe."""
+    thread-safe.
+
+    The interval measurement itself lives in ``obs.spans.SpanRecorder``
+    (one timing code path for the whole repo): ``phase()`` is a thin shim
+    over ``SpanRecorder.span(..., on_close=...)`` that hangs the
+    total/count accounting and the ``phase_seconds`` histogram off the
+    span's completion hook. Without an explicit ``spans=`` recorder a
+    private memory-only recorder measures (accounting never depends on
+    whether a run sink is armed).
+    """
 
     def __init__(self, registry=None, spans=None) -> None:
         self.totals: dict[str, float] = defaultdict(float)
         self.counts: dict[str, int] = defaultdict(int)
         self._lock = threading.Lock()
         self._registry = registry
-        self._spans = spans
+        self._spans = spans if spans is not None \
+            else SpanRecorder(None, enabled=False)
 
     @contextlib.contextmanager
     def phase(self, name: str) -> Iterator[None]:
-        t0 = time.perf_counter()
-        wall0 = time.time()
-        try:
-            yield
-        finally:
-            dt = time.perf_counter() - t0
+        def account(_wall0: float, dt: float) -> None:
             with self._lock:
                 self.totals[name] += dt
                 self.counts[name] += 1
             if self._registry is not None:
                 self._registry.histogram("phase_seconds",
                                          phase=name).observe(dt)
-            if self._spans is not None:
-                self._spans.record(name, wall0, dt, cat="phase")
+
+        with self._spans.span(name, cat="phase", on_close=account):
+            yield
 
     def summary(self) -> dict[str, dict[str, float]]:
         with self._lock:

@@ -32,6 +32,11 @@
 #    asserts the run completes, the dead edge's clients are re-homed
 #    (edge_failed reason=killed then edge_rehomed in events.jsonl), no
 #    accuracy NaN, and `report` renders the hierarchy section.
+# 8) causal-trace continuity — client update frames published through a
+#    ReconnectingBrokerClient keep their trace context across a broker
+#    kill/restart: the resent frame carries the same trace_id, so the
+#    client -> edge -> server chain stays connected (runs the tier-1 test
+#    that encodes exactly that).
 #
 # Usage: scripts/chaos_smoke.sh            (~2-3 min on one CPU core)
 set -euo pipefail
@@ -42,12 +47,12 @@ OUT=$(mktemp -d)
 trap 'rm -rf "$OUT"' EXIT
 RUN="$OUT/run"
 
-echo "== [1/7] chaos transport e2e (drop_prob=0.2 + broker kill/restart) =="
+echo "== [1/8] chaos transport e2e (drop_prob=0.2 + broker kill/restart) =="
 timeout -k 10 300 python -m pytest tests/test_resilience.py -q \
     -p no:cacheprovider -p no:randomly \
     -k "ChaosEndToEnd or survives_broker_kill or heartbeat_missed"
 
-echo "== [2/7] preemption: SIGTERM a real run, then --auto_resume =="
+echo "== [2/8] preemption: SIGTERM a real run, then --auto_resume =="
 ARGS=(--dataset sine --model fnn --concept_drift_algo win-1
       --concept_num 2 --client_num_in_total 4 --client_num_per_round 4
       --train_iterations 6 --comm_round 8 --epochs 2
@@ -84,15 +89,15 @@ print(f"resume OK: {len(rows)} metric rows, final Test/Acc="
       f"{rows[-1]['Test/Acc']:.4f}")
 EOF
 
-echo "== [3/7] event taxonomy consistency (strict: no dead kinds) =="
+echo "== [3/8] event taxonomy consistency (strict: no dead kinds) =="
 python scripts/check_events_schema.py --strict
 
-echo "== [4/7] byzantine smoke: trimmed_mean defends where mean fails =="
+echo "== [4/8] byzantine smoke: trimmed_mean defends where mean fails =="
 timeout -k 10 300 python -m pytest tests/test_robust_agg.py -q \
     -p no:cacheprovider -p no:randomly \
     -k "trimmed_mean_defends_where_mean_fails"
 
-echo "== [5/7] decision observability: kill clients -> alerts + lineage =="
+echo "== [5/8] decision observability: kill clients -> alerts + lineage =="
 LRUN="$OUT/lineage-run"
 timeout -k 10 300 python - "$LRUN" <<'EOF'
 import sys
@@ -126,7 +131,7 @@ python -m feddrift_tpu report "$LRUN" > "$OUT/report.txt"
 grep -q "alerts:" "$OUT/report.txt" \
     || { echo "report missing alerts section"; exit 1; }
 
-echo "== [6/7] participation: 10^3 population, 20% stragglers + churn =="
+echo "== [6/8] participation: 10^3 population, 20% stragglers + churn =="
 PRUN="$OUT/population-run"
 timeout -k 10 300 python -m feddrift_tpu run \
     --dataset sea --model fnn --concept_drift_algo softcluster \
@@ -145,7 +150,7 @@ python -m feddrift_tpu report "$PRUN" > "$OUT/preport.txt"
 grep -q "participation:" "$OUT/preport.txt" \
     || { echo "report missing participation section"; exit 1; }
 
-echo "== [7/7] hierarchy: 10^3 population, kill edge 0 mid-run =="
+echo "== [7/8] hierarchy: 10^3 population, kill edge 0 mid-run =="
 HRUN="$OUT/hierarchy-run"
 timeout -k 10 300 python -m feddrift_tpu run \
     --dataset sea --model fnn --concept_drift_algo softcluster \
@@ -182,5 +187,10 @@ grep -q "hierarchy:" "$OUT/hreport.txt" \
     || { echo "report missing hierarchy section"; exit 1; }
 grep -q "re-homed:" "$OUT/hreport.txt" \
     || { echo "report missing re-homed line"; exit 1; }
+
+echo "== [8/8] causal trace continuity across broker reconnect =="
+timeout -k 10 300 python -m pytest tests/test_causal_trace.py -q \
+    -p no:cacheprovider -p no:randomly \
+    -k "trace_survives_broker_reconnect"
 
 echo "chaos_smoke: ALL OK"

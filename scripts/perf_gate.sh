@@ -16,28 +16,57 @@ cd "$(dirname "$0")/.."
 out="$(mktemp -d)"
 trap 'rm -rf "$out"' EXIT
 
-echo "[perf_gate 1/4] warm run (populates the persistent compile cache)"
+echo "[perf_gate 1/5] warm run (populates the persistent compile cache)"
 python bench.py --smoke --cpu > "$out/warm.json"
 
-echo "[perf_gate 2/4] measured run"
+echo "[perf_gate 2/5] measured run"
 python bench.py --smoke --cpu > "$out/bench.json"
 
-echo "[perf_gate 3/4] cost-model fields present"
+echo "[perf_gate 3/5] cost-model + critical-path fields present"
 python - "$out/bench.json" <<'EOF'
 import json, sys
 d = json.loads(open(sys.argv[1]).read().strip().splitlines()[-1])
 assert d.get("mfu_estimate") is not None, "mfu_estimate is null"
 assert d.get("hbm_peak_bytes") is not None, "hbm_peak_bytes is null"
 assert d.get("mfu", {}).get("source") in ("cost_analysis", "analytic"), d.get("mfu")
+assert d.get("host_overhead_frac") is not None, "host_overhead_frac is null"
+assert 0.0 <= d["host_overhead_frac"] <= 1.0, d["host_overhead_frac"]
+assert d.get("dispatch_gap", {}).get("mean_s") is not None, "dispatch_gap is null"
 print(f"  mfu_estimate={d['mfu_estimate']} (source={d['mfu']['source']}), "
-      f"hbm_peak_bytes={d['hbm_peak_bytes']}")
+      f"hbm_peak_bytes={d['hbm_peak_bytes']}, "
+      f"host_overhead_frac={d['host_overhead_frac']}")
 EOF
 
-echo "[perf_gate 4/4] regress: self-comparison (warm), then vs BENCH_r05.json"
+echo "[perf_gate 4/5] critical_path on a smoke run dir"
+# bench.py runs without an out_dir (no spans.jsonl), so the attribution
+# verb gets its own tiny recorded run: 2 iterations, per-round path.
+JAX_PLATFORMS=cpu python -m feddrift_tpu run \
+    --dataset sea --model fnn --concept_drift_algo softcluster \
+    --concept_drift_algo_arg H_A_C_1_10_0 --concept_num 4 \
+    --change_points A --client_num_in_total 4 --client_num_per_round 4 \
+    --train_iterations 2 --comm_round 4 --epochs 1 --batch_size 20 \
+    --sample_num 20 --chunk_rounds false --trace_sync true \
+    --out_dir "$out/cp_run" --flat_out_dir > /dev/null
+python -m feddrift_tpu critical_path "$out/cp_run"
+python -m feddrift_tpu critical_path "$out/cp_run" --json > "$out/cp.json"
+python - "$out/cp.json" <<'EOF'
+import json, sys
+d = json.load(open(sys.argv[1]))
+assert d["iterations"], "no iterations in critical_path output"
+assert d["dominant_segment"], "no dominant segment named"
+for row in d["iterations"]:
+    assert row["coverage"] is not None and abs(row["coverage"] - 1.0) <= 0.05, \
+        f"segment sums off iteration wall by >5%: {row}"
+print(f"  dominant_segment={d['dominant_segment']}, "
+      f"host_overhead_frac_mean={d['host_overhead_frac_mean']}")
+EOF
+
+echo "[perf_gate 5/5] regress: self-comparison (warm), then vs BENCH_r05.json"
 # back-to-back smoke runs on a busy 1-core host: generous relative noise
 # margins, but identical round counts make every metric comparable
 python -m feddrift_tpu regress "$out/bench.json" --baseline "$out/warm.json" \
-    --tol-rounds 0.6 --tol-wall 2.0 --tol-acc 0.02 --tol-compiles 0
+    --tol-rounds 0.6 --tol-wall 2.0 --tol-acc 0.02 --tol-compiles 0 \
+    --tol-host-overhead 0.25
 # committed full-run artifact: loose floors that still catch a
 # catastrophic (order-of-magnitude) throughput or accuracy collapse
 python -m feddrift_tpu regress "$out/bench.json" --baseline BENCH_r05.json \
