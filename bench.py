@@ -545,6 +545,110 @@ def _hierarchy_bench(smoke: bool) -> list:
     return out
 
 
+def _megastep_cfg(smoke: bool, K: int):
+    """Megastep K-sweep config: the canonical SEA geometry under the
+    drift-OBLIVIOUS single model, which certifies an unbounded
+    megastep_horizon — the canonical softcluster decides drift every
+    iteration (decision_cadence=1) and would clamp every block to K=1,
+    measuring nothing. 16 iterations divide evenly by every swept K, so
+    no run ever compiles a second (tail-sized) megastep program."""
+    return _canonical_cfg(
+        smoke, concept_drift_algo="oblivious", concept_drift_algo_arg="",
+        concept_num=1, megastep_k=K,
+        train_iterations=16, comm_round=10 if smoke else 20,
+        sample_num=50, batch_size=50,
+        cost_model="lowered")     # exact-HBM capture not worth the compiles here
+
+
+def _measure_megastep(cfg, backend: str) -> dict:
+    """Like _measure, but driven through the runner's megastep loop
+    (run_iteration never fuses blocks). Warm-up is the first block (first
+    two iterations when K=1, matching _measure); the timed steady state is
+    every remaining block, so the instruments snapshot counts steady-state
+    retraces — the megastep program must show ZERO."""
+    from feddrift_tpu import obs
+    from feddrift_tpu.obs import costmodel
+    from feddrift_tpu.simulation.runner import Experiment
+
+    costmodel.clear()
+    exp = Experiment(cfg)
+    K = cfg.megastep_k
+
+    def drive(t: int) -> int:
+        span = exp._megastep_span(t)
+        if span > 1:
+            return t + exp.run_megastep(t, span)
+        exp.run_iteration(t)
+        return t + 1
+
+    t = 0
+    while t < max(K, 2):                       # warm-up: first block
+        t = drive(t)
+    obs.registry().reset()
+    costmodel.refresh_gauges()
+    start_t = t
+    breakdowns = []
+    t0 = time.time()
+    while t < cfg.train_iterations:
+        t = drive(t)
+        if exp.last_round_breakdown is not None:
+            breakdowns.append(exp.last_round_breakdown)
+    jax.block_until_ready(exp.pool.params)
+    elapsed = time.time() - t0
+    rounds = cfg.comm_round * (cfg.train_iterations - start_t)
+    hofs = [b["host_overhead_frac"] for b in breakdowns]
+    return {
+        "value": round(rounds / elapsed, 3),
+        "unit": "rounds/s",
+        "wall_s": round(elapsed, 2),
+        "rounds": rounds,
+        "final_test_acc": round(float(exp.logger.last("Test/Acc")), 4),
+        "host_overhead_frac": (round(sum(hofs) / len(hofs), 6)
+                               if hofs else None),
+        "instruments": obs.registry().snapshot(),
+    }
+
+
+def _megastep_bench(backend: str, smoke: bool) -> list:
+    """rounds/s + host-overhead fraction + steady-state recompiles vs the
+    fused-iterations-per-dispatch factor K (K=1 is the PR-9 fused path).
+
+    The MEGASTEP artifact the `regress` gate checks: per-K throughput must
+    hold within the rounds tolerance, steady-state recompiles must stay
+    ZERO across K, and K>1 must keep host_overhead_frac strictly below
+    K=1's — the whole point of fusing away the per-iteration host
+    round-trip."""
+    from feddrift_tpu.obs.regress import _compile_counts
+
+    out = []
+    k1_rps = None
+    for K in (1, 2, 4, 8):
+        cfg = _megastep_cfg(smoke, K)
+        try:
+            r = _measure_megastep(cfg, backend)
+        except Exception as e:    # jax errors share no useful base
+            r = {"error": f"{type(e).__name__}: {str(e)[:300]}"}
+        _, recompiles = _compile_counts(r)
+        entry = {
+            "megastep_k": K,
+            "rounds_per_sec": r.get("value"),
+            "final_test_acc": r.get("final_test_acc"),
+            "wall_s": r.get("wall_s"),
+            "host_overhead_frac": r.get("host_overhead_frac"),
+            "steady_recompiles": recompiles,
+            **({"error": r["error"]} if "error" in r else {}),
+        }
+        if K == 1:
+            k1_rps = entry["rounds_per_sec"]
+        entry["speedup_vs_k1"] = (
+            round(entry["rounds_per_sec"] / k1_rps, 3)
+            if k1_rps and entry["rounds_per_sec"] else None)
+        out.append(entry)
+        print(json.dumps({"partial": f"megastep@{K}", **entry}),
+              file=sys.stderr)
+    return out
+
+
 def _conv_cfg(smoke: bool, **overrides):
     base = dict(
         dataset="cifar10", model="resnet8",
@@ -663,6 +767,12 @@ def main() -> None:
         # committed as COMM_r0*.json and gated by `regress`
         "hierarchy": (_hierarchy_bench(smoke)
                       if "--hierarchy" in sys.argv else None),
+        # multi-iteration megastep axis (opt-in: K-sweep of fused
+        # iteration blocks); committed as MEGASTEP_r1*.json and gated by
+        # `regress` (rounds/s floor, zero steady recompiles, host
+        # overhead strictly below K=1)
+        "megastep": (_megastep_bench(backend, smoke)
+                     if "--megastep" in sys.argv else None),
     }
     print(json.dumps(out))
     if conv is not None and "error" in conv:

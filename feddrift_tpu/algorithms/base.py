@@ -276,6 +276,24 @@ class DriftAlgorithm:
         after_round must not need per-round host work. Default conservative."""
         return False
 
+    def megastep_horizon(self, t: int) -> int:
+        """How many upcoming iterations starting AT ``t`` are
+        drift-decision-free, i.e. fusable into one multi-iteration device
+        program (TrainStep.train_megastep).
+
+        The contract: for every step t+1 .. t+h-1 inside the returned
+        horizon h, ``begin_iteration`` must not read any training result
+        produced inside the block (accuracy matrices, losses, aggregated
+        params) — its ``round_inputs`` must be computable host-side from t
+        alone before the block dispatches. Step t itself MAY decide: its
+        begin_iteration runs on pre-block state exactly as in sequential
+        execution. Oblivious/window/recency stretches return the full
+        remaining run; decision algorithms return the distance to their
+        next cadence boundary; the conservative default is 1 (no fusion),
+        which every algorithm that also keeps ``chunkable`` False should
+        inherit."""
+        return 1
+
     def end_iteration(self, t: int) -> None:
         pass
 

@@ -53,6 +53,11 @@ class WindowBaseline(DriftAlgorithm):
     def chunkable(self, t: int) -> bool:
         return True
 
+    def megastep_horizon(self, t: int) -> int:
+        # No drift decisions ever: every remaining step's time weights are
+        # a pure function of t, so the whole tail is fusable.
+        return max(1, self.cfg.train_iterations - t)
+
 
 @register_algorithm("exp", "lin")
 class RecencyWeighted(DriftAlgorithm):
@@ -72,3 +77,6 @@ class RecencyWeighted(DriftAlgorithm):
 
     def chunkable(self, t: int) -> bool:
         return True
+
+    def megastep_horizon(self, t: int) -> int:
+        return max(1, self.cfg.train_iterations - t)

@@ -132,6 +132,25 @@ class SoftCluster(DriftAlgorithm):
         # re-clusters every round (after_round above) — both steer per round
         return self.kind not in ("cfl", "hard-r")
 
+    def _is_decision_step(self, t: int) -> bool:
+        """Clustering/drift decisions run only at cadence boundaries
+        (cfg.decision_cadence); off-boundary steps carry the previous
+        assignment forward unchanged — the property ``megastep_horizon``
+        certifies. Per-round deciders (cfl, hard-r) ignore the cadence:
+        their decision lives in after_round, not here."""
+        d = self.cfg.decision_cadence
+        return (t == 0 or d <= 1 or t % d == 0
+                or self.kind in ("cfl", "hard-r"))
+
+    def megastep_horizon(self, t: int) -> int:
+        d = self.cfg.decision_cadence
+        if d <= 1 or not self.chunkable(t):
+            return 1
+        # Step t may itself decide (its begin_iteration runs on pre-block
+        # state); only t+1 .. t+h-1 must be decision-free, so the horizon
+        # reaches exactly to the next cadence boundary after t.
+        return max(1, ((t // d) + 1) * d - t)
+
     def test_model_idx(self, t: int) -> np.ndarray:
         return np.argmax(self.weights[t], axis=0)        # (:1257-1258)
 
@@ -148,6 +167,12 @@ class SoftCluster(DriftAlgorithm):
                     self.pool.distinct_reinit_slot(m, seed=self.cfg.seed + 7700 + m)
                 acc_t = self.acc_matrix_at(0)
                 self._cluster(acc_t, 0, round_idx=0)
+        elif not self._is_decision_step(t):
+            # cadence carry-forward: the last decision's assignment extends
+            # to this step's data — no accuracy matrix, no cluster pass, no
+            # host<->device traffic, which is what lets the runner fuse
+            # these steps into one megastep.
+            self.weights[t] = self.weights[t - 1]
         else:
             if self.kind == "hierarchical":
                 self._cluster_hierarchical(t)

@@ -18,7 +18,7 @@ import jax.numpy as jnp
 from feddrift_tpu import obs
 from feddrift_tpu.algorithms.base import DriftAlgorithm, register_algorithm
 from feddrift_tpu.comm import multihost
-from feddrift_tpu.config import DEFAULT_DELTAS, DRIFTSURF_DELTAS
+from feddrift_tpu.config import DEFAULT_DELTAS
 from feddrift_tpu.data.retrain import is_retrain_spec, time_weights
 
 
@@ -40,7 +40,9 @@ class DriftSurf(DriftAlgorithm):
         super().__init__(cfg, ds, pool, step)
         assert self.M == 2
         p = cfg.algo_params()
-        self.delta = p.get("delta", DRIFTSURF_DELTAS.get(cfg.base_dataset, 0.1))
+        # cfg.algo_params() always supplies delta for driftsurf (config.py
+        # owns the per-dataset default table) — no fallback here.
+        self.delta = p["delta"]
         self.reac_len = 3                       # r=3 (DriftSurfState.__init__)
         self.win_len = 10                       # batch-window cap
         self.key_params = {"pred": None, "stab": None, "reac": None}

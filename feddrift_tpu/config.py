@@ -47,6 +47,17 @@ class ExperimentConfig:
     chunk_rounds: bool = True          # scan rounds between evals as one
                                        # device program when the algorithm
                                        # permits (bitwise-identical results)
+    # Multi-iteration megastep: fuse up to K whole time steps (each an
+    # R-round chunked scan) into ONE device program when the algorithm's
+    # megastep_horizon(t) allows — the host touches the device once per K
+    # steps. 1 = off (legacy per-iteration dispatch, bitwise-identical).
+    megastep_k: int = 1
+    # Drift-decision cadence for decision algorithms (softcluster family):
+    # clustering decisions run only at t % decision_cadence == 0; between
+    # boundaries the assignment is carried forward unchanged, which is what
+    # makes those stretches megastep-fusable. 1 = decide every step
+    # (historical behavior).
+    decision_cadence: int = 1
     trace_sync: bool = False           # block on device inside traced phases
                                        # for exact per-phase attribution (off:
                                        # keep async dispatch for throughput)
@@ -290,6 +301,10 @@ class ExperimentConfig:
                 raise ValueError("churn probabilities must be in [0, 1)")
         if self.time_stretch < 1:
             raise ValueError("time_stretch must be >= 1")
+        if self.megastep_k < 1:
+            raise ValueError("megastep_k must be >= 1")
+        if self.decision_cadence < 1:
+            raise ValueError("decision_cadence must be >= 1")
         if self.divergence_spike_factor <= 1.0:
             raise ValueError("divergence_spike_factor must be > 1")
         if self.divergence_max_rollbacks < 1:

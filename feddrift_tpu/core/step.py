@@ -60,9 +60,11 @@ import optax
 from feddrift_tpu import obs
 from feddrift_tpu.comm.compress import simulate_codec
 from feddrift_tpu.core.functional import confusion_matrix, cross_entropy, tree_select
+from feddrift_tpu.parallel.mesh import constrain_pool
 from feddrift_tpu.platform.faults import BYZ_MODES, apply_byzantine_updates
 from feddrift_tpu.platform.hierarchical import two_tier_aggregate
 from feddrift_tpu.resilience.robust_agg import RobustAggConfig, aggregate
+from feddrift_tpu.utils.prng import iteration_key
 
 
 def weight_cdf(weights: jnp.ndarray) -> jnp.ndarray:
@@ -143,6 +145,14 @@ class TrainStep:
     # memory_analysis() (exact static HBM) — one extra XLA compile per
     # program, which bench.py opts into.
     cost_capture: str = "lowered"
+    # Optional device mesh (parallel/mesh.py). When it names a "models"
+    # and/or "clients" axis, the megastep program annotates its carry
+    # params / opt states / time-weight slices with with_sharding_constraint
+    # so GSPMD keeps the 2-D (models, clients) layout through the scan.
+    # None (or a mesh naming neither axis) leaves every program untouched.
+    # `self` is a static jit argument (identity hash), so setting this
+    # before first dispatch is compile-safe.
+    mesh: object = field(default=None, repr=False)
     # Compile tracking: per jitted entry point, the set of argument
     # signatures (leaf shapes/dtypes + static values) seen so far. jit
     # retraces exactly when the signature is new, so a second distinct
@@ -157,8 +167,14 @@ class TrainStep:
         host work per dispatch — microseconds against a multi-ms round.
         Returns the event kind emitted, or None for an already-seen
         signature (callers hook program-cost capture on "jit_compile")."""
+        # shape + dtype + sharding/committed-ness: jit also keys its cache
+        # on placement, so two calls with identical shapes but e.g. an
+        # uncommitted first-params vs a NamedSharding-committed steady
+        # state retrace silently — exactly what this tracker must surface
         sig = tuple(static) + tuple(
-            (leaf.shape, str(getattr(leaf, "dtype", type(leaf).__name__)))
+            (leaf.shape, str(getattr(leaf, "dtype", type(leaf).__name__)),
+             str(getattr(leaf, "sharding", "")),
+             bool(getattr(leaf, "committed", False)))
             if hasattr(leaf, "shape") else repr(leaf)
             for tree in trees for leaf in jax.tree_util.tree_leaves(tree))
         seen = self._signatures.setdefault(fn, set())
@@ -493,6 +509,20 @@ class TrainStep:
         (corr_tr, loss_tr, corr_te, loss_te) each [E, M, C], total [C],
         agg_stats [R, M, 3]) where E = len(eval_rounds(R, freq)).
         """
+        return self._iteration_body(
+            params, opt_states, iter_key, x, y, time_w, sample_w, feat_mask,
+            lr_scale, R, freq, t, client_masks, byz_modes, edge_ids,
+            edge_masks, edge_byz, byz_stale=byz_stale)
+
+    def _iteration_body(self, params, opt_states, iter_key, x, y, time_w,
+                        sample_w, feat_mask, lr_scale, R: int, freq: int, t,
+                        client_masks=None, byz_modes=None, edge_ids=None,
+                        edge_masks=None, edge_byz=None, *,
+                        byz_stale: bool = False):
+        """Untraced body of ``_train_iteration_eval_jit``, shared with the
+        multi-iteration ``_train_megastep_jit`` outer scan — extracting it
+        (instead of nesting jits) keeps the K=1 path's XLA program
+        bit-for-bit what it was."""
         evs = self.eval_rounds(R, freq)
         E = len(evs)
         # slot(r): r//freq for the regular cadence; the final round takes the
@@ -565,6 +595,94 @@ class TrainStep:
         params, opt_states, bufs = carry[0], carry[1], carry[2]
         total = jnp.full((C,), x.shape[2], dtype=jnp.int32)
         return params, opt_states, ns[-1], ls[-1], bufs, total, stats
+
+    # ------------------------------------------------------------------
+    def train_megastep(self, params, base_key, x, y, time_ws, sample_w,
+                       feat_mask, lr_scale, t0, R: int, freq: int, K: int,
+                       client_masks=None):
+        """K whole time steps (each an R-round fused scan with scheduled
+        evals) as ONE device program (dispatches ``_train_megastep_jit``).
+
+        time_ws: [K, M, C, T1] — the per-step time weights the algorithm
+        decided host-side BEFORE the block (the megastep contract: no drift
+        decision may depend on results inside the block, which is what
+        ``DriftAlgorithm.megastep_horizon`` certifies). client_masks:
+        [K, R, C] or None. t0 is a traced operand — advancing the block
+        start never retraces.
+
+        Returns stacked per-step results ``(ps [K, M, ...], ns [K, M, C],
+        losses [K, M, C], bufs (4x [K, E, M, C]), total [C],
+        agg_stats [K, R, M, 3])``; step j of the block is bitwise-identical
+        to a K=1 dispatch at t0+j because the scan folds the same
+        ``iteration_key(base_key, t0+j)`` and re-inits the optimizer states
+        from the same value-independent zeros.
+        """
+        kind = self._note_signature(
+            "train_megastep", params, x, y, time_ws, sample_w, feat_mask,
+            client_masks, static=(R, freq, K))
+        self._capture_cost(
+            kind, "train_megastep", type(self)._train_megastep_jit,
+            (params, base_key, x, y, time_ws, sample_w, feat_mask, lr_scale,
+             t0, R, freq, K, client_masks))
+        t0w, p0 = time.time(), time.perf_counter()
+        out = self._train_megastep_jit(
+            params, base_key, x, y, time_ws, sample_w, feat_mask, lr_scale,
+            t0, R, freq, K, client_masks)
+        if kind is not None:
+            obs.spans.record("jit_compile", t0w, time.perf_counter() - p0,
+                             cat="round", fn="train_megastep", event=kind)
+        return out
+
+    # NOTE: no buffer donation here — every output is K-stacked, so the
+    # [M, ...] params input can never alias an output buffer (XLA would
+    # warn "donated buffers were not usable" on every compile).
+    @partial(jax.jit, static_argnums=(0, 10, 11, 12))
+    def _train_megastep_jit(self, params, base_key, x, y, time_ws, sample_w,
+                            feat_mask, lr_scale, t0, R: int, freq: int,
+                            K: int, client_masks=None):
+        """Outer scan over K time steps, each one `_iteration_body` call.
+
+        The host round-trip this kills: the K=1 driver fetches params,
+        re-derives the iteration key, re-inits optimizer states and
+        re-dispatches per step. Here the key derivation
+        (``iteration_key(base_key, t0+k)`` — a pure fold_in chain, traceable
+        and bitwise-equal to the host-side derivation) and the opt-state
+        re-init (value-independent zeros) move inside the scan, and the
+        data-slice index ``t0 + k`` advances as a traced value, so the host
+        touches the device once per K steps. Per-step end params ride the
+        stacked output — they are [M, ...] (no client axis), cheap, and the
+        driver needs them for after_round replay and divergence rollback.
+
+        With a 2-D ``(models, clients)`` mesh on ``self.mesh``, the carry
+        params, in-scan opt states and time-weight slices are annotated
+        with `constrain_pool` so GSPMD shards the [M, C, ...] stacks over
+        both axes instead of replicating M; on a 1-D or single-device mesh
+        the constraints degrade to replication no-ops.
+        """
+        M = time_ws.shape[1]
+        C = x.shape[0]
+
+        def one_step(p, xs):
+            k, tw_k, cm_k = xs
+            t = t0 + k
+            it_key = iteration_key(base_key, t)
+            o0 = self.init_opt_states(p, M, C)
+            o0 = constrain_pool(self.mesh, o0, model_axis=0, client_axis=1)
+            tw_k = constrain_pool(self.mesh, tw_k, model_axis=0,
+                                  client_axis=1)
+            p, _o, n, losses, bufs, total, stats = self._iteration_body(
+                p, o0, it_key, x, y, tw_k, sample_w, feat_mask, lr_scale,
+                R, freq, t, cm_k, None, None, None, None, byz_stale=False)
+            p = constrain_pool(self.mesh, p, model_axis=0)
+            return p, (p, n, losses, bufs, total, stats)
+
+        params = constrain_pool(self.mesh, params, model_axis=0)
+        _, (ps, ns, ls, bufs, tots, stats) = jax.lax.scan(
+            one_step, params,
+            (jnp.arange(K, dtype=jnp.int32), time_ws, client_masks))
+        # eval totals are a pure function of (x, feat_mask) — constant over
+        # the block, so return one step's [C] row, same shape as K=1
+        return ps, ns, ls, bufs, tots[0], stats
 
     # ------------------------------------------------------------------
     def acc_matrix(self, params, x, y, feat_mask):

@@ -88,6 +88,66 @@ def prefetch_to_device(it: Iterable[Any], size: int = 2,
         stop.set()
 
 
+class _Staged:
+    """A completed staging job: ``value`` is the staging fn's return,
+    ``meta`` whatever the submitter attached (e.g. the cohort member ids
+    the staged arrays were gathered for)."""
+
+    __slots__ = ("value", "meta")
+
+    def __init__(self, value, meta) -> None:
+        self.value = value
+        self.meta = meta
+
+
+class AsyncStager:
+    """Single-slot background stager for double-buffered cohort H2D.
+
+    The runner submits next iteration's gather+device_put closure right
+    after the current iteration's checkpoint; by the time the driver loop
+    reaches iteration t+1 the shards are (usually) already resident and
+    ``take`` returns instantly. One worker thread, one slot: cohort staging
+    is strictly look-ahead-1 (the NEXT draw depends on failure-detector
+    state the current iteration updates), so deeper pipelining would stage
+    from stale registry state.
+
+    ``take(tag)`` returns the staged ``.value``/``.meta`` holder when the
+    slot holds ``tag`` (blocking until the background fn finishes), or None
+    on an empty slot or tag mismatch — the caller falls back to inline
+    staging, so a miss costs only the overlap, never correctness.
+    Exceptions in the staging fn surface at ``take`` (future.result()).
+    """
+
+    def __init__(self) -> None:
+        import concurrent.futures
+        self._pool = concurrent.futures.ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="cohort-stager")
+        self._tag = None
+        self._meta = None
+        self._future = None
+
+    def submit(self, tag, fn: Callable[[], Any], meta: Any = None) -> None:
+        """Stage ``fn()`` on the worker thread, keyed by ``tag``.
+        Overwrites any unclaimed previous slot (its device buffers are
+        simply dropped — jax puts are async and unpinned once unreferenced).
+        """
+        self._tag = tag
+        self._meta = meta
+        self._future = self._pool.submit(fn)
+
+    def take(self, tag) -> Optional[_Staged]:
+        """Claim the slot if it holds ``tag``; None otherwise. Clears the
+        slot either way only on a hit."""
+        if self._future is None or self._tag != tag:
+            return None
+        fut, meta = self._future, self._meta
+        self._tag = self._meta = self._future = None
+        return _Staged(fut.result(), meta)
+
+    def close(self) -> None:
+        self._pool.shutdown(wait=False)
+
+
 class TimeStepStream:
     """Client-sharded (x_t, y_t) device slices of a HOST-resident dataset,
     prefetched one time step ahead.

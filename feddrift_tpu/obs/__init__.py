@@ -46,6 +46,7 @@ from __future__ import annotations
 from feddrift_tpu.obs.events import (  # noqa: F401
     EVENT_KINDS,
     EventBus,
+    capture,
     configure,
     emit,
     get_bus,

@@ -26,6 +26,7 @@ undocumented events" guarantee.
 from __future__ import annotations
 
 import collections
+import contextlib
 import json
 import os
 import threading
@@ -249,7 +250,40 @@ def configure(path: str | None, max_bytes: int = 0) -> EventBus:
     return _bus
 
 
+_capture_tls = threading.local()
+
+
+@contextlib.contextmanager
+def capture():
+    """Buffer this THREAD's module-level ``emit()`` calls instead of
+    recording them; yields the ``[(kind, fields), ...]`` buffer for later
+    replay through ``emit()``.
+
+    Exists for the runner's cohort pre-staging: the t+1 churn + cohort
+    draw run at the END of iteration t (so the gather/H2D can overlap the
+    iteration tail), but their events must appear — and persist to
+    events.jsonl — only when iteration t+1 actually consumes the draw.
+    Without deferral, a kill between staging and consumption leaves the
+    draw's events on disk, and the resumed run (which re-draws) duplicates
+    them with shifted iteration context."""
+    prev = getattr(_capture_tls, "buffer", None)
+    _capture_tls.buffer = buf = []
+    try:
+        yield buf
+    finally:
+        _capture_tls.buffer = prev
+
+
 def emit(kind: str, **fields: Any) -> dict:
+    buf = getattr(_capture_tls, "buffer", None)
+    if buf is not None:
+        if kind not in EVENT_KINDS:
+            raise ValueError(
+                f"unknown event kind {kind!r}; add it to "
+                "obs.events.EVENT_KINDS and document it in "
+                "docs/OBSERVABILITY.md")
+        buf.append((kind, fields))
+        return {"kind": kind, **fields}
     return _bus.emit(kind, **fields)
 
 

@@ -195,6 +195,49 @@ def compare(candidate: dict, baseline: dict,
     elif isinstance(bps, list):
         skip("popscale", "candidate lacks the popscale axis")
 
+    # multi-iteration megastep axis (bench.py --megastep; MEGASTEP
+    # artifacts): rounds/s per K point under the throughput tolerance,
+    # steady-state recompiles as an ABSOLUTE zero gate (fusing more
+    # iterations must never grow the XLA program count — K is a static
+    # arg, one program per K, compiled in warm-up), and a host-overhead
+    # ceiling at every K>1 STRICTLY below the same artifact's K=1 row —
+    # the whole point of the megastep is amortizing the host round-trip,
+    # so a K>1 row with K=1-level host overhead is a regression even if
+    # throughput still clears its floor.
+    cms, bms = candidate.get("megastep"), baseline.get("megastep")
+    if isinstance(cms, list) and isinstance(bms, list):
+        by_k = {e.get("megastep_k"): e for e in bms if isinstance(e, dict)}
+        c_k1 = next((e for e in cms if isinstance(e, dict)
+                     and e.get("megastep_k") == 1), None)
+        for e in cms:
+            if not isinstance(e, dict):
+                continue
+            k = e.get("megastep_k")
+            be = by_k.get(k)
+            if be is None:
+                skip(f"megastep[{k}]", "K point missing in baseline")
+                continue
+            bv, cv = be.get("rounds_per_sec"), e.get("rounds_per_sec")
+            if bv and cv:
+                floor = bv * (1.0 - tol["rounds"])
+                rows.append(row(f"megastep[{k}].rounds_per_s", bv, cv,
+                                f">= {floor:.3f}", cv < floor))
+            rec = e.get("steady_recompiles")
+            if rec is not None:
+                rows.append(row(f"megastep[{k}].steady_recompiles",
+                                be.get("steady_recompiles"), rec, "== 0",
+                                rec > 0,
+                                note="compile-count invariance over K"))
+            hof = e.get("host_overhead_frac")
+            hof1 = (c_k1 or {}).get("host_overhead_frac")
+            if k and k > 1 and hof is not None and hof1 is not None:
+                rows.append(row(f"megastep[{k}].host_overhead_frac",
+                                be.get("host_overhead_frac"), hof,
+                                f"< {hof1:.4f}", hof >= hof1,
+                                note="must beat this run's K=1 row"))
+    elif isinstance(bms, list):
+        skip("megastep", "candidate lacks the megastep axis")
+
     # two-tier wire axis (bench.py --hierarchy; COMM artifacts): broker
     # bytes/round per codec under the bytes ceiling, plus an ABSOLUTE
     # >= 3x reduction floor for every lossy codec — a codec that stops

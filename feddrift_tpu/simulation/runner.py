@@ -36,7 +36,11 @@ from feddrift_tpu.core.pool import ModelPool
 from feddrift_tpu.core.step import TrainStep, make_optimizer
 from feddrift_tpu.data.registry import make_dataset
 from feddrift_tpu.models import create_model
-from feddrift_tpu.parallel.mesh import make_mesh, shard_client_arrays
+from feddrift_tpu.parallel.mesh import (
+    make_mesh,
+    replicate,
+    shard_client_arrays,
+)
 from feddrift_tpu.utils.metrics import MetricsLogger
 from feddrift_tpu.utils.prng import experiment_key, iteration_key, round_key
 from feddrift_tpu.utils.tracing import PhaseTracer
@@ -57,9 +61,18 @@ class Experiment:
         self.cfg = cfg
         self.ds = make_dataset(cfg)
         self.module = create_model(cfg.model, self.ds, cfg)
-        self.mesh = mesh if mesh is not None else make_mesh()
+        # cfg.mesh_shape (e.g. {"models": 2, "clients": 4}) selects the 2-D
+        # layout; empty dict = legacy 1-D clients mesh over all devices.
+        self.mesh = mesh if mesh is not None \
+            else make_mesh(shape=cfg.mesh_shape or None)
         self.pool = ModelPool.create(self.module, _sample_input(self.ds),
                                      cfg.num_models, seed=cfg.seed + 42)
+        # Commit the pool to the mesh (replicated) up front: every jitted
+        # step consumes COMMITTED x/y (shard_client_arrays), so its param
+        # outputs come back committed to a NamedSharding — if the t=0
+        # params were left uncommitted, t=1 would present a new sharding
+        # signature and silently recompile the whole iteration program.
+        self.pool.params = replicate(self.mesh, self.pool.params)
         from feddrift_tpu.resilience.robust_agg import RobustAggConfig
         self.step = TrainStep(
             apply_fn=self._make_apply(),
@@ -93,6 +106,10 @@ class Experiment:
             # (and memory_analysis under "compiled") into program_cost
             # events + gauges.
             cost_capture=cfg.cost_model,
+            # The megastep program annotates its [M, C, ...] stacks with
+            # with_sharding_constraint over this mesh (no-op on 1-D/1-device
+            # meshes — parallel/mesh.py::constrain_pool).
+            mesh=self.mesh,
         )
         # Device-resident dataset, client axis sharded over the mesh. The
         # client axis is padded to a multiple of the mesh size with phantom
@@ -104,7 +121,9 @@ class Experiment:
         # device stacks each iteration (_prepare_cohort) — XLA program
         # shapes depend on the cohort, never on the population.
         self.population_mode = cfg.population_size > 0
-        n_dev = self.mesh.devices.size
+        # Pad the client axis to the CLIENTS mesh-axis size: on a 2-D
+        # (models, clients) mesh only the clients dimension shards data.
+        n_dev = dict(self.mesh.shape).get("clients", self.mesh.devices.size)
         C = cfg.device_clients
         self.C_pad = ((C + n_dev - 1) // n_dev) * n_dev
         pad = self.C_pad - C
@@ -192,6 +211,7 @@ class Experiment:
         self.straggler = self.churn = self.participation = None
         self._cohort_members = None
         self._slot_valid = None
+        self._stager = None
         if self.population_mode:
             from feddrift_tpu.platform.faults import (ChurnSchedule,
                                                       StragglerInjector)
@@ -216,6 +236,13 @@ class Experiment:
                 cfg.cohort_size or cfg.client_num_in_total)
             self._slot_valid = np.ones(self.C_pad, dtype=bool)
             self._slot_valid[self.C_:] = False
+            # Double-buffered cohort staging: iteration t's tail kicks off
+            # the t+1 gather + device_put on a background thread so the
+            # next _prepare_cohort finds its shard already staged
+            # (data/prefetch.py::AsyncStager; bitwise-identical — only the
+            # copy timing moves).
+            from feddrift_tpu.data.prefetch import AsyncStager
+            self._stager = AsyncStager()
         from feddrift_tpu.platform.faults import (ByzantineInjector,
                                                   FailureDetector,
                                                   FaultInjector)
@@ -468,29 +495,78 @@ class Experiment:
     # ------------------------------------------------------------------
     # population mode: cohort lifecycle (one cohort per iteration — the
     # boundary where data windows and optimizer states change anyway)
+    def _cohort_gather_index(self, members: np.ndarray) -> np.ndarray:
+        """[C_pad] population row per cohort slot: phantom slots (inactive
+        population shortfall + mesh padding) borrow member 0's rows — they
+        train masked, are stale-excluded from decisions and metrics-masked."""
+        valid = members >= 0
+        idx = np.zeros(self.C_pad, dtype=np.int64)
+        idx[: self.C_] = np.where(valid, members, 0)
+        return idx
+
+    def _stage_cohort(self, t: int) -> None:
+        """Kick off iteration t's cohort staging at the END of iteration
+        t-1: the registry mutation (churn) and the seeded cohort draw run
+        on the MAIN thread — after iteration t-1's checkpoint is on disk,
+        so a resume replays them identically — and only the pure
+        [C_pad, T1, N, ...] gather + device_put goes to the stager thread,
+        overlapping the host-side iteration tail. Bitwise-identical to
+        inline staging; only the copy timing moves off the measured
+        cohort_prep/h2d path."""
+        if t >= self.cfg.train_iterations or self._stager is None:
+            return
+        # Defer the churn/draw events to consumption time (_prepare_cohort):
+        # staged-but-never-consumed draws (a kill between staging and the
+        # next iteration) must leave no trace in events.jsonl, or the
+        # resumed run — which re-draws identically from the checkpointed
+        # registry — would duplicate them.
+        with obs.capture() as deferred:
+            if self.churn is not None:
+                joins, leaves = self.churn.events(t, self.registry.active)
+                self.registry.apply_churn(joins, leaves, t)
+            members = self.sampler.sample(t)
+        idx = self._cohort_gather_index(members)
+
+        def gather():
+            return (shard_client_arrays(self.mesh,
+                                        jnp.asarray(self._x_pop[idx])),
+                    shard_client_arrays(self.mesh,
+                                        jnp.asarray(self._y_pop[idx])))
+        self._stager.submit(t, gather, meta=(members, deferred))
+
     def _prepare_cohort(self, t: int) -> None:
         """Churn the registry, draw the seeded cohort, stage its shard
         into the fixed-shape device stacks, and reload the algorithm's
-        per-slot state from the members' registry columns."""
+        per-slot state from the members' registry columns. Consumes the
+        background-staged shard when iteration t-1 pre-staged it
+        (_stage_cohort); falls back to inline staging otherwise (first
+        iteration, resume)."""
         cfg = self.cfg
-        if self.churn is not None:
-            joins, leaves = self.churn.events(t, self.registry.active)
-            self.registry.apply_churn(joins, leaves, t)
-        members = self.sampler.sample(t)
+        staged = self._stager.take(t) if self._stager is not None else None
+        if staged is None:
+            if self.churn is not None:
+                joins, leaves = self.churn.events(t, self.registry.active)
+                self.registry.apply_churn(joins, leaves, t)
+            members = self.sampler.sample(t)
+        else:
+            members, deferred = staged.meta
+            # replay the draw's deferred events under THIS iteration's
+            # context — the stream is then byte-identical to inline staging
+            for kind, fields in deferred:
+                self.events.emit(kind, **fields)
         self._cohort_members = members
         valid = members >= 0
         self._slot_valid = np.zeros(self.C_pad, dtype=bool)
         self._slot_valid[: self.C_] = valid
-        # Gather [C_pad, T1, N, ...]: phantom slots (inactive population
-        # shortfall + mesh padding) borrow member 0's rows — they train
-        # masked, are stale-excluded from decisions and metrics-masked.
-        idx = np.zeros(self.C_pad, dtype=np.int64)
-        idx[: self.C_] = np.where(valid, members, 0)
         with self._seg("h2d", iteration=t):
-            self.x = shard_client_arrays(self.mesh,
-                                         jnp.asarray(self._x_pop[idx]))
-            self.y = shard_client_arrays(self.mesh,
-                                         jnp.asarray(self._y_pop[idx]))
+            if staged is None:
+                idx = self._cohort_gather_index(members)
+                self.x = shard_client_arrays(self.mesh,
+                                             jnp.asarray(self._x_pop[idx]))
+                self.y = shard_client_arrays(self.mesh,
+                                             jnp.asarray(self._y_pop[idx]))
+            else:
+                self.x, self.y = staged.value
         self.algo.rebind_data(self.x, self.y)
         hist, arm = self.registry.cohort_view(members)
         self.algo.load_cohort_state(
@@ -636,6 +712,11 @@ class Experiment:
             with self._seg("writeback", iteration=t):
                 self.save_checkpoint(t)
             self.events.emit("checkpoint_save", path=self.ckpt_path())
+        if self.population_mode:
+            # pre-stage t+1's cohort shard on the stager thread; must run
+            # AFTER this iteration's checkpoint so the churned registry the
+            # draw commits is never ahead of the state a resume reloads
+            self._stage_cohort(t + 1)
         wall = time.time() - t0
         log.info("iteration %d done in %.1fs (Test/Acc=%.4f)", t,
                  wall, self.logger.last("Test/Acc", -1))
@@ -1102,19 +1183,245 @@ class Experiment:
                 new_params, {t: corr_tr[-1][:, :C] / tot,
                              t + 1: corr_te[-1][:, :C] / tot})
 
+    # ------------------------------------------------------------------
+    # multi-iteration megastep (TrainStep.train_megastep)
+    def _megastep_span(self, t: int) -> int:
+        """How many whole time steps starting at ``t`` to fuse into one
+        train_megastep dispatch. 1 = legacy per-iteration path (always
+        bitwise-identical — K=1 never even builds the megastep program).
+
+        Features that need per-iteration host participation keep the span
+        at 1: population cohorts re-gather data between steps, hierarchy /
+        Byzantine schedules and the delta codec thread per-iteration
+        carries the megastep scan does not model, streaming swaps the
+        dataset window. Within the fusable configurations the algorithm's
+        ``megastep_horizon`` bounds the span at its next drift-decision
+        boundary."""
+        cfg = self.cfg
+        if (cfg.megastep_k <= 1 or not cfg.chunk_rounds or cfg.stream_data
+                or self.population_mode or self.hierarchy
+                or self.byzantine is not None or self.step.codec != "none"):
+            return 1
+        if not (self.algo.chunkable(t) and self.algo.ensemble_spec(t) is None):
+            return 1
+        return max(1, min(cfg.megastep_k, self.algo.megastep_horizon(t),
+                          cfg.train_iterations - t))
+
+    def run_megastep(self, t0: int, K: int) -> int:
+        """Run K whole time steps as ONE device dispatch
+        (TrainStep.train_megastep) and replay the buffered per-step results
+        into the exact per-iteration record stream the K=1 path emits.
+
+        Three phases:
+          plan    — per step, in sequential order: events context,
+                    begin_iteration (host drift decisions on pre-block
+                    state — legal because megastep_horizon certified steps
+                    t0+1.. are decision-free), round_inputs, client masks.
+          dispatch — one donated-buffer device program for all K*R rounds.
+          replay  — per step, in sequential order: robust-agg stats,
+                    divergence guard (same per-iteration window/check
+                    cadence), after_round, the buffered eval matrices into
+                    _log_eval, end_iteration.
+
+        Returns the number of COMMITTED iterations: K normally; j+1 after
+        a divergence rollback at block step j — steps past j trained on
+        the diverged trajectory inside the fused program, so the driver
+        loop reruns them from the restored params (their planning-phase
+        events re-emit; all planning state writes are idempotent by the
+        megastep contract)."""
+        cfg = self.cfg
+        R, freq = cfg.comm_round, cfg.frequency_of_the_test
+        block_t0 = time.time()
+        self._segs = {}
+        self._profiled_rounds = 0
+        g0 = self.global_round
+        # -- plan ------------------------------------------------------
+        tws, cms_list = [], []
+        sw = fm = lr_scale = None
+        for j in range(K):
+            t = t0 + j
+            self.events.set_context(iteration=t, round=g0 + j * R)
+            self.events.emit("iteration_start", megastep_k=K)
+            self._byz_stale = None
+            self._codec_prev = None
+            if self.failure_detector is not None:
+                self.algo.set_client_staleness(
+                    self.failure_detector.absent_streak,
+                    self.failure_detector.suspected)
+            with self.tracer.phase("cluster"), \
+                    self._seg("drift_decision", iteration=t):
+                self.algo.begin_iteration(t)
+            if cfg.debug_checks:
+                from feddrift_tpu.utils.invariants import check_round_inputs
+                tw_d, sw_d, fm_d, _ = self.algo.round_inputs(t, 0)
+                check_round_inputs(
+                    tw_d, sw_d, fm_d, num_models=self.pool.num_models,
+                    num_clients=self.C_, num_steps_p1=self.ds.num_steps + 1,
+                    sample_num=self.ds.samples_per_step)
+            tw, sw, fm, lr_scale = self.algo.round_inputs(t, 0)
+            if fm is not getattr(self.algo, "_ones_feat_mask", None):
+                raise RuntimeError(
+                    "megastep requires the algorithm's plain all-ones "
+                    "feature mask (megastep_horizon contract violated)")
+            tws.append(self._pad_clients(tw))
+            cms_list.append(self._client_masks(t, range(R)))
+        sw = self._pad_clients(sw, value=1.0)
+        time_ws = jnp.stack(tws)                      # [K, M, C_pad, T1]
+        cms = None
+        if cms_list[0] is not None:
+            cms = jnp.asarray(np.stack(cms_list))     # [K, R, C_pad]
+        # -- dispatch --------------------------------------------------
+        with self.tracer.phase("train_round"):
+            disp0 = time.perf_counter()
+            ps, ns, ls, bufs, total, agg_stats = self.step.train_megastep(
+                self.pool.params, self.key, self.x, self.y, time_ws, sw, fm,
+                lr_scale, jnp.int32(t0), R, freq, K, cms)
+            self._seg_add("dispatch", time.perf_counter() - disp0)
+            blk_w, blk0 = time.time(), time.perf_counter()
+            jax.block_until_ready(ps)
+            blk_dt = time.perf_counter() - blk0
+            self.spans.record("device_compute", blk_w, blk_dt, cat="round",
+                              iteration=t0, round=g0)
+            self._seg_add("device_compute", blk_dt)
+            self._profiled_rounds += K * R
+        # -- replay ----------------------------------------------------
+        C = self.C_
+        ns_h, ls_h, bufs_h, total_h = multihost.fetch((ns, ls, bufs, total))
+        ns_h, ls_h, total_h = (np.asarray(ns_h), np.asarray(ls_h),
+                               np.asarray(total_h))
+        corr_tr, loss_tr, corr_te, loss_te = (np.asarray(b) for b in bufs_h)
+        stats_h = (np.asarray(multihost.fetch(agg_stats))
+                   if self._robust_active else None)
+        evs = self.step.eval_rounds(R, freq)
+        committed = K
+        final_p = None
+        for j in range(K):
+            t = t0 + j
+            gj = g0 + j * R
+            self.events.set_context(iteration=t, round=gj)
+            if stats_h is not None:
+                for rr in range(R):
+                    self._emit_robust_stats(stats_h[j, rr], gj + rr)
+            if self.divergence_guard is not None:
+                self.divergence_guard.new_window()
+            if self._check_divergence(ls_h[j], ns_h[j]):
+                # roll back to the end of block step j-1 and truncate: the
+                # fused program trained later steps on the diverged
+                # trajectory. For j=0 the pool still holds the pre-block
+                # params (the megastep program does not donate its input),
+                # so the rollback is a no-op there.
+                if j > 0:
+                    self.pool.params = jax.tree_util.tree_map(
+                        lambda l, _j=j: l[_j - 1], ps)
+                self.divergence_guard.record_rollback()
+                self.global_round = gj + R
+                committed = j + 1
+                break
+            step_p = jax.tree_util.tree_map(lambda l, _j=j: l[_j], ps)
+            wb0 = time.perf_counter()
+            self.pool.params = self.algo.after_round(
+                t, R - 1, None, step_p, None, ns_h[j])
+            self._seg_add("writeback", time.perf_counter() - wb0)
+            ev0 = time.perf_counter()
+            with self.tracer.phase("eval"):
+                for slot, r in enumerate(evs):
+                    self.global_round = gj + r
+                    self._log_eval(
+                        t, corr_tr[j, slot][:, :C], loss_tr[j, slot][:, :C],
+                        corr_te[j, slot][:, :C], loss_te[j, slot][:, :C],
+                        total_h[:C])
+            self._seg_add("eval", time.perf_counter() - ev0)
+            self.global_round = gj + R
+            with self.tracer.phase("cluster"), \
+                    self._seg("drift_decision", iteration=t):
+                self.algo.end_iteration(t)
+            final_p = step_p
+        # Final-slot accuracy offer, exactly like the K=1 fused path —
+        # keyed to the sliced final-step params object the pool now holds.
+        if final_p is not None and committed == K:
+            tot = np.maximum(total_h[None, :C], 1)
+            self.algo.offer_acc_matrix(
+                final_p, {t0 + K - 1: corr_tr[K - 1, -1][:, :C] / tot,
+                          t0 + K: corr_te[K - 1, -1][:, :C] / tot})
+        last_t = t0 + committed - 1
+        if cfg.checkpoint_every_iteration and self.out_dir:
+            # one checkpoint per BLOCK (the per-iteration generations
+            # between block boundaries are skipped — each would overwrite
+            # the same path anyway; resume granularity becomes the block)
+            with self._seg("writeback", iteration=last_t):
+                self.save_checkpoint(last_t)
+            self.events.emit("checkpoint_save", path=self.ckpt_path())
+        # -- per-iteration telemetry records ---------------------------
+        wall = time.time() - block_t0
+        log.info("megastep %d..%d (K=%d) done in %.1fs (Test/Acc=%.4f)",
+                 t0, last_t, K, wall, self.logger.last("Test/Acc", -1))
+        self.tracer.log_summary(prefix=f"iters {t0}..{last_t}: ")
+        self.last_phase_summary = self.tracer.summary()
+        self.tracer.reset()
+        B = min(cfg.batch_size, self.ds.samples_per_step)
+        participants = min(cfg.client_num_per_round, self.C_)
+        examples = R * cfg.epochs * B * participants
+        wall_j = wall / committed
+        gap = max(wall - sum(self._segs.values()), 0.0)
+        dev = self._segs.get("device_compute", 0.0)
+        host_frac = min(max(1.0 - dev / max(wall, 1e-9), 0.0), 1.0)
+        phases = {k: {"total_s": round(v["total_s"] / committed, 4),
+                      "count": v["count"]}
+                  for k, v in self.last_phase_summary.items()}
+        segments = {k: round(v / committed, 6)
+                    for k, v in sorted(self._segs.items())}
+        segments["dispatch_gap"] = round(gap / committed, 6)
+        for j in range(committed):
+            t = t0 + j
+            self.events.set_context(iteration=t, round=g0 + j * R + R - 1)
+            self.events.emit(
+                "iteration_end", wall_s=round(wall_j, 4), rounds=R,
+                examples=examples,
+                examples_per_s=round(examples / max(wall_j, 1e-9), 1),
+                rounds_per_s=round(R / max(wall_j, 1e-9), 3),
+                test_acc=self.logger.last("Test/Acc"),
+                megastep_k=K, phases=phases)
+            self.spans.record("iteration", block_t0 + j * wall_j, wall_j,
+                              cat="runner", iteration=t)
+            self.last_round_breakdown = {
+                "iteration": t, "wall_s": round(wall_j, 6), "rounds": R,
+                "profiled_rounds": R, "megastep_k": K,
+                "segments": segments,
+                "dispatch_gap_s": round(gap / committed, 6),
+                "host_overhead_frac": round(host_frac, 6)}
+            self.events.emit("round_breakdown", **self.last_round_breakdown)
+        reg = obs.registry()
+        reg.gauge("host_overhead_frac").set(round(host_frac, 6))
+        reg.histogram("round_wall_seconds").observe(wall_j / max(R, 1))
+        obs.costmodel.record_hbm_watermark(iteration=last_t)
+        if self.out_dir and self.is_coordinator:
+            import os
+            obs.registry().write_textfile(
+                os.path.join(self.out_dir, "metrics.prom"))
+        return committed
+
     def run(self) -> MetricsLogger:
         # Context managers so a raising iteration cannot leak the JSONL
         # handles; the in-memory history/ring stay readable after close.
         from feddrift_tpu.resilience.preempt import PreemptionHandler
         with self.logger, self.events:
             with PreemptionHandler(enabled=self.cfg.preempt_signals) as pre:
-                for t in range(self.start_iteration,
-                               self.cfg.train_iterations):
-                    self.run_iteration(t)
+                t = self.start_iteration
+                while t < self.cfg.train_iterations:
+                    # greedy megastep fusion: K > 1 runs whole blocks of
+                    # drift-decision-free time steps as one dispatch; K = 1
+                    # is the historical per-iteration path, bit for bit
+                    K = self._megastep_span(t)
+                    if K > 1:
+                        t += self.run_megastep(t, K)
+                    else:
+                        self.run_iteration(t)
+                        t += 1
                     if pre.requested:
-                        # preemption: iteration t just completed — persist
-                        # it and exit cleanly; --auto_resume continues here
-                        self._preempt_stop(t, pre.signal_name)
+                        # preemption: the block ending at t-1 just
+                        # completed — persist it and exit cleanly;
+                        # --auto_resume continues here
+                        self._preempt_stop(t - 1, pre.signal_name)
                         break
             self.events.emit("run_end", global_round=self.global_round,
                              test_acc=self.logger.last("Test/Acc"),

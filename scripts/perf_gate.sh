@@ -16,13 +16,13 @@ cd "$(dirname "$0")/.."
 out="$(mktemp -d)"
 trap 'rm -rf "$out"' EXIT
 
-echo "[perf_gate 1/5] warm run (populates the persistent compile cache)"
+echo "[perf_gate 1/6] warm run (populates the persistent compile cache)"
 python bench.py --smoke --cpu > "$out/warm.json"
 
-echo "[perf_gate 2/5] measured run"
+echo "[perf_gate 2/6] measured run"
 python bench.py --smoke --cpu > "$out/bench.json"
 
-echo "[perf_gate 3/5] cost-model + critical-path fields present"
+echo "[perf_gate 3/6] cost-model + critical-path fields present"
 python - "$out/bench.json" <<'EOF'
 import json, sys
 d = json.loads(open(sys.argv[1]).read().strip().splitlines()[-1])
@@ -37,7 +37,7 @@ print(f"  mfu_estimate={d['mfu_estimate']} (source={d['mfu']['source']}), "
       f"host_overhead_frac={d['host_overhead_frac']}")
 EOF
 
-echo "[perf_gate 4/5] critical_path on a smoke run dir"
+echo "[perf_gate 4/6] critical_path on a smoke run dir"
 # bench.py runs without an out_dir (no spans.jsonl), so the attribution
 # verb gets its own tiny recorded run: 2 iterations, per-round path.
 JAX_PLATFORMS=cpu python -m feddrift_tpu run \
@@ -61,7 +61,40 @@ print(f"  dominant_segment={d['dominant_segment']}, "
       f"host_overhead_frac_mean={d['host_overhead_frac_mean']}")
 EOF
 
-echo "[perf_gate 5/5] regress: self-comparison (warm), then vs BENCH_r05.json"
+echo "[perf_gate 5/6] megastep: K=4 vs K=1 bitwise parity + zero steady recompiles"
+# the megastep fuses K whole iterations into one device program; the gate
+# is (a) bitwise-identical params/accuracy vs the K=1 driver and (b) no
+# jit cache growth past the single warm-up compile across blocks
+JAX_PLATFORMS=cpu python - <<'EOF'
+import jax, numpy as np
+from feddrift_tpu.config import ExperimentConfig
+from feddrift_tpu.simulation.runner import Experiment
+
+def run(K):
+    cfg = ExperimentConfig(
+        dataset="sea", model="lr", concept_drift_algo="oblivious",
+        concept_drift_algo_arg="", concept_num=1, client_num_in_total=8,
+        client_num_per_round=8, train_iterations=8, comm_round=5,
+        epochs=1, batch_size=50, sample_num=50, frequency_of_the_test=5,
+        megastep_k=K, seed=7, trace_sync=True)
+    exp = Experiment(cfg)
+    exp.run()
+    return exp, exp.pool.params, exp.logger.series("Test/Acc")
+
+e1, p1, a1 = run(1)
+e4, p4, a4 = run(4)
+diff = max(float(np.max(np.abs(np.asarray(x) - np.asarray(y))))
+           for x, y in zip(jax.tree_util.tree_leaves(p1),
+                           jax.tree_util.tree_leaves(p4)))
+assert diff == 0.0, f"megastep K=4 params diverge from K=1: {diff}"
+assert a1 == a4, "megastep K=4 eval series diverges from K=1"
+n = e4.step._train_megastep_jit._cache_size()
+assert n == 1, f"megastep jit cache grew past warm-up: {n} entries"
+print(f"  parity OK (leafdiff=0.0, {len(a4)} eval points), "
+      f"megastep cache entries={n}")
+EOF
+
+echo "[perf_gate 6/6] regress: self-comparison (warm), then vs BENCH_r05.json"
 # back-to-back smoke runs on a busy 1-core host: generous relative noise
 # margins, but identical round counts make every metric comparable
 python -m feddrift_tpu regress "$out/bench.json" --baseline "$out/warm.json" \

@@ -1,0 +1,211 @@
+"""Multi-iteration megastep: K fused time steps per device dispatch.
+
+The megastep (TrainStep.train_megastep, runner.run_megastep) scans K
+whole time steps — each itself an R-round fused scan with scheduled
+evals — inside ONE device program, so the host touches the device once
+per K iterations instead of once per iteration. The contract under test:
+
+- bitwise parity: the K>1 path must reproduce the K=1 driver exactly
+  (params, eval series, decision trajectories) — same fold_in key
+  sequence, same opt-state reinit, same eval cadence;
+- validity gating: ``_megastep_span`` fuses only configurations the scan
+  actually models, and ``megastep_horizon`` clamps the span at the next
+  drift-decision boundary;
+- compile stability: one program per K, compiled once — steady-state
+  blocks must hit the jit cache (the perf win evaporates otherwise);
+- the regress gate's megastep axis (rounds/s floor, absolute
+  zero-recompile, host-overhead-beats-K=1).
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from feddrift_tpu.config import ExperimentConfig
+from feddrift_tpu.simulation.runner import Experiment, run_experiment
+
+
+def _cfg(**kw):
+    base = dict(dataset="sea", model="lr", concept_drift_algo="oblivious",
+                concept_drift_algo_arg="", concept_num=1,
+                client_num_in_total=8, client_num_per_round=8,
+                train_iterations=8, comm_round=5, epochs=1, batch_size=50,
+                sample_num=50, frequency_of_the_test=5, lr=0.05,
+                seed=7, trace_sync=True)
+    base.update(kw)
+    return ExperimentConfig(**base)
+
+
+def _leafdiff(a, b):
+    la, lb = jax.tree_util.tree_leaves(a), jax.tree_util.tree_leaves(b)
+    assert len(la) == len(lb)
+    return max(float(np.max(np.abs(np.asarray(x) - np.asarray(y))))
+               for x, y in zip(la, lb))
+
+
+@pytest.mark.slow
+class TestMegastepParity:
+    """K=4 vs K=1 must be bitwise-identical end to end."""
+
+    def _pair(self, **kw):
+        return run_experiment(_cfg(megastep_k=1, **kw)), \
+               run_experiment(_cfg(megastep_k=4, **kw))
+
+    def test_oblivious_bitwise(self):
+        e1, e4 = self._pair()
+        assert _leafdiff(e1.pool.params, e4.pool.params) == 0.0
+        assert e1.logger.series("Test/Acc") == e4.logger.series("Test/Acc")
+        assert e1.logger.series("Train/Acc") == e4.logger.series("Train/Acc")
+
+    def test_softcluster_cadence_bitwise(self):
+        # cadence-3 softcluster: decisions at t=0,3,6 — the megastep fuses
+        # the decision-free gaps and the carried-forward weight trajectory
+        # must match the sequential driver exactly
+        kw = dict(concept_drift_algo="softcluster",
+                  concept_drift_algo_arg="H_A_C_1_10_0", concept_num=3,
+                  decision_cadence=3)
+        e1, e4 = self._pair(**kw)
+        assert _leafdiff(e1.pool.params, e4.pool.params) == 0.0
+        assert e1.logger.series("Test/Acc") == e4.logger.series("Test/Acc")
+        assert np.array_equal(e1.algo.weights, e4.algo.weights)
+
+    def test_partial_participation_bitwise(self):
+        # per-round client masks ride through the scan as a [K, R, C] xs
+        e1, e4 = self._pair(client_num_per_round=2)
+        assert _leafdiff(e1.pool.params, e4.pool.params) == 0.0
+        assert e1.logger.series("Test/Acc") == e4.logger.series("Test/Acc")
+
+    def test_single_compile_across_blocks(self):
+        # 8 iterations at K=4 = two blocks; block 2's params are scan
+        # outputs (committed NamedSharding) — the init-time pool placement
+        # must make block 1 present the same signature, or every steady
+        # block silently recompiles the whole program. (_cache_size is
+        # per jit-wrapped function, shared by every TrainStep via the
+        # static self argnum — so assert NO GROWTH past block 1, not an
+        # absolute count.)
+        exp = Experiment(_cfg(megastep_k=4))
+        t = exp.run_megastep(0, exp._megastep_span(0))
+        n0 = exp.step._train_megastep_jit._cache_size()
+        while t < exp.cfg.train_iterations:
+            t += exp.run_megastep(t, exp._megastep_span(t))
+        assert exp.step._train_megastep_jit._cache_size() == n0
+
+
+class TestMegastepGate:
+    """_megastep_span: fuse only what the scan models, clamp at decision
+    boundaries and the end of the run."""
+
+    def test_span_and_tail_clamp(self):
+        exp = Experiment(_cfg(megastep_k=4))
+        assert exp._megastep_span(0) == 4
+        assert exp._megastep_span(6) == 2      # train_iterations=8 tail
+        assert exp._megastep_span(7) == 1
+
+    def test_k1_and_unfusable_configs_stay_sequential(self):
+        assert Experiment(_cfg(megastep_k=1))._megastep_span(0) == 1
+        assert Experiment(
+            _cfg(megastep_k=4, chunk_rounds=False))._megastep_span(0) == 1
+        # delta codec threads per-iteration carry the scan does not model
+        assert Experiment(
+            _cfg(megastep_k=4, compress_codec="topk"))._megastep_span(0) == 1
+
+    def test_horizon_window_stretches_full_tail(self):
+        exp = Experiment(_cfg(megastep_k=4, concept_drift_algo="win-1"))
+        assert exp.algo.megastep_horizon(0) == 8
+        assert exp.algo.megastep_horizon(5) == 3
+
+    def test_horizon_softcluster_cadence(self):
+        exp = Experiment(_cfg(
+            megastep_k=4, concept_drift_algo="softcluster",
+            concept_drift_algo_arg="H_A_C_1_10_0", concept_num=3,
+            decision_cadence=3))
+        # step t may itself decide; the horizon reaches the NEXT boundary
+        assert exp.algo.megastep_horizon(0) == 3
+        assert exp.algo.megastep_horizon(1) == 2
+        assert exp.algo.megastep_horizon(2) == 1
+        assert exp.algo.megastep_horizon(3) == 3
+        assert exp._megastep_span(0) == 3      # clamped below megastep_k
+        assert exp._megastep_span(1) == 2
+
+    def test_horizon_cadence_one_never_fuses(self):
+        exp = Experiment(_cfg(
+            megastep_k=4, concept_drift_algo="softcluster",
+            concept_drift_algo_arg="H_A_C_1_10_0", concept_num=3))
+        assert exp.algo.megastep_horizon(2) == 1
+        assert exp._megastep_span(2) == 1
+
+    def test_horizon_conservative_default(self):
+        from feddrift_tpu.algorithms.base import DriftAlgorithm
+        # the base contract: algorithms that don't certify decision-free
+        # stretches inherit no fusion at all
+        assert DriftAlgorithm.megastep_horizon.__get__(object())(5) == 1
+
+
+class TestOfferCacheAliasing:
+    """offer_acc_matrix hands the SAME ndarray to every consumer; the
+    frozen-array + identity-key + rebind-invalidation trio keeps one
+    consumer's mutation (or a dataset swap) from corrupting the rest."""
+
+    def test_offered_matrix_is_frozen(self):
+        exp = Experiment(_cfg())
+        m = np.full((exp.pool.num_models, exp.algo.C), 0.5, np.float32)
+        exp.algo.offer_acc_matrix(exp.pool.params, {0: m})
+        got = exp.algo.acc_matrix_at(0)
+        assert got is not m or not got.flags.writeable
+        with pytest.raises(ValueError):
+            got[0, 0] = 0.0
+
+    def test_rebind_invalidates_offer(self):
+        exp = Experiment(_cfg())
+        m = np.full((exp.pool.num_models, exp.algo.C), 0.5, np.float32)
+        exp.algo.offer_acc_matrix(exp.pool.params, {0: m})
+        exp.algo.rebind_data(exp.x, exp.y)
+        assert exp.algo._acc_offer is None
+
+    def test_pool_mutation_misses_cache(self):
+        exp = Experiment(_cfg())
+        m = np.zeros((exp.pool.num_models, exp.algo.C), np.float32)
+        exp.algo.offer_acc_matrix(exp.pool.params, {0: m})
+        # any writeback rebinds pool.params to a new object: identity key
+        exp.pool.params = jax.tree_util.tree_map(lambda l: l + 0,
+                                                 exp.pool.params)
+        fresh = exp.algo.acc_matrix_at(0)
+        assert fresh is not m and float(fresh.max()) > 0.0
+
+
+class TestMegastepRegressAxis:
+    def test_floor_zero_recompile_and_host_overhead_gates(self):
+        from feddrift_tpu.obs.regress import compare
+        base = {"megastep": [
+            {"megastep_k": 1, "rounds_per_sec": 100.0,
+             "steady_recompiles": 0, "host_overhead_frac": 0.8},
+            {"megastep_k": 4, "rounds_per_sec": 160.0,
+             "steady_recompiles": 0, "host_overhead_frac": 0.3}]}
+        ok = compare({"megastep": [
+            {"megastep_k": 1, "rounds_per_sec": 95.0,
+             "steady_recompiles": 0, "host_overhead_frac": 0.82},
+            {"megastep_k": 4, "rounds_per_sec": 150.0,
+             "steady_recompiles": 0, "host_overhead_frac": 0.35}]}, base)
+        ms = {r["metric"]: r for r in ok
+              if r["metric"].startswith("megastep")}
+        assert ms["megastep[4].rounds_per_s"]["status"] == "ok"
+        assert ms["megastep[4].steady_recompiles"]["status"] == "ok"
+        assert ms["megastep[4].host_overhead_frac"]["status"] == "ok"
+        bad = compare({"megastep": [
+            {"megastep_k": 1, "rounds_per_sec": 100.0,
+             "steady_recompiles": 0, "host_overhead_frac": 0.5},
+            {"megastep_k": 4, "rounds_per_sec": 50.0,
+             "steady_recompiles": 1, "host_overhead_frac": 0.6}]}, base)
+        ms = {r["metric"]: r for r in bad
+              if r["metric"].startswith("megastep")}
+        assert ms["megastep[4].rounds_per_s"]["status"] == "regress"
+        # absolute gates: any recompile, or K>1 overhead >= this run's K=1
+        assert ms["megastep[4].steady_recompiles"]["status"] == "regress"
+        assert ms["megastep[4].host_overhead_frac"]["status"] == "regress"
+
+    def test_baseline_without_axis_skips(self):
+        from feddrift_tpu.obs.regress import compare
+        rows = compare({"value": 1.0}, {"value": 1.0, "megastep": [
+            {"megastep_k": 1, "rounds_per_sec": 100.0}]})
+        skips = [r for r in rows if r["metric"] == "megastep"]
+        assert skips and skips[0]["status"] == "skip"

@@ -1,0 +1,117 @@
+"""parallel/mesh.py: device mesh construction and pool sharding layout.
+
+Runs on the harness's 8 virtual CPU devices (conftest forces
+``--xla_force_host_platform_device_count=8``), so 1-D and 2-D
+``(models, clients)`` layouts and real multi-shard placement are
+exercised without TPU hardware.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from feddrift_tpu.parallel.mesh import (
+    client_sharding,
+    constrain_pool,
+    make_mesh,
+    pool_spec,
+    replicate,
+    shard_client_arrays,
+)
+
+
+class TestMakeMesh:
+    def test_default_is_1d_clients_over_all_devices(self):
+        mesh = make_mesh()
+        assert mesh.axis_names == ("clients",)
+        assert mesh.shape["clients"] == len(jax.devices())
+
+    def test_num_devices_slices_prefix(self):
+        mesh = make_mesh(num_devices=4)
+        assert mesh.shape["clients"] == 4
+        assert list(mesh.devices.flat) == jax.devices()[:4]
+
+    def test_2d_shape_layout(self):
+        mesh = make_mesh(shape={"models": 2, "clients": 4})
+        assert mesh.axis_names == ("models", "clients")
+        assert mesh.devices.shape == (2, 4)
+        # row-major fill over the device prefix
+        assert list(mesh.devices.flat) == jax.devices()[:8]
+
+    def test_2d_shape_too_large_raises(self):
+        with pytest.raises(ValueError, match="devices"):
+            make_mesh(shape={"models": 4, "clients": 8})
+
+
+class TestShardingSpecs:
+    def test_client_sharding_rank_and_axis(self):
+        mesh = make_mesh()
+        s = client_sharding(mesh, rank=3, client_axis=0)
+        assert s.spec == P("clients", None, None)
+        s = client_sharding(mesh, rank=4, client_axis=1)
+        assert s.spec == P(None, "clients", None, None)
+
+    def test_shard_client_arrays_places_shards(self):
+        mesh = make_mesh()
+        x = jnp.arange(8 * 3, dtype=jnp.float32).reshape(8, 3)
+        sx = shard_client_arrays(mesh, x)
+        assert isinstance(sx.sharding, NamedSharding)
+        assert sx.sharding.spec == P("clients", None)
+        shards = sx.addressable_shards
+        assert len(shards) == 8 and shards[0].data.shape == (1, 3)
+        np.testing.assert_array_equal(np.asarray(sx), np.asarray(x))
+
+    def test_replicate_commits_full_copy_per_device(self):
+        mesh = make_mesh()
+        tree = {"w": jnp.ones((2, 3)), "b": jnp.zeros(3)}
+        rt = replicate(mesh, tree)
+        for leaf in jax.tree_util.tree_leaves(rt):
+            assert leaf.sharding.spec == P()
+            assert leaf.committed
+            assert all(s.data.shape == leaf.shape
+                       for s in leaf.addressable_shards)
+
+
+class TestPoolSpec:
+    def test_2d_mesh_places_divisible_axes(self):
+        mesh = make_mesh(shape={"models": 2, "clients": 4})
+        assert pool_spec(mesh, (4, 8, 3), model_axis=0, client_axis=1) \
+            == P("models", "clients", None)
+
+    def test_indivisible_axis_degrades_to_replicated(self):
+        mesh = make_mesh(shape={"models": 2, "clients": 4})
+        # M=3 % 2 != 0: models axis must degrade, clients still placed
+        assert pool_spec(mesh, (3, 8), model_axis=0, client_axis=1) \
+            == P(None, "clients")
+        # C=6 % 4 != 0: both degrade
+        assert pool_spec(mesh, (3, 6), model_axis=0, client_axis=1) == P(None, None)
+
+    def test_legacy_1d_mesh_never_places_models(self):
+        mesh = make_mesh()
+        assert pool_spec(mesh, (4, 8), model_axis=0, client_axis=1) \
+            == P(None, "clients")
+
+
+class TestConstrainPool:
+    def test_noop_on_none_and_non_splitting_mesh(self):
+        tree = {"w": jnp.ones((2, 4))}
+        assert constrain_pool(None, tree) is tree
+        # 1-device mesh: an all-replicated constraint would COMMIT outputs
+        # and change downstream jit cache keys — must return unchanged
+        mesh1 = make_mesh(num_devices=1)
+        assert constrain_pool(mesh1, tree) is tree
+
+    def test_2d_mesh_constraint_is_value_preserving(self):
+        mesh = make_mesh(shape={"models": 2, "clients": 4})
+        x = jnp.arange(2 * 8 * 3, dtype=jnp.float32).reshape(2, 8, 3)
+
+        @jax.jit
+        def f(v):
+            return constrain_pool(mesh, v, model_axis=0, client_axis=1)
+
+        out = f(x)
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(x))
+        # jit normalizes away trailing Nones in the propagated spec
+        assert out.sharding.spec == P("models", "clients")
