@@ -333,6 +333,19 @@ def _profile_capture(cfg, profile_dir: str) -> str | None:
         return None
 
 
+def _round_wall_quantiles(instruments: dict) -> dict | None:
+    """Pull the per-round wall-time quantile digest out of a registry
+    snapshot. The runner feeds the ``round_wall_seconds_q`` P² sketch once
+    per iteration regardless of the ops plane, so steady-state p50/p95/p99
+    are always available here; None before any timed iteration landed."""
+    entry = instruments.get("round_wall_seconds_q")
+    if not isinstance(entry, dict):
+        return None
+    q = entry.get("quantiles")
+    return {k: round(v, 6) for k, v in q.items() if v is not None} \
+        if q else None
+
+
 def _measure(cfg, backend: str) -> dict:
     """Run one config to steady state and return its measured numbers."""
     from feddrift_tpu import obs
@@ -407,6 +420,12 @@ def _measure(cfg, backend: str) -> dict:
                      "max_s": round(max(gaps), 6),
                      "iterations": len(gaps)} if gaps else None)
 
+    # Streaming tail latency: the runner feeds a P² sketch per timed
+    # iteration (obs/quantiles.py), so the steady-state p50/p95/p99 of
+    # per-round wall time ride the artifact without sample retention.
+    instruments = obs.registry().snapshot()
+    wall_q = _round_wall_quantiles(instruments)
+
     return {
         "value": round(rps, 3),
         "unit": "rounds/s",
@@ -421,6 +440,8 @@ def _measure(cfg, backend: str) -> dict:
         "hbm_peak_bytes": hbm_peak,
         "host_overhead_frac": host_overhead,
         "dispatch_gap": dispatch_gap,
+        "round_wall_p99_s": (wall_q or {}).get("0.99"),
+        "round_wall_quantiles": wall_q,
         "round_breakdown": (breakdowns[-1] if breakdowns else None),
         "program_costs": {fn: pc.to_event_fields()
                           for fn, pc in costmodel.costs().items()},
@@ -429,7 +450,7 @@ def _measure(cfg, backend: str) -> dict:
         # recompile counts per program, phase_seconds histograms, program
         # cost + hbm_peak_bytes gauges, comm counters when a transport is
         # active (obs/instruments.py).
-        "instruments": obs.registry().snapshot(),
+        "instruments": instruments,
     }
 
 
@@ -597,6 +618,7 @@ def _measure_megastep(cfg, backend: str) -> dict:
     elapsed = time.time() - t0
     rounds = cfg.comm_round * (cfg.train_iterations - start_t)
     hofs = [b["host_overhead_frac"] for b in breakdowns]
+    instruments = obs.registry().snapshot()
     return {
         "value": round(rounds / elapsed, 3),
         "unit": "rounds/s",
@@ -605,7 +627,9 @@ def _measure_megastep(cfg, backend: str) -> dict:
         "final_test_acc": round(float(exp.logger.last("Test/Acc")), 4),
         "host_overhead_frac": (round(sum(hofs) / len(hofs), 6)
                                if hofs else None),
-        "instruments": obs.registry().snapshot(),
+        "round_wall_p99_s": (_round_wall_quantiles(instruments)
+                             or {}).get("0.99"),
+        "instruments": instruments,
     }
 
 

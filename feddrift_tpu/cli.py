@@ -20,6 +20,7 @@ checkpoint.
     python -m feddrift_tpu lineage runs/my-run  # cluster genealogy + oracle ARI
     python -m feddrift_tpu regress bench_new.json --baseline BENCH_r05.json
     python -m feddrift_tpu critical_path runs/my-run  # round segment breakdown
+    python -m feddrift_tpu fleet 127.0.0.1:7777  # live multi-process ops table
 
 Logging is configured in exactly one place (obs.setup_logging), driven by
 the ``--log_level`` flag every subcommand accepts.
@@ -158,6 +159,7 @@ def main(argv: list[str] | None = None) -> int:
     reg_p.add_argument("--tol-acc", type=float, default=None)
     reg_p.add_argument("--tol-compiles", type=float, default=None)
     reg_p.add_argument("--tol-host-overhead", type=float, default=None)
+    reg_p.add_argument("--tol-p99", type=float, default=None)
     reg_p.add_argument("--json", action="store_true")
 
     cp_p = sub.add_parser(
@@ -168,10 +170,21 @@ def main(argv: list[str] | None = None) -> int:
     cp_p.add_argument("run_dir")
     cp_p.add_argument("--json", action="store_true")
 
+    fl_p = sub.add_parser(
+        "fleet",
+        help="render a live multi-process ops table from <ns>/ops/* "
+             "snapshots on a running broker (obs/live.py)")
+    fl_p.add_argument("broker", help="broker address, host:port")
+    fl_p.add_argument("--namespace", default="feddrift")
+    fl_p.add_argument("--duration", type=float, default=5.0)
+    fl_p.add_argument("--poll", type=float, default=0.2)
+    fl_p.add_argument("--min-lanes", type=int, default=0)
+    fl_p.add_argument("--json", action="store_true")
+
     # --log_level is also accepted after the subcommand for convenience
     # (SUPPRESS default: an absent post-subcommand flag must not clobber a
     # pre-subcommand one — both write the same namespace attribute)
-    for p in (run_p, res_p, rep_p, reg_p, lin_p, cp_p):
+    for p in (run_p, res_p, rep_p, reg_p, lin_p, cp_p, fl_p):
         p.add_argument("--log_level", type=str, default=argparse.SUPPRESS,
                        help=argparse.SUPPRESS)
 
@@ -203,7 +216,7 @@ def main(argv: list[str] | None = None) -> int:
         from feddrift_tpu.obs.regress import main as regress_main
         argv_r = [args.candidate, "--baseline", args.baseline]
         for flag in ("tol_rounds", "tol_wall", "tol_acc", "tol_compiles",
-                     "tol_host_overhead"):
+                     "tol_host_overhead", "tol_p99"):
             v = getattr(args, flag)
             if v is not None:
                 argv_r += [f"--{flag.replace('_', '-')}", str(v)]
@@ -215,6 +228,15 @@ def main(argv: list[str] | None = None) -> int:
         # pure host-side: no jax / backend initialisation needed
         from feddrift_tpu.obs.critical_path import main as cp_main
         return cp_main([args.run_dir] + (["--json"] if args.json else []))
+
+    if args.cmd == "fleet":
+        # pure host-side: the netbroker client is stdlib + obs, no jax
+        from feddrift_tpu.obs.live import fleet_main
+        return fleet_main(
+            [args.broker, "--namespace", args.namespace,
+             "--duration", str(args.duration), "--poll", str(args.poll),
+             "--min-lanes", str(args.min_lanes)]
+            + (["--json"] if args.json else []))
 
     if getattr(args, "platform", ""):
         import jax

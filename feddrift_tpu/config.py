@@ -261,6 +261,22 @@ class ExperimentConfig:
     # Size cap (MiB) on events.jsonl / spans.jsonl before rotation to
     # <file>.1 with a loud obs_rotated event; 0 = unbounded (default).
     obs_max_file_mb: float = 0.0
+    # --- live ops plane (obs/live.py; docs/OBSERVABILITY.md) ------------
+    # HTTP ops endpoint (/metrics, /healthz, /status) on a background
+    # thread. 0 = disabled (default, zero hot-path work); -1 = bind an
+    # ephemeral port (tests / several processes on one host — read it
+    # back from Experiment.ops.port); > 0 = that port.
+    ops_port: int = 0
+    # Iterations between local ops_snapshot events while the ops plane
+    # is enabled (the fleet publisher has its own wall-clock cadence).
+    ops_snapshot_every: int = 1
+    # SLO objectives (0 = that objective disabled). Any non-zero value —
+    # or an enabled ops plane — attaches the SLO burn-rate engine to the
+    # event tap; burns emit slo_burn events and append to alerts.jsonl.
+    slo_rounds_per_s: float = 0.0       # throughput floor (rounds/s)
+    slo_host_overhead: float = 0.0      # host_overhead_frac ceiling
+    slo_p99_round_wall_s: float = 0.0   # per-round wall p99 ceiling (s)
+    slo_eval_gap: float = 0.0           # train-test accuracy gap ceiling
 
     def __post_init__(self) -> None:
         if self.population_size == 0 \
@@ -328,6 +344,17 @@ class ExperimentConfig:
             raise ValueError("profile_rounds must be >= 1")
         if self.obs_max_file_mb < 0:
             raise ValueError("obs_max_file_mb must be >= 0")
+        if self.ops_port < -1 or self.ops_port > 65535:
+            raise ValueError("ops_port must be -1 (ephemeral), 0 (off) "
+                             "or a TCP port")
+        if self.ops_snapshot_every < 1:
+            raise ValueError("ops_snapshot_every must be >= 1")
+        for name in ("slo_rounds_per_s", "slo_host_overhead",
+                     "slo_p99_round_wall_s", "slo_eval_gap"):
+            if getattr(self, name) < 0:
+                raise ValueError(f"{name} must be >= 0 (0 disables)")
+        if self.slo_host_overhead > 1.0:
+            raise ValueError("slo_host_overhead is a fraction in (0, 1]")
         if self.hierarchy_edges < 0:
             raise ValueError("hierarchy_edges must be >= 0")
         if self.hierarchy_edges > 0:

@@ -32,6 +32,13 @@ were all invisible. This package is the missing observability layer:
 - ``obs.alerts``      — declarative rule-based health monitor: live as an
   event-bus tap (``cfg.alerts``) and offline via ``report --follow``,
   raising ``alert_raised`` events + ``alerts.jsonl``.
+- ``obs.quantiles``   — streaming P² percentile sketches (O(1) memory)
+  registrable alongside histograms for live p50/p95/p99 gauges.
+- ``obs.live``        — the live ops plane: per-process /metrics,
+  /healthz and /status HTTP endpoints, fleet snapshot publishing +
+  ``FleetCollector`` merge over the broker (CLI: ``python -m
+  feddrift_tpu fleet <broker>``), and an SLO engine whose error-budget
+  burn-rate rules emit ``slo_burn`` events on the live tap.
 
 Event kinds are a CLOSED set (``events.EVENT_KINDS``): ``emit()`` rejects
 unknown kinds, and ``scripts/check_events_schema.py`` statically checks that
@@ -56,9 +63,18 @@ from feddrift_tpu.obs.instruments import (  # noqa: F401
     Registry,
     registry,
 )
-from feddrift_tpu.obs import alerts, costmodel, lineage, spans  # noqa: F401
+from feddrift_tpu.obs import (  # noqa: F401
+    alerts,
+    costmodel,
+    lineage,
+    live,
+    quantiles,
+    spans,
+)
 # (import order: all depend only on obs.events/obs.instruments, bound above;
-# lineage is numpy+stdlib only and alerts touches the bus solely via taps)
+# lineage is numpy+stdlib only, alerts touches the bus solely via taps, and
+# live — the ops-plane HTTP server / fleet publisher / SLO engine — is
+# stdlib + events/instruments/alerts, importing comm transports lazily)
 
 _LOG_FORMAT = "%(asctime)s %(name)s %(levelname)s %(message)s"
 

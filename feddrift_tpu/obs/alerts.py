@@ -265,9 +265,16 @@ class AlertMonitor:
         except Exception:
             pass
         if self.path:
-            os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
-            with open(self.path, "a") as f:
-                f.write(json.dumps(rec, default=_json_default) + "\n")
+            append_alert(self.path, rec)
+
+
+def append_alert(path: str, rec: dict) -> None:
+    """Append one record to an alerts.jsonl sink (open-append-close, so
+    concurrent writers — the alert monitor and the SLO engine in
+    obs/live.py — interleave whole lines, never partial ones)."""
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    with open(path, "a") as f:
+        f.write(json.dumps(rec, default=_json_default) + "\n")
 
 
 def _json_default(o):

@@ -104,6 +104,9 @@ EVENT_KINDS = frozenset({
     # obs/events.py + obs/spans.py rotation)
     "round_breakdown",      # per-iteration segment split + dispatch gap
     "obs_rotated",          # a size-capped JSONL sink rotated a generation
+    # live ops plane (obs/live.py)
+    "ops_snapshot",         # periodic per-process metric+health snapshot
+    "slo_burn",             # SLO error-budget burn-rate rule fired
 })
 
 RING_SIZE = 4096

@@ -31,6 +31,8 @@ import os
 import threading
 from typing import Any
 
+from feddrift_tpu.obs.quantiles import DEFAULT_QUANTILES, QuantileSketch
+
 DEFAULT_BUCKETS = (1e-4, 1e-3, 1e-2, 0.1, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0,
                    100.0)
 
@@ -104,10 +106,19 @@ def _series_key(name: str, labels: dict[str, str]) -> tuple:
     return (name, tuple(sorted(labels.items())))
 
 
+def _escape_label_value(v: str) -> str:
+    """Prometheus exposition-format escaping: backslash, double quote and
+    newline must be escaped inside a label value (in that order — the
+    backslash first, or it re-escapes the others)."""
+    return str(v).replace("\\", "\\\\").replace('"', '\\"') \
+                 .replace("\n", "\\n")
+
+
 def _label_str(labels: tuple) -> str:
     if not labels:
         return ""
-    return "{" + ",".join(f'{k}="{v}"' for k, v in labels) + "}"
+    return "{" + ",".join(f'{k}="{_escape_label_value(v)}"'
+                          for k, v in labels) + "}"
 
 
 class Registry:
@@ -142,6 +153,15 @@ class Registry:
                   **labels: str) -> Histogram:
         return self._get(Histogram, name, labels, buckets=buckets)
 
+    def quantile_sketch(self, name: str,
+                        quantiles: tuple = DEFAULT_QUANTILES,
+                        **labels: str) -> QuantileSketch:
+        """Streaming P² percentile sketch (obs/quantiles.py) — exports
+        summary-style ``name{quantile="0.99"}`` lines. A sketch and a
+        histogram cannot share a name (Prometheus types collide); the
+        convention is a ``_q`` suffix on the sketch."""
+        return self._get(QuantileSketch, name, labels, quantiles=quantiles)
+
     def reset(self) -> None:
         """Drop every series (benchmarks reset between measurements so
         snapshots are per-measurement, not cumulative)."""
@@ -150,47 +170,72 @@ class Registry:
 
     # -- export ---------------------------------------------------------
     def snapshot(self) -> dict:
-        """{"name{label=...}": value-or-histogram-dict}, JSON-ready."""
+        """{"name{label=...}": value-or-histogram-dict}, JSON-ready.
+        Every read takes the instrument lock so a concurrent observe can
+        never yield a torn (count vs. sum vs. buckets) view."""
         with self._lock:
             items = sorted(self._series.items())
         out: dict[str, Any] = {}
         for (name, labels), inst in items:
             key = name + _label_str(labels)
-            if isinstance(inst, Histogram):
+            if isinstance(inst, (Histogram, QuantileSketch)):
                 out[key] = inst.snapshot()
             else:
-                out[key] = inst.value
+                with inst._lock:
+                    out[key] = inst.value
         return out
 
     def to_prometheus_text(self) -> str:
         """node-exporter textfile-collector format (untyped TYPE lines are
         omitted for gauges/counters whose kind is in the name; histograms
-        render the standard _bucket/_sum/_count triplet)."""
+        render the standard _bucket/_sum/_count triplet; quantile
+        sketches render summary-style quantile/_sum/_count lines).
+        Histogram state is copied under the instrument lock first — the
+        cumulative buckets, _sum and _count of one series always describe
+        the same set of observations."""
         with self._lock:
             items = sorted(self._series.items())
         lines: list[str] = []
         typed: set[str] = set()
         for (name, labels), inst in items:
             if isinstance(inst, Histogram):
+                with inst._lock:
+                    bucket_counts = list(inst.bucket_counts)
+                    hsum, hcount = inst.sum, inst.count
                 if name not in typed:
                     lines.append(f"# TYPE {name} histogram")
                     typed.add(name)
                 cum = 0
                 for i, bound in enumerate(inst.bounds):
-                    cum += inst.bucket_counts[i]
+                    cum += bucket_counts[i]
                     ls = _label_str(labels + (("le", repr(bound)),))
                     lines.append(f"{name}_bucket{ls} {cum}")
-                cum += inst.bucket_counts[-1]
+                cum += bucket_counts[-1]
                 ls = _label_str(labels + (("le", "+Inf"),))
                 lines.append(f"{name}_bucket{ls} {cum}")
-                lines.append(f"{name}_sum{_label_str(labels)} {inst.sum}")
-                lines.append(f"{name}_count{_label_str(labels)} {inst.count}")
+                lines.append(f"{name}_sum{_label_str(labels)} {hsum}")
+                lines.append(f"{name}_count{_label_str(labels)} {hcount}")
+            elif isinstance(inst, QuantileSketch):
+                snap = inst.snapshot()
+                if name not in typed:
+                    lines.append(f"# TYPE {name} summary")
+                    typed.add(name)
+                for qs, qv in snap["quantiles"].items():
+                    if qv is None:
+                        continue
+                    ls = _label_str(labels + (("quantile", qs),))
+                    lines.append(f"{name}{ls} {qv}")
+                lines.append(f"{name}_sum{_label_str(labels)} {snap['sum']}")
+                lines.append(
+                    f"{name}_count{_label_str(labels)} {snap['count']}")
             else:
                 kind = "counter" if isinstance(inst, Counter) else "gauge"
                 if name not in typed:
                     lines.append(f"# TYPE {name} {kind}")
                     typed.add(name)
-                lines.append(f"{name}{_label_str(labels)} {inst.value}")
+                with inst._lock:
+                    val = inst.value
+                lines.append(f"{name}{_label_str(labels)} {val}")
         return "\n".join(lines) + ("\n" if lines else "")
 
     def write_textfile(self, path: str) -> None:

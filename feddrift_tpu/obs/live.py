@@ -1,0 +1,795 @@
+"""Live ops plane: /metrics + /healthz HTTP endpoints, fleet snapshot
+publishing over the broker, and an SLO burn-rate engine on the event tap.
+
+Everything observability built before this module is post-hoc: the
+Prometheus exporter writes a textfile, ``report --follow`` tails one
+file, ``critical_path`` replays a finished run. This module operates a
+*running* process:
+
+- ``OpsServer`` — a stdlib ``ThreadingHTTPServer`` on a background
+  daemon thread (off the hot path; enabled via ``cfg.ops_port``, 0 =
+  disabled) serving
+
+  * ``/metrics``  — the live ``Registry.to_prometheus_text()`` (same
+    exporter as the per-iteration ``metrics.prom`` textfile, minus the
+    file),
+  * ``/healthz``  — liveness: last-iteration beat age, broker-connection
+    state aggregated from every live ``ReconnectingBrokerClient``
+    (their heartbeat loopbacks), and active SLO burns; HTTP 503 when
+    degraded,
+  * ``/status``   — a JSON run summary (iteration, rounds/s,
+    ``num_models``, live ``oracle_ari``, active alerts, live p50/p95/p99
+    digests).
+
+- the **fleet plane** — each process publishes periodic metric+health
+  snapshots on ``<ns>/ops/<lane>`` broker topics (``OpsPublisher``),
+  announcing its lane on ``<ns>/ops/announce`` so a ``FleetCollector``
+  can discover and merge them; ``python -m feddrift_tpu fleet
+  <host:port>`` renders the merged multi-process table.
+
+- the **SLO engine** — declarative windowed objectives (rounds/s floor,
+  ``host_overhead_frac`` ceiling, per-round wall ceiling, eval gap,
+  broker liveness) with error-budget burn-rate rules, evaluated live on
+  the event-bus tap (not file replay). A burning objective emits an
+  ``slo_burn`` event, increments ``slo_burns{slo=...}`` and appends to
+  the same ``alerts.jsonl`` the alert monitor uses
+  (``obs.alerts.append_alert``).
+
+The module is stdlib + obs.events/instruments/alerts only; the broker
+client for the ``fleet`` CLI verb is imported lazily, so the verb stays
+jax-free (routable before backend init like ``report``/``regress``).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import os
+import socket
+import threading
+import time
+import weakref
+from dataclasses import dataclass
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Optional
+
+from feddrift_tpu.obs import alerts as obs_alerts
+from feddrift_tpu.obs.events import emit
+from feddrift_tpu.obs.instruments import registry
+
+log = logging.getLogger("feddrift_tpu")
+
+OPS_NAMESPACE = "feddrift"
+
+
+# ----------------------------------------------------------------------
+# process status board: the single source /status, /healthz and fleet
+# snapshots read. Fed by StatusTap (event-driven) or directly.
+class StatusBoard:
+    """Thread-safe latest-value store for the process's run state plus
+    the last-iteration beat (monotonic, for /healthz age)."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._fields: dict = {}
+        self._beat_mono: Optional[float] = None
+        self._beat_iteration: Optional[int] = None
+
+    def beat(self, iteration: Optional[int] = None) -> None:
+        with self._lock:
+            self._beat_mono = time.monotonic()
+            if iteration is not None:
+                self._beat_iteration = iteration
+
+    def update(self, **fields) -> None:
+        with self._lock:
+            self._fields.update(fields)
+
+    def fields(self) -> dict:
+        with self._lock:
+            out = dict(self._fields)
+            if self._beat_iteration is not None:
+                out.setdefault("iteration", self._beat_iteration)
+            return out
+
+    def last_iteration_age(self) -> Optional[float]:
+        with self._lock:
+            if self._beat_mono is None:
+                return None
+            return time.monotonic() - self._beat_mono
+
+    def reset(self) -> None:
+        with self._lock:
+            self._fields.clear()
+            self._beat_mono = None
+            self._beat_iteration = None
+
+
+_status = StatusBoard()
+
+
+def status_board() -> StatusBoard:
+    return _status
+
+
+class StatusTap:
+    """EventBus tap feeding the status board: iteration_end beats +
+    rounds/s, cluster_state num_models, cluster_assign oracle ARI."""
+
+    def __init__(self, board: Optional[StatusBoard] = None) -> None:
+        self.board = board if board is not None else _status
+
+    def attach(self, bus) -> "StatusTap":
+        bus.add_tap(self.observe)
+        return self
+
+    def observe(self, rec: dict) -> None:
+        kind = rec.get("kind")
+        if kind == "iteration_end":
+            self.board.beat(rec.get("iteration"))
+            self.board.update(
+                rounds_per_s=rec.get("rounds_per_s"),
+                test_acc=rec.get("test_acc"),
+                last_iteration_wall_s=rec.get("wall_s"))
+        elif kind == "cluster_state":
+            self.board.update(num_models=rec.get("num_models"))
+        elif kind == "cluster_assign":
+            if rec.get("oracle_ari") is not None:
+                self.board.update(oracle_ari=rec.get("oracle_ari"))
+        elif kind == "run_start":
+            self.board.beat(rec.get("iteration"))
+            self.board.update(num_models=rec.get("num_models"),
+                              run_phase="running")
+        elif kind == "run_end":
+            self.board.update(run_phase="done")
+
+
+# ----------------------------------------------------------------------
+# broker-connection health: every ReconnectingBrokerClient registers
+# itself here (weakly) so /healthz can aggregate heartbeat liveness.
+_BROKER_CLIENTS: "weakref.WeakSet" = weakref.WeakSet()
+
+
+def register_broker_client(client) -> None:
+    _BROKER_CLIENTS.add(client)
+
+
+def broker_health() -> dict:
+    detail = []
+    for c in list(_BROKER_CLIENTS):
+        try:
+            if getattr(c, "_closed", False):
+                continue
+            detail.append(c.health())
+        except Exception:           # a half-torn-down client must not 500
+            continue
+    return {
+        "clients": len(detail),
+        "healthy": all(h.get("healthy") for h in detail) if detail else True,
+        "reconnects": sum(h.get("reconnects") or 0 for h in detail),
+        "detail": detail,
+    }
+
+
+# ----------------------------------------------------------------------
+# SLO engine: declarative windowed objectives + burn-rate rules.
+@dataclass
+class SLObjective:
+    """One service-level objective over a stream of event-derived samples.
+
+    ``value(rec)`` extracts a sample from a triggering event (None =
+    no sample). ``direction`` says which side violates: ``"max"`` —
+    value above ``objective`` is a violation; ``"min"`` — below. The
+    error budget allows ``budget_frac`` of the window to violate; the
+    rule *burns* when the observed violating fraction reaches
+    ``budget_frac * burn_rate`` (with ``budget_frac == 0`` any violation
+    burns, and a healthy sample resets the window — incident mode)."""
+
+    name: str
+    kinds: tuple
+    value: Callable[[dict], Optional[float]]
+    objective: float
+    direction: str = "max"
+    window: int = 20
+    budget_frac: float = 0.1
+    burn_rate: float = 2.0
+    min_samples: int = 5
+    cooldown_s: float = 30.0
+    severity: str = "warn"
+    description: str = ""
+
+    def __post_init__(self) -> None:
+        if self.direction not in ("max", "min"):
+            raise ValueError(f"direction must be max|min, got "
+                             f"{self.direction!r}")
+        if self.window < 1 or self.min_samples < 1:
+            raise ValueError("window and min_samples must be >= 1")
+
+
+def default_slos(rounds_per_s: float = 0.0,
+                 host_overhead: float = 0.0,
+                 p99_round_wall_s: float = 0.0,
+                 eval_gap: float = 0.0) -> list:
+    """The runner's objective set; a threshold of 0 disables that
+    objective. Broker liveness is always on (it only samples on
+    heartbeat/reconnect events, so it is free otherwise)."""
+    objs = [
+        SLObjective(
+            "broker_liveness", ("heartbeat_missed", "conn_reconnect"),
+            lambda r: 1.0 if r.get("kind") == "heartbeat_missed" else 0.0,
+            objective=0.5, direction="max", window=8, budget_frac=0.0,
+            burn_rate=1.0, min_samples=1, cooldown_s=5.0, severity="crit",
+            description="broker heartbeat loopback went silent"),
+    ]
+    if rounds_per_s > 0:
+        objs.append(SLObjective(
+            "rounds_per_s_floor", ("iteration_end",),
+            lambda r: r.get("rounds_per_s"),
+            objective=rounds_per_s, direction="min", window=12,
+            budget_frac=0.25, burn_rate=2.0, min_samples=4,
+            cooldown_s=30.0, severity="warn",
+            description="sustained rounds/s below the throughput floor"))
+    if host_overhead > 0:
+        objs.append(SLObjective(
+            "host_overhead_ceiling", ("round_breakdown",),
+            lambda r: r.get("host_overhead_frac"),
+            objective=host_overhead, direction="max", window=12,
+            budget_frac=0.25, burn_rate=2.0, min_samples=4,
+            cooldown_s=30.0, severity="warn",
+            description="host_overhead_frac persistently above ceiling"))
+    if p99_round_wall_s > 0:
+        objs.append(SLObjective(
+            "p99_round_wall", ("round_breakdown",),
+            lambda r: (r["wall_s"] / max(r.get("rounds") or 1, 1)
+                       if r.get("wall_s") is not None else None),
+            objective=p99_round_wall_s, direction="max", window=64,
+            budget_frac=0.01, burn_rate=5.0, min_samples=8,
+            cooldown_s=30.0, severity="crit",
+            description="per-round wall tail above the p99 objective"))
+    if eval_gap > 0:
+        objs.append(SLObjective(
+            "eval_gap", ("eval",),
+            lambda r: (r["train_acc"] - r["test_acc"]
+                       if r.get("train_acc") is not None
+                       and r.get("test_acc") is not None else None),
+            objective=eval_gap, direction="max", window=6,
+            budget_frac=0.34, burn_rate=1.5, min_samples=2,
+            cooldown_s=60.0, severity="warn",
+            description="train-test accuracy gap above objective"))
+    return objs
+
+
+class SLOEngine:
+    """Evaluates SLObjectives live on the event tap; burning objectives
+    emit ``slo_burn`` (cooldown-limited) and stay listed in ``active()``
+    until a window evaluation clears them."""
+
+    def __init__(self, objectives: Optional[list] = None,
+                 path: Optional[str] = None, bus=None,
+                 time_fn: Callable[[], float] = time.time) -> None:
+        import collections
+        self.objectives = objectives if objectives is not None \
+            else default_slos()
+        self.path = path
+        self.bus = bus
+        self._time = time_fn
+        self._lock = threading.RLock()
+        self._win = {o.name: collections.deque(maxlen=o.window)
+                     for o in self.objectives}
+        self._active: dict[str, dict] = {}
+        self._last_fired: dict[str, float] = {}
+        self.burns: list[dict] = []
+        self._by_kind: dict[str, list] = {}
+        for o in self.objectives:
+            for k in o.kinds:
+                self._by_kind.setdefault(k, []).append(o)
+
+    def attach(self, bus) -> "SLOEngine":
+        self.bus = bus
+        bus.add_tap(self.observe)
+        return self
+
+    def observe(self, rec: dict) -> None:
+        kind = rec.get("kind")
+        objs = self._by_kind.get(kind)
+        if not objs or kind in ("slo_burn", "alert_raised"):
+            return
+        now = rec.get("_ts") or self._time()
+        with self._lock:
+            for obj in objs:
+                try:
+                    v = obj.value(rec)
+                except (KeyError, TypeError):
+                    v = None
+                if v is None:
+                    continue
+                violating = (v > obj.objective if obj.direction == "max"
+                             else v < obj.objective)
+                win = self._win[obj.name]
+                if obj.budget_frac == 0.0 and not violating:
+                    win.clear()       # incident mode: healthy sample heals
+                win.append(1 if violating else 0)
+                frac = sum(win) / len(win)
+                burn_at = (obj.budget_frac * obj.burn_rate
+                           if obj.budget_frac > 0 else 1e-9)
+                burning = len(win) >= obj.min_samples and frac >= burn_at
+                if not burning:
+                    self._active.pop(obj.name, None)
+                    continue
+                summary = {
+                    "slo": obj.name, "severity": obj.severity,
+                    "objective": obj.objective,
+                    "direction": obj.direction,
+                    "observed": round(float(v), 6),
+                    "window": len(win), "violations": int(sum(win)),
+                    "burn_frac": round(frac, 4),
+                    "budget_frac": obj.budget_frac,
+                    "burn_rate": obj.burn_rate,
+                    "description": obj.description,
+                }
+                self._active[obj.name] = summary
+                last = self._last_fired.get(obj.name)
+                if last is not None and now - last < obj.cooldown_s:
+                    continue
+                self._last_fired[obj.name] = now
+                self._fire(summary, rec)
+
+    def _fire(self, summary: dict, trigger: dict) -> None:
+        fields = {**summary, "rule": f"slo:{summary['slo']}",
+                  "trigger_kind": trigger.get("kind")}
+        if self.bus is not None:
+            burn = self.bus.emit("slo_burn", **fields)
+        else:
+            burn = {"_ts": self._time(), "kind": "slo_burn",
+                    "iteration": trigger.get("iteration"), **fields}
+        self.burns.append(burn)
+        try:
+            registry().counter("slo_burns", slo=summary["slo"]).inc()
+        except Exception:
+            pass
+        if self.path:
+            obs_alerts.append_alert(self.path, burn)
+        log.warning("SLO burn: %s (observed=%s objective=%s, %d/%d "
+                    "window violations)", summary["slo"],
+                    summary["observed"], summary["objective"],
+                    summary["violations"], summary["window"])
+
+    def active(self) -> list:
+        with self._lock:
+            return [dict(v) for v in self._active.values()]
+
+
+# ----------------------------------------------------------------------
+# health + status documents (shared by /healthz, /status and fleet
+# snapshots)
+def health_snapshot(slo: Optional[SLOEngine] = None,
+                    stall_after_s: float = 0.0,
+                    board: Optional[StatusBoard] = None) -> dict:
+    board = board if board is not None else _status
+    age = board.last_iteration_age()
+    brokers = broker_health()
+    active = slo.active() if slo is not None else []
+    degraded = []
+    if brokers["clients"] and not brokers["healthy"]:
+        degraded.append("broker")
+    if any(a.get("severity") == "crit" for a in active):
+        degraded.append("slo_burn")
+    if stall_after_s > 0 and age is not None and age > stall_after_s:
+        degraded.append("stalled")
+    return {
+        "status": "degraded" if degraded else "ok",
+        "degraded": degraded,
+        "last_iteration_age_s": round(age, 3) if age is not None else None,
+        "broker": brokers,
+        "active_alerts": active,
+        "pid": os.getpid(),
+    }
+
+
+def _quantile_digests(reg=None) -> dict:
+    """Live p50/p95/p99 digests: every registered QuantileSketch series
+    (snapshot keys carrying a quantiles sub-dict)."""
+    snap = (reg if reg is not None else registry()).snapshot()
+    return {k: v["quantiles"] for k, v in snap.items()
+            if isinstance(v, dict) and "quantiles" in v}
+
+
+def status_snapshot(slo: Optional[SLOEngine] = None,
+                    board: Optional[StatusBoard] = None,
+                    reg=None) -> dict:
+    board = board if board is not None else _status
+    doc = board.fields()
+    doc["active_alerts"] = slo.active() if slo is not None else []
+    doc["quantiles"] = _quantile_digests(reg)
+    doc["pid"] = os.getpid()
+    return doc
+
+
+_METRIC_PREFIXES = (
+    "broker_", "client_", "comm_bytes", "stragglers_masked",
+    "rounds_degraded", "host_overhead_frac", "round_wall_seconds_q",
+    "dispatch_gap_seconds_q", "num_models", "alerts_raised", "slo_burns",
+    "heartbeats_missed", "edge_", "publish_retries",
+)
+
+
+def snapshot_fields(lane: str, reg=None, slo: Optional[SLOEngine] = None,
+                    board: Optional[StatusBoard] = None,
+                    prefixes: tuple = _METRIC_PREFIXES,
+                    extra: Optional[dict] = None) -> dict:
+    """One fleet snapshot: lane identity + status + health + a filtered
+    metric subset (full registry snapshots carry per-phase histograms —
+    too heavy to ship every couple of seconds)."""
+    reg = reg if reg is not None else registry()
+    metrics = {k: v for k, v in reg.snapshot().items()
+               if k.startswith(prefixes)}
+    snap = {
+        "lane": lane,
+        "pid": os.getpid(),
+        "ts": round(time.time(), 3),
+        "status": status_snapshot(slo=slo, board=board, reg=reg),
+        "health": health_snapshot(slo=slo, board=board),
+        "metrics": metrics,
+    }
+    if extra:
+        snap["extra"] = extra
+    return snap
+
+
+def emit_snapshot(lane: str, seq: int = 0,
+                  slo: Optional[SLOEngine] = None,
+                  board: Optional[StatusBoard] = None) -> dict:
+    """Record a lean ops_snapshot event locally (the runner's snapshot
+    cadence and every fleet publish go through here)."""
+    board = board if board is not None else _status
+    fields = board.fields()
+    digests = _quantile_digests()
+    p99 = (digests.get("round_wall_seconds_q") or {}).get("0.99")
+    return emit(
+        "ops_snapshot", lane=lane, seq=seq,
+        health=health_snapshot(slo=slo, board=board)["status"],
+        rounds_per_s=fields.get("rounds_per_s"),
+        round_wall_p99_s=p99,
+        active_alerts=len(slo.active()) if slo is not None else 0)
+
+
+# ----------------------------------------------------------------------
+# the HTTP ops server
+class _OpsHandler(BaseHTTPRequestHandler):
+    server_version = "feddrift-ops/1"
+
+    def log_message(self, fmt, *args):  # noqa: N802 - stdlib API
+        log.debug("ops %s " + fmt, self.client_address[0], *args)
+
+    def _send(self, code: int, body: bytes, ctype: str) -> None:
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self):  # noqa: N802 - stdlib API
+        ops = self.server.ops                       # type: ignore[attr-defined]
+        path = self.path.split("?", 1)[0].rstrip("/") or "/"
+        try:
+            if path == "/metrics":
+                body = ops.reg.to_prometheus_text().encode()
+                self._send(200, body,
+                           "text/plain; version=0.0.4; charset=utf-8")
+            elif path == "/healthz":
+                doc = health_snapshot(slo=ops.slo,
+                                      stall_after_s=ops.stall_after_s,
+                                      board=ops.board)
+                code = 200 if doc["status"] == "ok" else 503
+                self._send(code, _json_bytes(doc), "application/json")
+            elif path in ("/", "/status"):
+                doc = status_snapshot(slo=ops.slo, board=ops.board,
+                                      reg=ops.reg)
+                self._send(200, _json_bytes(doc), "application/json")
+            else:
+                self._send(404, b'{"error": "not found"}',
+                           "application/json")
+        except (BrokenPipeError, ConnectionResetError):
+            pass
+        except Exception as exc:        # never let a scrape kill the thread
+            try:
+                self._send(500, _json_bytes({"error": str(exc)}),
+                           "application/json")
+            except OSError:
+                pass
+
+
+def _json_bytes(doc: dict) -> bytes:
+    return json.dumps(doc, default=obs_alerts._json_default).encode()
+
+
+class OpsServer:
+    """Per-process ops endpoint host. ``port=0`` binds an ephemeral port
+    (read it back from ``.port``); the serving loop and every request run
+    on daemon threads, entirely off the training hot path."""
+
+    def __init__(self, port: int = 0, host: str = "127.0.0.1",
+                 reg=None, slo: Optional[SLOEngine] = None,
+                 board: Optional[StatusBoard] = None,
+                 stall_after_s: float = 0.0) -> None:
+        self.reg = reg if reg is not None else registry()
+        self.slo = slo
+        self.board = board if board is not None else _status
+        self.stall_after_s = stall_after_s
+        self._httpd = ThreadingHTTPServer((host, port), _OpsHandler)
+        self._httpd.daemon_threads = True
+        self._httpd.ops = self          # type: ignore[attr-defined]
+        self.host = host
+        self.port = self._httpd.server_address[1]
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> "OpsServer":
+        # Long poll interval on purpose: select() wakes instantly for an
+        # incoming request regardless, so the interval only bounds how
+        # fast serve_forever notices shutdown() — and on a single-core
+        # host every idle wakeup preempts the training thread (a 0.2s
+        # interval measurably costs rounds/s; see perf_gate stage 7).
+        # close() pokes the socket so shutdown stays fast anyway.
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, kwargs={"poll_interval": 30.0},
+            daemon=True, name=f"ops-server:{self.port}")
+        self._thread.start()
+        log.info("ops server listening on http://%s:%d "
+                 "(/metrics /healthz /status)", self.host, self.port)
+        return self
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    def close(self) -> None:
+        if self._thread is not None:
+            stopper = threading.Thread(target=self._httpd.shutdown,
+                                       daemon=True)
+            stopper.start()
+            # shutdown() only takes effect when the serve loop's select()
+            # returns; connect to our own socket so it returns now instead
+            # of after the (long) poll interval.
+            deadline = time.time() + 5.0
+            while stopper.is_alive() and time.time() < deadline:
+                try:
+                    socket.create_connection(
+                        (self.host, self.port), timeout=0.2).close()
+                except OSError:
+                    pass
+                stopper.join(timeout=0.1)
+            stopper.join(timeout=1.0)
+            self._thread.join(timeout=2)
+            self._thread = None
+        self._httpd.server_close()
+
+
+# ----------------------------------------------------------------------
+# fleet plane: per-process snapshot publishing + collector merge
+def ops_topic(namespace: str, lane: str) -> str:
+    return f"{namespace}/ops/{lane}"
+
+
+def announce_topic(namespace: str) -> str:
+    return f"{namespace}/ops/announce"
+
+
+class OpsPublisher:
+    """Publishes this process's snapshot on ``<ns>/ops/<lane>`` every
+    ``interval_s`` (daemon thread), announcing the lane on
+    ``<ns>/ops/announce`` so collectors can discover it. Works over any
+    Broker-interface client; publish failures on a dying bare client are
+    swallowed (a reconnecting client buffers them itself)."""
+
+    def __init__(self, client, lane: str,
+                 namespace: str = OPS_NAMESPACE, interval_s: float = 2.0,
+                 reg=None, slo: Optional[SLOEngine] = None,
+                 board: Optional[StatusBoard] = None,
+                 extra_fn: Optional[Callable[[], dict]] = None) -> None:
+        self.client = client
+        self.lane = lane
+        self.namespace = namespace
+        self.interval_s = interval_s
+        self.reg = reg
+        self.slo = slo
+        self.board = board
+        self.extra_fn = extra_fn
+        self.seq = 0
+        self._closed = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def publish_now(self) -> dict:
+        self.seq += 1
+        extra = None
+        if self.extra_fn is not None:
+            try:
+                extra = self.extra_fn()
+            except Exception:
+                extra = None
+        snap = snapshot_fields(self.lane, reg=self.reg, slo=self.slo,
+                               board=self.board, extra=extra)
+        snap["seq"] = self.seq
+        try:
+            self.client.publish(announce_topic(self.namespace),
+                                json.dumps({"lane": self.lane}))
+            self.client.publish(ops_topic(self.namespace, self.lane),
+                                json.dumps(
+                                    snap, default=obs_alerts._json_default))
+        except (OSError, RuntimeError):
+            pass                        # dead bare client; next tick retries
+        emit_snapshot(self.lane, seq=self.seq, slo=self.slo,
+                      board=self.board)
+        return snap
+
+    def _loop(self) -> None:
+        while not self._closed.wait(self.interval_s):
+            self.publish_now()
+
+    def start(self) -> "OpsPublisher":
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True,
+            name=f"ops-publisher:{self.lane}")
+        self._thread.start()
+        return self
+
+    def close(self) -> None:
+        self._closed.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+
+class FleetCollector:
+    """Merges fleet snapshots by process lane: subscribes the announce
+    topic, subscribes each announced lane's ops topic, and keeps the
+    latest snapshot per lane. Poll-driven (no threads of its own)."""
+
+    def __init__(self, client, namespace: str = OPS_NAMESPACE) -> None:
+        self.client = client
+        self.namespace = namespace
+        self.lanes: dict[str, dict] = {}
+        self._announce_q = client.subscribe(announce_topic(namespace))
+        self._lane_qs: dict[str, object] = {}
+
+    @staticmethod
+    def _drain(q) -> list:
+        import queue as _queue
+        out = []
+        while True:
+            try:
+                out.append(q.get_nowait())
+            except _queue.Empty:
+                return out
+
+    def poll(self) -> dict:
+        for raw in self._drain(self._announce_q):
+            try:
+                lane = json.loads(raw).get("lane")
+            except (ValueError, AttributeError):
+                continue
+            if lane and lane not in self._lane_qs:
+                self._lane_qs[lane] = self.client.subscribe(
+                    ops_topic(self.namespace, lane))
+        for lane, q in self._lane_qs.items():
+            for raw in self._drain(q):
+                try:
+                    snap = json.loads(raw)
+                except ValueError:
+                    continue
+                prev = self.lanes.get(lane)
+                if prev is None or snap.get("seq", 0) >= prev.get("seq", 0):
+                    self.lanes[lane] = snap
+        return self.lanes
+
+    def collect(self, duration_s: float = 5.0, poll_s: float = 0.2,
+                min_lanes: int = 0) -> dict:
+        """Poll for up to ``duration_s``; returns early once
+        ``min_lanes`` distinct lanes reported (0 = wait the full
+        bound)."""
+        deadline = time.monotonic() + duration_s
+        while time.monotonic() < deadline:
+            self.poll()
+            if min_lanes and len(self.lanes) >= min_lanes:
+                break
+            time.sleep(poll_s)
+        return self.poll()
+
+
+def _fmt(v, nd=3) -> str:
+    if v is None:
+        return "-"
+    if isinstance(v, float):
+        return f"{v:.{nd}f}"
+    return str(v)
+
+
+def _metric(snap: dict, prefix: str):
+    """Sum every metric series of one name across label sets (e.g.
+    client_bytes_out{transport=...})."""
+    total, seen = 0.0, False
+    for k, v in (snap.get("metrics") or {}).items():
+        if k == prefix or k.startswith(prefix + "{"):
+            if isinstance(v, (int, float)):
+                total, seen = total + v, True
+    return total if seen else None
+
+
+def _sketch_q(snap: dict, name: str, q: str):
+    for k, v in (snap.get("metrics") or {}).items():
+        if (k == name or k.startswith(name + "{")) and isinstance(v, dict):
+            qv = (v.get("quantiles") or {}).get(q)
+            if qv is not None:
+                return qv
+    return None
+
+
+def render_fleet(lanes: dict) -> str:
+    """The merged multi-process table the ``fleet`` CLI verb prints."""
+    cols = ("LANE", "PID", "ITER", "ROUNDS/S", "P99 WALL", "BYTES OUT",
+            "STRAGGLERS", "RECONNECTS", "ALERTS", "HEALTH")
+    rows = []
+    for lane in sorted(lanes):
+        snap = lanes[lane]
+        st = snap.get("status") or {}
+        health = snap.get("health") or {}
+        bytes_out = _metric(snap, "client_bytes_out")
+        if bytes_out is None:
+            bytes_out = _metric(snap, "broker_bytes_out")
+        rows.append((
+            lane,
+            _fmt(snap.get("pid")),
+            _fmt(st.get("iteration")),
+            _fmt(st.get("rounds_per_s")),
+            _fmt(_sketch_q(snap, "round_wall_seconds_q", "0.99"), 4),
+            _fmt(int(bytes_out) if bytes_out is not None else None),
+            _fmt(_metric(snap, "stragglers_masked")),
+            _fmt((health.get("broker") or {}).get("reconnects")),
+            _fmt(len(st.get("active_alerts") or [])),
+            health.get("status", "-"),
+        ))
+    widths = [max(len(c), *(len(r[i]) for r in rows)) if rows else len(c)
+              for i, c in enumerate(cols)]
+    lines = ["  ".join(c.ljust(widths[i]) for i, c in enumerate(cols))]
+    for r in rows:
+        lines.append("  ".join(v.ljust(widths[i]) for i, v in enumerate(r)))
+    if not rows:
+        lines.append("(no lanes reported)")
+    return "\n".join(lines)
+
+
+def fleet_main(argv=None) -> int:
+    """``python -m feddrift_tpu fleet <host:port>`` — collect fleet
+    snapshots from a live broker and render the merged table. Pure
+    host-side (no jax/backend initialisation)."""
+    ap = argparse.ArgumentParser(
+        prog="python -m feddrift_tpu fleet",
+        description="render a live multi-process ops table from "
+                    "<ns>/ops/* broker snapshots")
+    ap.add_argument("broker", help="broker address, host:port")
+    ap.add_argument("--namespace", default=OPS_NAMESPACE)
+    ap.add_argument("--duration", type=float, default=5.0,
+                    help="collection bound in seconds (default 5)")
+    ap.add_argument("--poll", type=float, default=0.2)
+    ap.add_argument("--min-lanes", type=int, default=0,
+                    help="return as soon as this many lanes reported")
+    ap.add_argument("--json", action="store_true",
+                    help="print merged snapshots as JSON instead")
+    args = ap.parse_args(argv)
+    host, _, port = args.broker.rpartition(":")
+    if not port.isdigit():
+        ap.error(f"broker must be host:port, got {args.broker!r}")
+    from feddrift_tpu.comm.netbroker import NetworkBrokerClient
+    client = NetworkBrokerClient(host or "127.0.0.1", int(port))
+    try:
+        coll = FleetCollector(client, namespace=args.namespace)
+        lanes = coll.collect(duration_s=args.duration, poll_s=args.poll,
+                             min_lanes=args.min_lanes)
+    finally:
+        client.close()
+    if args.json:
+        print(json.dumps(lanes, indent=2,
+                         default=obs_alerts._json_default))
+    else:
+        print(render_fleet(lanes))
+    return 0 if lanes else 1

@@ -37,6 +37,7 @@ DEFAULT_TOL = {
     "compiles": 0.0,     # fail if steady-state compiles > baseline + tol
     "bytes": 0.25,       # fail if bytes_per_round > baseline * (1 + tol)
     "host_overhead": 0.10,   # fail if host_overhead_frac > baseline + tol
+    "p99": 0.75,         # fail if round_wall_p99_s > baseline * (1 + tol)
 }
 
 
@@ -88,6 +89,7 @@ def extract_metrics(bench: dict) -> dict[str, float | None]:
         "jit_compiles": comp,
         "jit_recompiles": rec,
         "host_overhead_frac": bench.get("host_overhead_frac"),
+        "round_wall_p99_s": bench.get("round_wall_p99_s"),
     }
 
 
@@ -155,6 +157,17 @@ def compare(candidate: dict, baseline: dict,
         rows.append(row("host_overhead_frac", b["host_overhead_frac"],
                         c["host_overhead_frac"], f"<= {ceil:.4f}",
                         c["host_overhead_frac"] > ceil))
+
+    # tail latency ceiling: lower is better, relative tolerance sized for
+    # p99-of-few-hundred-samples noise on a shared host. Artifacts that
+    # predate the streaming quantile sketch skip, never fail.
+    if (b["round_wall_p99_s"] is None or c["round_wall_p99_s"] is None):
+        skip("round_wall_p99_s", "missing from one side")
+    else:
+        ceil = b["round_wall_p99_s"] * (1.0 + tol["p99"])
+        rows.append(row("round_wall_p99_s", b["round_wall_p99_s"],
+                        c["round_wall_p99_s"], f"<= {ceil:.4f}",
+                        c["round_wall_p99_s"] > ceil))
 
     # steady-state compile counts: lower is better, absolute tolerance
     for metric in ("jit_compiles", "jit_recompiles"):
@@ -324,6 +337,9 @@ def main(argv: list[str] | None = None) -> int:
                     default=DEFAULT_TOL["host_overhead"],
                     help="absolute host_overhead_frac growth tolerated "
                          "(default %(default)s)")
+    ap.add_argument("--tol-p99", type=float, default=DEFAULT_TOL["p99"],
+                    help="relative round_wall_p99_s growth tolerated "
+                         "(default %(default)s)")
     ap.add_argument("--json", action="store_true", help="machine-readable")
     args = ap.parse_args(argv)
 
@@ -338,7 +354,8 @@ def main(argv: list[str] | None = None) -> int:
                    tol={"rounds": args.tol_rounds, "wall": args.tol_wall,
                         "acc": args.tol_acc, "compiles": args.tol_compiles,
                         "bytes": args.tol_bytes,
-                        "host_overhead": args.tol_host_overhead})
+                        "host_overhead": args.tol_host_overhead,
+                        "p99": args.tol_p99})
     regressed = any(r["status"] == "regress" for r in rows)
     if args.json:
         print(json.dumps({"regressed": regressed, "rows": rows,

@@ -54,7 +54,10 @@ def _load_jsonl(path: str) -> list[dict]:
 def summarize(run_dir: str) -> dict[str, Any]:
     """Machine-readable run summary (the --json output and the renderer's
     single source)."""
-    events = _load_jsonl(os.path.join(run_dir, "events.jsonl"))
+    # rotated generation first (size-capped runs), then the live file —
+    # same fold order as critical_path's loader
+    events = (_load_jsonl(os.path.join(run_dir, "events.jsonl.1"))
+              + _load_jsonl(os.path.join(run_dir, "events.jsonl")))
     metrics = _load_jsonl(os.path.join(run_dir, "metrics.jsonl"))
 
     out: dict[str, Any] = {
@@ -657,6 +660,7 @@ def follow(run_dir: str, timeout_s: float = 30.0, poll_s: float = 0.5,
 
     out = out or sys.stdout
     path = os.path.join(run_dir, "events.jsonl")
+    gen1 = path + ".1"
     mon = obs_alerts.AlertMonitor()          # offline: no file, no bus
     seen_alerts: set = set()                 # (rule, iteration) dedupe
     offset = 0
@@ -668,24 +672,52 @@ def follow(run_dir: str, timeout_s: float = 30.0, poll_s: float = 0.5,
                 f"{a.get('severity', '?')}/{a.get('rule', '?')}: "
                 f"{a.get('message', '')}")
 
+    def read_from(p: str, start: int) -> tuple[list, int]:
+        """Read whole JSON lines from byte ``start``; a torn tail line is
+        left unconsumed (re-read next poll)."""
+        recs = []
+        with open(p) as f:
+            f.seek(start)
+            chunk = f.read()
+            end = f.tell()
+        for line in chunk.splitlines():
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                recs.append(json.loads(line))
+            except json.JSONDecodeError:
+                end -= len(line) + 1          # torn tail: re-read next poll
+                break
+        return recs, end
+
     print(f"following {path} (bound {timeout_s:.0f}s; "
           "ends at run_end)", file=out)
+    # Fold an already-rotated generation first (size-capped runs —
+    # obs_max_file_mb — move history to events.jsonl.1), like the other
+    # readers (summarize/critical_path) do.
+    pre_rotated: list = []
+    if os.path.isfile(gen1):
+        pre_rotated, _ = read_from(gen1, 0)
+        print(f"(folded {len(pre_rotated)} events from rotated "
+              f"{os.path.basename(gen1)})", file=out)
     while not done and _time.monotonic() < deadline:
-        new = []
+        new, pre_rotated = pre_rotated, []
         if os.path.isfile(path):
-            with open(path) as f:
-                f.seek(offset)
-                chunk = f.read()
-                offset = f.tell()
-            for line in chunk.splitlines():
-                line = line.strip()
-                if not line:
-                    continue
-                try:
-                    new.append(json.loads(line))
-                except json.JSONDecodeError:
-                    offset -= len(line) + 1   # torn tail: re-read next poll
-                    break
+            if os.path.getsize(path) < offset:
+                # The file shrank below our offset: it rotated mid-follow
+                # and our unread tail now lives in events.jsonl.1 — fold
+                # it from the old offset instead of silently losing it.
+                folded = []
+                if os.path.isfile(gen1) and os.path.getsize(gen1) >= offset:
+                    folded, _ = read_from(gen1, offset)
+                new.extend(folded)
+                print(f"(events.jsonl rotated mid-follow; folded "
+                      f"{len(folded)} tail events from "
+                      f"{os.path.basename(gen1)})", file=out)
+                offset = 0
+            recs, offset = read_from(path, offset)
+            new.extend(recs)
         for e in new:
             kind = e.get("kind")
             if kind == "alert_raised":

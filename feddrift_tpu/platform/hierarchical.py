@@ -237,6 +237,21 @@ class EdgeRelay:
         self.down = down        # UpdateReceiver on the client->edge topic
         self.up = up            # UpdateSender on the edge->server topic
         self.edge_id = int(edge_id)
+        self.rounds_relayed = 0
+        self.last_members = 0
+
+    @property
+    def lane(self) -> str:
+        """Ops-plane process-lane identity: the edge's fleet snapshots
+        (obs.live.OpsPublisher) publish under this lane so the merged
+        fleet table keys per-edge rows apart."""
+        return f"edge/{self.edge_id}"
+
+    def ops_snapshot_fields(self) -> dict:
+        """Per-tier extras riding the edge's fleet snapshot."""
+        return {"edge": self.edge_id,
+                "rounds_relayed": self.rounds_relayed,
+                "last_members": self.last_members}
 
     def relay_round(self, n_updates: int, timeout: float = 5.0,
                     name: str = "edge_summary"):
@@ -255,6 +270,8 @@ class EdgeRelay:
         if not arrs:
             return None
         summary = np.mean(np.stack(arrs), axis=0)
+        self.rounds_relayed += 1
+        self.last_members = len(arrs)
         obs.emit("edge_aggregated", edge=self.edge_id, wire=True,
                  members=len(arrs))
         return self.up.send(name, summary, trace=tctx)

@@ -113,6 +113,10 @@ class ReconnectingBrokerClient:
         self._maintenance = threading.Thread(target=self._maintenance_loop,
                                              daemon=True)
         self._maintenance.start()
+        try:                              # ops plane: /healthz broker state
+            obs.live.register_broker_client(self)
+        except Exception:                 # obs.live absent mid-bootstrap
+            pass
 
     # -- session management --------------------------------------------
     def _verify_session(self, inner) -> None:
@@ -316,8 +320,18 @@ class ReconnectingBrokerClient:
     def _heartbeat(self, now: float) -> None:
         while True:                      # drain loopback beats
             try:
-                self._hb_queue.get_nowait()
+                payload = self._hb_queue.get_nowait()
                 self._hb_last_rx = now
+                try:
+                    # beat payloads carry their send time: the loopback
+                    # delay is a broker-RTT upper bound (tick-granular —
+                    # beats sit in the queue until this drain runs)
+                    obs.registry().quantile_sketch(
+                        "broker_rtt_seconds_q",
+                        transport=self._transport,
+                    ).observe(max(0.0, now - float(payload)))
+                except (TypeError, ValueError):
+                    pass
             except queue.Empty:
                 break
         if now - self._hb_last_rx > self._hb_timeout:
@@ -372,6 +386,31 @@ class ReconnectingBrokerClient:
     def is_dead(self) -> bool:
         """True once the retry schedule was exhausted without a session."""
         return self._dead
+
+    def health(self) -> dict:
+        """Connection-state snapshot for the ops plane (/healthz):
+        a client is healthy when it is neither dead nor mid-reconnect and
+        its heartbeat loopback (when enabled) is inside the timeout."""
+        now = time.monotonic()
+        hb_age = round(now - self._hb_last_rx, 3) if self._hb_interval \
+            else None
+        with self._lock:
+            reconnecting = self._reconnecting
+            pending = len(self._pending)
+        hb_silent = bool(self._hb_interval and self._hb_timeout
+                         and hb_age is not None
+                         and hb_age > self._hb_timeout)
+        return {
+            "transport": self._transport,
+            "connected": not (self._dead or reconnecting),
+            "dead": self._dead,
+            "reconnecting": reconnecting,
+            "reconnects": self.reconnects,
+            "pending": pending,
+            "hb_age_s": hb_age,
+            "hb_silent": hb_silent,
+            "healthy": not (self._dead or reconnecting or hb_silent),
+        }
 
     def close(self) -> None:
         self._closed = True

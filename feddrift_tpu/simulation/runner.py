@@ -200,6 +200,30 @@ class Experiment:
                 path=os.path.join(out_dir, "alerts.jsonl")
                 if (out_dir and self.is_coordinator) else None,
             ).attach(self.events)
+        # Live ops plane (obs/live.py): SLO burn-rate engine on the event
+        # tap, plus the /metrics + /healthz + /status HTTP server when
+        # cfg.ops_port enables it (0 = fully off: no tap, no thread, no
+        # per-iteration work beyond the two sketch observes that also
+        # feed bench p99 fields).
+        self.slo = self.ops = None
+        self._ops_active = cfg.ops_port != 0
+        slo_thresholds = dict(
+            rounds_per_s=cfg.slo_rounds_per_s,
+            host_overhead=cfg.slo_host_overhead,
+            p99_round_wall_s=cfg.slo_p99_round_wall_s,
+            eval_gap=cfg.slo_eval_gap)
+        if self._ops_active or any(v > 0 for v in slo_thresholds.values()):
+            self.slo = obs.live.SLOEngine(
+                objectives=obs.live.default_slos(**slo_thresholds),
+                path=os.path.join(out_dir, "alerts.jsonl")
+                if (out_dir and self.is_coordinator) else None,
+            ).attach(self.events)
+        if self._ops_active:
+            obs.live.status_board().reset()
+            obs.live.StatusTap().attach(self.events)
+            self.ops = obs.live.OpsServer(
+                port=max(cfg.ops_port, 0),   # -1 -> ephemeral bind
+                slo=self.slo).start()
         self.algo.bind(self.x, self.y, self.logger, self.C_pad)
         # Population-scale participation (platform/registry.py,
         # resilience/participation.py): host-side registry of every
@@ -767,7 +791,14 @@ class Experiment:
         reg.gauge("host_overhead_frac").set(round(host_frac, 6))
         reg.histogram("round_wall_seconds").observe(
             wall / max(cfg.comm_round, 1))
+        # Streaming P² digests next to the histogram: live p50/p95/p99
+        # for the ops plane (/metrics summary lines) and bench p99 fields.
+        reg.quantile_sketch("round_wall_seconds_q").observe(
+            wall / max(cfg.comm_round, 1))
+        reg.quantile_sketch("dispatch_gap_seconds_q").observe(gap)
         obs.costmodel.record_hbm_watermark(iteration=t)
+        if self._ops_active and t % cfg.ops_snapshot_every == 0:
+            obs.live.emit_snapshot("runner", seq=t, slo=self.slo)
         if self.out_dir and self.is_coordinator:
             # Prometheus textfile-collector snapshot, refreshed per
             # iteration (atomic replace; scrape-safe).
@@ -1393,7 +1424,13 @@ class Experiment:
         reg = obs.registry()
         reg.gauge("host_overhead_frac").set(round(host_frac, 6))
         reg.histogram("round_wall_seconds").observe(wall_j / max(R, 1))
+        reg.quantile_sketch("round_wall_seconds_q").observe(
+            wall_j / max(R, 1))
+        reg.quantile_sketch("dispatch_gap_seconds_q").observe(
+            gap / committed)
         obs.costmodel.record_hbm_watermark(iteration=last_t)
+        if self._ops_active and last_t % cfg.ops_snapshot_every == 0:
+            obs.live.emit_snapshot("runner", seq=last_t, slo=self.slo)
         if self.out_dir and self.is_coordinator:
             import os
             obs.registry().write_textfile(

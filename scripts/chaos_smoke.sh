@@ -37,6 +37,10 @@
 #    kill/restart: the resent frame carries the same trace_id, so the
 #    client -> edge -> server chain stays connected (runs the tier-1 test
 #    that encodes exactly that).
+# 9) live ops plane — a process with /metrics + /healthz up loses its
+#    broker mid-run: /healthz flips to 503 degraded, an slo_burn
+#    (broker_liveness, via heartbeat_missed) lands in alerts.jsonl; the
+#    broker restarts on the same port and /healthz flips back to 200 ok.
 #
 # Usage: scripts/chaos_smoke.sh            (~2-3 min on one CPU core)
 set -euo pipefail
@@ -47,12 +51,12 @@ OUT=$(mktemp -d)
 trap 'rm -rf "$OUT"' EXIT
 RUN="$OUT/run"
 
-echo "== [1/8] chaos transport e2e (drop_prob=0.2 + broker kill/restart) =="
+echo "== [1/9] chaos transport e2e (drop_prob=0.2 + broker kill/restart) =="
 timeout -k 10 300 python -m pytest tests/test_resilience.py -q \
     -p no:cacheprovider -p no:randomly \
     -k "ChaosEndToEnd or survives_broker_kill or heartbeat_missed"
 
-echo "== [2/8] preemption: SIGTERM a real run, then --auto_resume =="
+echo "== [2/9] preemption: SIGTERM a real run, then --auto_resume =="
 ARGS=(--dataset sine --model fnn --concept_drift_algo win-1
       --concept_num 2 --client_num_in_total 4 --client_num_per_round 4
       --train_iterations 6 --comm_round 8 --epochs 2
@@ -89,15 +93,15 @@ print(f"resume OK: {len(rows)} metric rows, final Test/Acc="
       f"{rows[-1]['Test/Acc']:.4f}")
 EOF
 
-echo "== [3/8] event taxonomy consistency (strict: no dead kinds) =="
+echo "== [3/9] event taxonomy consistency (strict: no dead kinds) =="
 python scripts/check_events_schema.py --strict
 
-echo "== [4/8] byzantine smoke: trimmed_mean defends where mean fails =="
+echo "== [4/9] byzantine smoke: trimmed_mean defends where mean fails =="
 timeout -k 10 300 python -m pytest tests/test_robust_agg.py -q \
     -p no:cacheprovider -p no:randomly \
     -k "trimmed_mean_defends_where_mean_fails"
 
-echo "== [5/8] decision observability: kill clients -> alerts + lineage =="
+echo "== [5/9] decision observability: kill clients -> alerts + lineage =="
 LRUN="$OUT/lineage-run"
 timeout -k 10 300 python - "$LRUN" <<'EOF'
 import sys
@@ -131,7 +135,7 @@ python -m feddrift_tpu report "$LRUN" > "$OUT/report.txt"
 grep -q "alerts:" "$OUT/report.txt" \
     || { echo "report missing alerts section"; exit 1; }
 
-echo "== [6/8] participation: 10^3 population, 20% stragglers + churn =="
+echo "== [6/9] participation: 10^3 population, 20% stragglers + churn =="
 PRUN="$OUT/population-run"
 timeout -k 10 300 python -m feddrift_tpu run \
     --dataset sea --model fnn --concept_drift_algo softcluster \
@@ -150,7 +154,7 @@ python -m feddrift_tpu report "$PRUN" > "$OUT/preport.txt"
 grep -q "participation:" "$OUT/preport.txt" \
     || { echo "report missing participation section"; exit 1; }
 
-echo "== [7/8] hierarchy: 10^3 population, kill edge 0 mid-run =="
+echo "== [7/9] hierarchy: 10^3 population, kill edge 0 mid-run =="
 HRUN="$OUT/hierarchy-run"
 timeout -k 10 300 python -m feddrift_tpu run \
     --dataset sea --model fnn --concept_drift_algo softcluster \
@@ -188,9 +192,77 @@ grep -q "hierarchy:" "$OUT/hreport.txt" \
 grep -q "re-homed:" "$OUT/hreport.txt" \
     || { echo "report missing re-homed line"; exit 1; }
 
-echo "== [8/8] causal trace continuity across broker reconnect =="
+echo "== [8/9] causal trace continuity across broker reconnect =="
 timeout -k 10 300 python -m pytest tests/test_causal_trace.py -q \
     -p no:cacheprovider -p no:randomly \
     -k "trace_survives_broker_reconnect"
+
+echo "== [9/9] live ops plane: broker kill -> /healthz 503 + slo_burn -> recovery =="
+ORUN="$OUT/ops-run"
+mkdir -p "$ORUN"
+timeout -k 10 300 python - "$ORUN" <<'EOF'
+import json, os, sys, time, urllib.error, urllib.request
+from feddrift_tpu import obs
+from feddrift_tpu.comm.netbroker import NetworkBroker, NetworkBrokerClient
+from feddrift_tpu.obs import live
+from feddrift_tpu.resilience.reconnect import ReconnectingBrokerClient
+from feddrift_tpu.resilience.retry import RetryPolicy
+
+out = sys.argv[1]
+bus = obs.configure(os.path.join(out, "events.jsonl"))
+apath = os.path.join(out, "alerts.jsonl")
+
+broker = NetworkBroker()
+host, port = broker.host, broker.port
+client = ReconnectingBrokerClient(
+    lambda: NetworkBrokerClient(host, port, timeout=2.0),
+    retry=RetryPolicy(base_delay=0.05, max_delay=0.25, max_attempts=400,
+                      deadline_s=120.0),
+    heartbeat_interval=0.1, heartbeat_timeout=0.4)
+slo = live.SLOEngine(objectives=live.default_slos(), path=apath).attach(bus)
+srv = live.OpsServer(port=0, slo=slo).start()
+
+def healthz():
+    try:
+        with urllib.request.urlopen(srv.url + "/healthz", timeout=2) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:          # 503 carries the doc too
+        return e.code, json.loads(e.read())
+
+def wait_for(pred, what, timeout_s=30.0):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if pred():
+            return
+        time.sleep(0.1)
+    raise AssertionError(f"timed out waiting for {what}")
+
+code, doc = healthz()
+assert code == 200 and doc["status"] == "ok", (code, doc)
+# heartbeats are looping back: the RTT sketch must reach /metrics
+wait_for(lambda: b"broker_rtt_seconds_q" in urllib.request.urlopen(
+    srv.url + "/metrics", timeout=2).read(), "broker RTT sketch on /metrics")
+
+broker.close()                                   # kill the broker mid-run
+wait_for(lambda: healthz()[0] == 503
+         and "broker" in healthz()[1]["degraded"],
+         "/healthz to flip 503 degraded(broker)")
+wait_for(lambda: os.path.isfile(apath) and any(
+    json.loads(l).get("kind") == "slo_burn"
+    for l in open(apath) if l.strip()), "slo_burn line in alerts.jsonl")
+burns = [json.loads(l) for l in open(apath) if l.strip()
+         if json.loads(l).get("kind") == "slo_burn"]
+assert any(b.get("slo") == "broker_liveness" for b in burns), burns
+print(f"  degraded OK: {len(burns)} slo_burn(s) in alerts.jsonl")
+
+broker2 = NetworkBroker(host=host, port=port)    # restart, same address
+wait_for(lambda: healthz()[0] == 200,
+         "/healthz to recover to 200 ok", timeout_s=60.0)
+code, doc = healthz()
+assert doc["status"] == "ok", doc
+print(f"  recovery OK: /healthz {code} {doc['status']}, "
+      f"reconnects={doc['broker']['reconnects']}")
+client.close(); srv.close(); broker2.close()
+EOF
 
 echo "chaos_smoke: ALL OK"

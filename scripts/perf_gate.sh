@@ -16,13 +16,13 @@ cd "$(dirname "$0")/.."
 out="$(mktemp -d)"
 trap 'rm -rf "$out"' EXIT
 
-echo "[perf_gate 1/6] warm run (populates the persistent compile cache)"
+echo "[perf_gate 1/7] warm run (populates the persistent compile cache)"
 python bench.py --smoke --cpu > "$out/warm.json"
 
-echo "[perf_gate 2/6] measured run"
+echo "[perf_gate 2/7] measured run"
 python bench.py --smoke --cpu > "$out/bench.json"
 
-echo "[perf_gate 3/6] cost-model + critical-path fields present"
+echo "[perf_gate 3/7] cost-model + critical-path fields present"
 python - "$out/bench.json" <<'EOF'
 import json, sys
 d = json.loads(open(sys.argv[1]).read().strip().splitlines()[-1])
@@ -32,12 +32,14 @@ assert d.get("mfu", {}).get("source") in ("cost_analysis", "analytic"), d.get("m
 assert d.get("host_overhead_frac") is not None, "host_overhead_frac is null"
 assert 0.0 <= d["host_overhead_frac"] <= 1.0, d["host_overhead_frac"]
 assert d.get("dispatch_gap", {}).get("mean_s") is not None, "dispatch_gap is null"
+assert d.get("round_wall_p99_s") is not None, "round_wall_p99_s is null"
 print(f"  mfu_estimate={d['mfu_estimate']} (source={d['mfu']['source']}), "
       f"hbm_peak_bytes={d['hbm_peak_bytes']}, "
-      f"host_overhead_frac={d['host_overhead_frac']}")
+      f"host_overhead_frac={d['host_overhead_frac']}, "
+      f"round_wall_p99_s={d['round_wall_p99_s']}")
 EOF
 
-echo "[perf_gate 4/6] critical_path on a smoke run dir"
+echo "[perf_gate 4/7] critical_path on a smoke run dir"
 # bench.py runs without an out_dir (no spans.jsonl), so the attribution
 # verb gets its own tiny recorded run: 2 iterations, per-round path.
 JAX_PLATFORMS=cpu python -m feddrift_tpu run \
@@ -61,7 +63,7 @@ print(f"  dominant_segment={d['dominant_segment']}, "
       f"host_overhead_frac_mean={d['host_overhead_frac_mean']}")
 EOF
 
-echo "[perf_gate 5/6] megastep: K=4 vs K=1 bitwise parity + zero steady recompiles"
+echo "[perf_gate 5/7] megastep: K=4 vs K=1 bitwise parity + zero steady recompiles"
 # the megastep fuses K whole iterations into one device program; the gate
 # is (a) bitwise-identical params/accuracy vs the K=1 driver and (b) no
 # jit cache growth past the single warm-up compile across blocks
@@ -94,7 +96,7 @@ print(f"  parity OK (leafdiff=0.0, {len(a4)} eval points), "
       f"megastep cache entries={n}")
 EOF
 
-echo "[perf_gate 6/6] regress: self-comparison (warm), then vs BENCH_r05.json"
+echo "[perf_gate 6/7] regress: self-comparison (warm), then vs BENCH_r05.json"
 # back-to-back smoke runs on a busy 1-core host: generous relative noise
 # margins, but identical round counts make every metric comparable
 python -m feddrift_tpu regress "$out/bench.json" --baseline "$out/warm.json" \
@@ -104,5 +106,57 @@ python -m feddrift_tpu regress "$out/bench.json" --baseline "$out/warm.json" \
 # catastrophic (order-of-magnitude) throughput or accuracy collapse
 python -m feddrift_tpu regress "$out/bench.json" --baseline BENCH_r05.json \
     --tol-rounds 0.9 --tol-acc 0.15
+
+echo "[perf_gate 7/7] ops plane overhead: enabled run within 2% of disabled"
+# The /metrics + /healthz server, SLO engine and status tap must stay off
+# the hot path. Resolving a 2% bound on a noisy 1-core host needs a
+# paired design: BOTH experiments live in one process, iterations
+# alternate off/on (order flipped each step), and each side is scored by
+# its per-iteration MINIMUM — scheduler noise only ever ADDS time, so
+# the mins sample the same machine-state windows and the comparison is
+# not at the mercy of whole-run drift.
+JAX_PLATFORMS=cpu python - <<'EOF'
+import time, urllib.request
+import jax
+from feddrift_tpu.config import ExperimentConfig
+from feddrift_tpu.simulation.runner import Experiment
+
+BASE = dict(dataset="sea", model="lr", concept_drift_algo="oblivious",
+            concept_drift_algo_arg="", concept_num=1,
+            client_num_in_total=8, client_num_per_round=8,
+            train_iterations=40, comm_round=20, epochs=1, batch_size=50,
+            sample_num=50, frequency_of_the_test=5, seed=7,
+            trace_sync=True)
+
+def build(extra):
+    exp = Experiment(ExperimentConfig(**BASE, **extra))
+    exp.run_iteration(0); exp.run_iteration(1)       # warm-up / compiles
+    jax.block_until_ready(exp.pool.params)
+    return exp
+
+off = build({})
+# ephemeral port + a live SLO objective + status tap + per-iter snapshot
+on = build(dict(ops_port=-1, slo_rounds_per_s=0.01))
+best = {"off": float("inf"), "on": float("inf")}
+for t in range(2, BASE["train_iterations"]):
+    pair = (("off", off), ("on", on)) if t % 2 else (("on", on), ("off", off))
+    for name, exp in pair:
+        t0 = time.perf_counter()
+        exp.run_iteration(t)
+        jax.block_until_ready(exp.pool.params)
+        best[name] = min(best[name], time.perf_counter() - t0)
+# endpoints must have been answering while the run was live
+with urllib.request.urlopen(on.ops.url + "/healthz", timeout=5) as r:
+    assert r.status == 200, r.status
+with urllib.request.urlopen(on.ops.url + "/metrics", timeout=5) as r:
+    assert b"round_wall_seconds_q" in r.read(), "sketch not exported"
+on.ops.close()
+off_rps = BASE["comm_round"] / best["off"]
+on_rps = BASE["comm_round"] / best["on"]
+print(f"  rounds/s ops-off={off_rps:.3f} ops-on={on_rps:.3f} "
+      f"ratio={on_rps / off_rps:.4f} (floor 0.98)")
+assert on_rps >= 0.98 * off_rps, \
+    f"ops plane costs more than 2%: {on_rps:.3f} vs {off_rps:.3f} rounds/s"
+EOF
 
 echo "perf_gate: OK"

@@ -1,0 +1,502 @@
+"""Live ops plane tests (obs/live.py + obs/quantiles.py): P² streaming
+quantile accuracy, /metrics + /healthz + /status endpoints, the SLO
+burn-rate engine, the fleet snapshot merge over a real TCP broker, and
+`report --follow` rotation folding. Pure host logic except the runner
+end-to-end (slow tier: compiles a train_round)."""
+
+from __future__ import annotations
+
+import io
+import json
+import os
+import random
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from feddrift_tpu import obs
+from feddrift_tpu.obs import live
+from feddrift_tpu.obs.events import EventBus
+from feddrift_tpu.obs.instruments import DEFAULT_BUCKETS, Registry
+from feddrift_tpu.obs.quantiles import P2Estimator, QuantileSketch
+
+
+def _get(url: str, timeout: float = 5.0):
+    """Bounded GET returning (status, body) — 503s carry a body too."""
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as r:
+            return r.status, r.read()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read()
+
+
+class TestP2Estimator:
+    def test_exact_below_marker_window(self):
+        """Under 5 samples the estimator is exact nearest-rank, not an
+        interpolation artifact."""
+        est = P2Estimator(0.5)
+        for v in (3.0, 1.0, 2.0):
+            est.observe(v)
+        assert est.quantile() == 2.0
+        assert P2Estimator(0.99).quantile() is None
+
+    @pytest.mark.parametrize("q", [0.5, 0.95, 0.99])
+    def test_accuracy_uniform(self, q):
+        rng = random.Random(7)
+        xs = [rng.random() for _ in range(20000)]
+        est = P2Estimator(q)
+        for x in xs:
+            est.observe(x)
+        exact = sorted(xs)[int(q * len(xs)) - 1]
+        assert abs(est.quantile() - exact) < 0.01, (q, est.quantile(), exact)
+
+    @pytest.mark.parametrize("q", [0.5, 0.95, 0.99])
+    def test_accuracy_heavy_tail(self, q):
+        """Exponential tail — the shape round walls actually have."""
+        rng = random.Random(11)
+        xs = [rng.expovariate(1.0) for _ in range(20000)]
+        est = P2Estimator(q)
+        for x in xs:
+            est.observe(x)
+        exact = sorted(xs)[int(q * len(xs)) - 1]
+        assert abs(est.quantile() - exact) / exact < 0.1, \
+            (q, est.quantile(), exact)
+
+    def test_sketch_snapshot_and_thread_safety(self):
+        sk = QuantileSketch()
+        threads = [threading.Thread(
+            target=lambda: [sk.observe(0.5) for _ in range(500)])
+            for _ in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        snap = sk.snapshot()
+        assert snap["count"] == 2000
+        assert abs(snap["sum"] - 1000.0) < 1e-6
+        assert snap["min"] == snap["max"] == 0.5
+        assert set(snap["quantiles"]) == {"0.5", "0.95", "0.99"}
+        assert abs(snap["quantiles"]["0.99"] - 0.5) < 1e-9
+
+    def test_sketch_p99_agrees_with_histogram_bucket(self):
+        """The acceptance cross-check: the live sketch p99 must land
+        inside the post-hoc histogram's p99 bucket (one bucket width)."""
+        from feddrift_tpu.obs.instruments import Histogram
+        rng = random.Random(3)
+        hist = Histogram(DEFAULT_BUCKETS)
+        sk = QuantileSketch()
+        for _ in range(5000):
+            v = min(abs(rng.lognormvariate(-2.0, 1.0)), 90.0)
+            hist.observe(v)
+            sk.observe(v)
+        snap = hist.snapshot()
+        # histogram p99: first bucket whose cumulative count crosses 99%
+        bounds = list(hist.bounds) + [float("inf")]
+        cum, lo = 0, 0.0
+        for i, b in enumerate(bounds):
+            cum += hist.bucket_counts[i]
+            if cum >= 0.99 * snap["count"]:
+                hi = b
+                break
+            lo = b
+        p99 = sk.snapshot()["quantiles"]["0.99"]
+        assert lo <= p99 <= hi, f"sketch p99 {p99} outside bucket ({lo}, {hi}]"
+
+
+class TestStatusBoardAndTap:
+    def test_board_beat_age_and_fields(self):
+        board = live.StatusBoard()
+        assert board.last_iteration_age() is None
+        board.beat(iteration=4)
+        board.update(rounds_per_s=2.5)
+        assert board.fields()["iteration"] == 4
+        assert board.fields()["rounds_per_s"] == 2.5
+        assert 0.0 <= board.last_iteration_age() < 5.0
+        board.reset()
+        assert board.fields() == {} and board.last_iteration_age() is None
+
+    def test_tap_feeds_board_from_events(self):
+        board = live.StatusBoard()
+        tap = live.StatusTap(board)
+        bus = EventBus(None)
+        tap.attach(bus)
+        bus.emit("run_start", num_models=1)
+        bus.emit("iteration_end", iteration=2, rounds_per_s=3.0,
+                 test_acc=0.8, wall_s=1.5)
+        bus.emit("cluster_state", num_models=4)
+        bus.emit("cluster_assign", oracle_ari=0.9)
+        f = board.fields()
+        assert f["iteration"] == 2 and f["rounds_per_s"] == 3.0
+        assert f["num_models"] == 4 and f["oracle_ari"] == 0.9
+        assert f["run_phase"] == "running"
+        bus.emit("run_end", test_acc=0.8)
+        assert board.fields()["run_phase"] == "done"
+
+
+class TestSLOEngine:
+    def _floor(self, **kw):
+        base = dict(name="rps_floor", kinds=("iteration_end",),
+                    value=lambda r: r.get("rounds_per_s"), objective=1.0,
+                    direction="min", window=4, budget_frac=0.25,
+                    burn_rate=2.0, min_samples=3, cooldown_s=10.0)
+        base.update(kw)
+        return live.SLObjective(**base)
+
+    def test_fires_on_sustained_violation(self, tmp_path):
+        clock = [100.0]
+        apath = str(tmp_path / "alerts.jsonl")
+        eng = live.SLOEngine([self._floor()], path=apath,
+                             time_fn=lambda: clock[0])
+        for _ in range(3):
+            eng.observe({"kind": "iteration_end", "rounds_per_s": 0.1})
+        assert len(eng.burns) == 1
+        assert eng.burns[0]["slo"] == "rps_floor"
+        assert eng.burns[0]["rule"] == "slo:rps_floor"
+        assert [a["slo"] for a in eng.active()] == ["rps_floor"]
+        (rec,) = [json.loads(l) for l in open(apath)]
+        assert rec["kind"] == "slo_burn" and rec["burn_frac"] == 1.0
+
+    def test_stays_quiet_within_budget(self):
+        eng = live.SLOEngine([self._floor()], time_fn=lambda: 0.0)
+        # at most 1 violation per 4-sample window (burn needs 2): quiet
+        for v in (0.1, 2.0, 2.0, 2.0, 2.0, 0.1, 2.0, 2.0, 2.0):
+            eng.observe({"kind": "iteration_end", "rounds_per_s": v})
+        assert eng.burns == [] and eng.active() == []
+        # and below min_samples nothing fires even at 100% violation
+        eng2 = live.SLOEngine([self._floor()], time_fn=lambda: 0.0)
+        eng2.observe({"kind": "iteration_end", "rounds_per_s": 0.1})
+        eng2.observe({"kind": "iteration_end", "rounds_per_s": 0.1})
+        assert eng2.burns == []
+
+    def test_cooldown_and_recovery(self):
+        clock = [0.0]
+        eng = live.SLOEngine([self._floor()], time_fn=lambda: clock[0])
+        for _ in range(4):
+            eng.observe({"kind": "iteration_end", "rounds_per_s": 0.1})
+        assert len(eng.burns) == 1            # cooldown holds repeats back
+        assert eng.active()                   # ...but it stays active
+        clock[0] = 20.0                       # past cooldown, still burning
+        eng.observe({"kind": "iteration_end", "rounds_per_s": 0.1})
+        assert len(eng.burns) == 2
+        # recovery: healthy samples flush the window -> active clears
+        for _ in range(4):
+            eng.observe({"kind": "iteration_end", "rounds_per_s": 5.0})
+        assert eng.active() == []
+
+    def test_incident_mode_broker_liveness(self):
+        eng = live.SLOEngine(live.default_slos(), time_fn=lambda: 0.0)
+        eng.observe({"kind": "heartbeat_missed", "transport": "netbroker"})
+        assert [b["slo"] for b in eng.burns] == ["broker_liveness"]
+        assert eng.burns[0]["severity"] == "crit"
+        # one healthy sample (the reconnect) heals incident mode
+        eng.observe({"kind": "conn_reconnect", "transport": "netbroker"})
+        assert eng.active() == []
+
+    def test_emits_slo_burn_on_attached_bus(self, tmp_path):
+        path = str(tmp_path / "events.jsonl")
+        bus = EventBus(path)
+        eng = live.SLOEngine([self._floor()]).attach(bus)
+        for _ in range(3):
+            bus.emit("iteration_end", rounds_per_s=0.1)
+        bus.close()
+        assert eng.burns and eng.burns[0]["kind"] == "slo_burn"
+        kinds = [json.loads(l)["kind"] for l in open(path)]
+        assert kinds.count("slo_burn") == 1
+
+    def test_default_slos_gating(self):
+        names = {o.name for o in live.default_slos()}
+        assert names == {"broker_liveness"}
+        names = {o.name for o in live.default_slos(
+            rounds_per_s=1.0, host_overhead=0.5, p99_round_wall_s=2.0,
+            eval_gap=0.1)}
+        assert names == {"broker_liveness", "rounds_per_s_floor",
+                         "host_overhead_ceiling", "p99_round_wall",
+                         "eval_gap"}
+
+
+class TestOpsServer:
+    def test_endpoints(self):
+        reg = Registry()
+        reg.counter("client_bytes_out", transport="netbroker").inc(42)
+        reg.quantile_sketch("round_wall_seconds_q").observe(0.25)
+        board = live.StatusBoard()
+        board.beat(iteration=1)
+        board.update(rounds_per_s=4.0)
+        srv = live.OpsServer(port=0, reg=reg, board=board).start()
+        try:
+            code, body = _get(srv.url + "/metrics")
+            assert code == 200
+            assert b'client_bytes_out{transport="netbroker"} 42.0' in body
+            assert b'round_wall_seconds_q{quantile="0.99"}' in body
+            code, body = _get(srv.url + "/healthz")
+            doc = json.loads(body)
+            assert code == 200 and doc["status"] == "ok"
+            assert doc["last_iteration_age_s"] is not None
+            code, body = _get(srv.url + "/status")
+            doc = json.loads(body)
+            assert code == 200 and doc["rounds_per_s"] == 4.0
+            assert "0.99" in doc["quantiles"]["round_wall_seconds_q"]
+            code, _ = _get(srv.url + "/nope")
+            assert code == 404
+        finally:
+            srv.close()
+
+    def test_healthz_degrades_on_stall_and_crit_burn(self):
+        board = live.StatusBoard()
+        board.beat(iteration=0)
+        eng = live.SLOEngine(live.default_slos(), time_fn=lambda: 0.0)
+        srv = live.OpsServer(port=0, reg=Registry(), slo=eng, board=board,
+                             stall_after_s=0.05).start()
+        try:
+            time.sleep(0.1)                    # beat goes stale
+            code, body = _get(srv.url + "/healthz")
+            doc = json.loads(body)
+            assert code == 503 and "stalled" in doc["degraded"]
+            eng.observe({"kind": "heartbeat_missed"})   # crit burn
+            code, body = _get(srv.url + "/healthz")
+            doc = json.loads(body)
+            assert code == 503 and "slo_burn" in doc["degraded"]
+            board.beat()                       # fresh beat clears the stall
+            eng.observe({"kind": "conn_reconnect"})
+            code, body = _get(srv.url + "/healthz")
+            assert code == 200
+        finally:
+            srv.close()
+
+    def test_healthz_aggregates_broker_clients(self):
+        class FakeClient:
+            _closed = False
+            healthy = True
+            def health(self):
+                return {"healthy": self.healthy, "reconnects": 2}
+        fake = FakeClient()
+        live.register_broker_client(fake)
+        srv = live.OpsServer(port=0, reg=Registry(),
+                             board=live.StatusBoard()).start()
+        try:
+            code, body = _get(srv.url + "/healthz")
+            doc = json.loads(body)
+            assert code == 200 and doc["broker"]["clients"] == 1
+            assert doc["broker"]["reconnects"] == 2
+            fake.healthy = False
+            code, body = _get(srv.url + "/healthz")
+            doc = json.loads(body)
+            assert code == 503 and "broker" in doc["degraded"]
+        finally:
+            srv.close()
+            del fake                           # drop out of the WeakSet
+
+
+class TestFleetPlane:
+    def test_three_lane_merge_and_render(self):
+        """Three processes' worth of lanes (runner, edge/0, server) over
+        one real TCP broker: the collector discovers every lane via the
+        announce topic and render_fleet shows one row per lane."""
+        from feddrift_tpu.comm.netbroker import (NetworkBroker,
+                                                 NetworkBrokerClient)
+        from feddrift_tpu.platform.hierarchical import EdgeRelay
+
+        broker = NetworkBroker()
+        clients, pubs = [], []
+        try:
+            collector_client = NetworkBrokerClient(broker.host, broker.port)
+            clients.append(collector_client)
+            coll = live.FleetCollector(collector_client, namespace="t")
+
+            relay = EdgeRelay(None, None, edge_id=0)
+            relay.rounds_relayed, relay.last_members = 5, 3
+            assert relay.lane == "edge/0"
+            lanes = ["runner", relay.lane, "server"]
+            for i, lane in enumerate(lanes):
+                reg = Registry()
+                reg.counter("client_bytes_out",
+                            transport="netbroker").inc(100 * (i + 1))
+                reg.quantile_sketch("round_wall_seconds_q").observe(0.2)
+                board = live.StatusBoard()
+                board.beat(iteration=i)
+                board.update(rounds_per_s=float(i + 1))
+                c = NetworkBrokerClient(broker.host, broker.port)
+                clients.append(c)
+                pub = live.OpsPublisher(
+                    c, lane, namespace="t", interval_s=0.1, reg=reg,
+                    board=board,
+                    extra_fn=(relay.ops_snapshot_fields
+                              if lane == relay.lane else None))
+                pubs.append(pub.start())
+            merged = coll.collect(duration_s=15.0, poll_s=0.05, min_lanes=3)
+            assert set(merged) == set(lanes)
+            edge = merged["edge/0"]
+            assert edge["extra"] == {"edge": 0, "rounds_relayed": 5,
+                                     "last_members": 3}
+            assert edge["seq"] >= 1
+            assert edge["health"]["status"] == "ok"
+            table = live.render_fleet(merged)
+            lines = table.splitlines()
+            assert lines[0].split()[:2] == ["LANE", "PID"]
+            assert len(lines) == 1 + 3
+            assert any(l.startswith("edge/0") for l in lines[1:])
+            # per-lane bytes made it through the metric filter
+            assert "300" in [l for l in lines if l.startswith("server")][0]
+        finally:
+            for p in pubs:
+                p.close()
+            for c in clients:
+                c.close()
+            broker.close()
+
+    def test_seq_keeps_latest_snapshot(self):
+        """The merge is seq-ordered: a late-arriving stale snapshot never
+        replaces a newer one."""
+        class LoopClient:
+            def __init__(self):
+                import queue as _q
+                self.qs = {}
+            def subscribe(self, topic, sink=None):
+                import queue as _q
+                q = sink if sink is not None else _q.Queue()
+                self.qs.setdefault(topic, []).append(q)
+                return q
+            def publish(self, topic, payload):
+                for q in self.qs.get(topic, []):
+                    q.put(payload)
+        c = LoopClient()
+        coll = live.FleetCollector(c, namespace="t")
+        c.publish(live.announce_topic("t"), json.dumps({"lane": "a"}))
+        coll.poll()
+        c.publish(live.ops_topic("t", "a"),
+                  json.dumps({"lane": "a", "seq": 5, "pid": 1}))
+        c.publish(live.ops_topic("t", "a"),
+                  json.dumps({"lane": "a", "seq": 3, "pid": 0}))
+        lanes = coll.poll()
+        assert lanes["a"]["seq"] == 5
+
+    def test_emit_snapshot_records_event(self, tmp_path):
+        old = obs.get_bus()
+        try:
+            bus = obs.configure(str(tmp_path / "events.jsonl"))
+            board = live.StatusBoard()
+            board.update(rounds_per_s=2.0)
+            rec = live.emit_snapshot("runner", seq=7, board=board)
+            assert rec["kind"] == "ops_snapshot"
+            assert rec["lane"] == "runner" and rec["seq"] == 7
+            assert rec["rounds_per_s"] == 2.0
+            assert bus.events("ops_snapshot")
+        finally:
+            obs.configure(None)
+
+
+class TestFollowRotation:
+    def _seed_run(self, tmp_path, events, gen1=None):
+        with open(tmp_path / "metrics.jsonl", "w") as f:
+            f.write(json.dumps({"_ts": 1.0, "iteration": 0, "round": 0,
+                                "Test/Acc": 0.5}) + "\n")
+        if gen1 is not None:
+            with open(tmp_path / "events.jsonl.1", "w") as f:
+                for e in gen1:
+                    f.write(json.dumps(e) + "\n")
+        with open(tmp_path / "events.jsonl", "w") as f:
+            for e in events:
+                f.write(json.dumps(e) + "\n")
+
+    def test_follow_folds_existing_rotated_generation(self, tmp_path):
+        from feddrift_tpu.obs.report import follow
+        self._seed_run(
+            tmp_path,
+            gen1=[{"_ts": 1.0, "kind": "iteration_end", "iteration": 0,
+                   "test_acc": 0.5, "rounds_per_s": 2.0}],
+            events=[{"_ts": 2.0, "kind": "iteration_end", "iteration": 1,
+                     "test_acc": 0.6, "rounds_per_s": 2.0},
+                    {"_ts": 3.0, "kind": "run_end", "test_acc": 0.6}])
+        buf = io.StringIO()
+        assert follow(str(tmp_path), timeout_s=5, poll_s=0.05, out=buf) == 0
+        out = buf.getvalue()
+        assert "folded 1 events from rotated events.jsonl.1" in out
+        assert "t=0 done" in out and "t=1 done" in out
+
+    def test_follow_notes_mid_follow_rotation(self, tmp_path):
+        """Rotate events.jsonl out from under a live follow: the reader
+        must fold the unread tail from events.jsonl.1 (noting it) instead
+        of silently losing it, then keep tailing the fresh file."""
+        from feddrift_tpu.obs.report import follow
+        path = tmp_path / "events.jsonl"
+        filler = {"_ts": 1.1, "kind": "eval", "round": 0, "test_acc": 0.5,
+                  "pad": "x" * 2000}
+        self._seed_run(tmp_path, events=[
+            {"_ts": 1.0, "kind": "iteration_end", "iteration": 0,
+             "test_acc": 0.5, "rounds_per_s": 2.0}, filler])
+        buf = io.StringIO()
+        t = threading.Thread(target=follow, args=(str(tmp_path),),
+                             kwargs=dict(timeout_s=20, poll_s=0.05, out=buf))
+        t.start()
+        time.sleep(0.5)                       # follow has read past 0
+        os.replace(path, tmp_path / "events.jsonl.1")   # rotation
+        with open(path, "w") as f:
+            f.write(json.dumps({"_ts": 2.0, "kind": "iteration_end",
+                                "iteration": 1, "test_acc": 0.6,
+                                "rounds_per_s": 2.0}) + "\n")
+            f.write(json.dumps({"_ts": 3.0, "kind": "run_end",
+                                "test_acc": 0.6}) + "\n")
+        t.join(timeout=20)
+        assert not t.is_alive()
+        out = buf.getvalue()
+        assert "rotated mid-follow" in out
+        assert "t=0 done" in out and "t=1 done" in out
+
+    def test_summarize_folds_rotated_generation(self, tmp_path, capsys):
+        from feddrift_tpu.obs.report import main
+        self._seed_run(
+            tmp_path,
+            gen1=[{"_ts": 0.5, "kind": "drift_detected", "iteration": 0,
+                   "client": 3, "acc_drop": 0.2},
+                  {"_ts": 1.0, "kind": "iteration_end", "iteration": 0,
+                   "wall_s": 1.0, "rounds": 2}],
+            events=[{"_ts": 2.0, "kind": "iteration_end", "iteration": 1,
+                     "wall_s": 1.0, "rounds": 2},
+                    {"_ts": 3.0, "kind": "run_end", "test_acc": 0.6}])
+        assert main([str(tmp_path)]) == 0
+        out = capsys.readouterr().out
+        # the drift event lives only in the rotated generation
+        assert "drift_detected" in out
+
+
+@pytest.mark.slow
+class TestExperimentOpsEndToEnd:
+    def test_run_serves_endpoints_and_snapshots(self, tmp_path):
+        """A real (tiny) run with the ops plane on: endpoints answer
+        while the process is live, the sketch reaches /metrics, and
+        ops_snapshot events land in events.jsonl."""
+        from feddrift_tpu.config import ExperimentConfig
+        from feddrift_tpu.simulation.runner import Experiment
+        out = str(tmp_path / "run")
+        cfg = ExperimentConfig(
+            dataset="sea", model="lr", concept_drift_algo="oblivious",
+            concept_drift_algo_arg="", concept_num=1,
+            client_num_in_total=8, client_num_per_round=8,
+            train_iterations=3, comm_round=4, epochs=1, batch_size=50,
+            sample_num=50, frequency_of_the_test=2, seed=0,
+            ops_port=-1, slo_rounds_per_s=0.001, out_dir=out)
+        exp = Experiment(cfg, out_dir=out)
+        assert exp.ops is not None and exp.slo is not None
+        try:
+            exp.run()
+            code, body = _get(exp.ops.url + "/metrics")
+            assert code == 200
+            assert b'round_wall_seconds_q{quantile="0.99"}' in body
+            assert b"dispatch_gap_seconds_q" in body
+            code, body = _get(exp.ops.url + "/healthz")
+            doc = json.loads(body)
+            assert code == 200 and doc["status"] == "ok"
+            code, body = _get(exp.ops.url + "/status")
+            doc = json.loads(body)
+            assert doc["rounds_per_s"] is not None
+            assert doc["run_phase"] == "done"
+            live_p99 = doc["quantiles"]["round_wall_seconds_q"]["0.99"]
+            assert live_p99 is not None and live_p99 > 0
+        finally:
+            exp.ops.close()
+        kinds = [json.loads(l)["kind"]
+                 for l in open(os.path.join(out, "events.jsonl"))]
+        assert "ops_snapshot" in kinds

@@ -6,6 +6,7 @@ from __future__ import annotations
 
 import json
 import os
+import re
 import threading
 import time
 
@@ -148,6 +149,116 @@ class TestInstruments:
         reg.counter("c").inc()
         reg.reset()
         assert reg.snapshot() == {}
+
+
+class TestExpositionFormat:
+    """Prometheus exposition-format compliance: label escaping, the
+    strict line grammar, histogram triplet invariants, and torn-read
+    freedom under concurrent observes."""
+
+    # one metric line: name{labels} value  — label values are quoted
+    # strings where only \\, \" and \n escapes are legal
+    _LINE = re.compile(
+        r'^[a-zA-Z_:][a-zA-Z0-9_:]*'
+        r'(\{[a-zA-Z_][a-zA-Z0-9_]*="(?:[^"\\\n]|\\\\|\\"|\\n)*"'
+        r'(,[a-zA-Z_][a-zA-Z0-9_]*="(?:[^"\\\n]|\\\\|\\"|\\n)*")*\})?'
+        r' -?[0-9+.eEinf]+$')
+    _TYPE = re.compile(
+        r"^# TYPE [a-zA-Z_:][a-zA-Z0-9_:]* "
+        r"(counter|gauge|histogram|summary)$")
+
+    def test_label_value_escaping(self):
+        """Backslash, double quote and newline in a label value must be
+        escaped per the exposition spec — raw they corrupt the line
+        grammar (a bare quote ends the value early)."""
+        reg = Registry()
+        reg.counter("c", path='we"ird\\x\ny').inc(3)
+        text = reg.to_prometheus_text()
+        assert 'c{path="we\\"ird\\\\x\\ny"} 3.0' in text
+        for line in text.strip().splitlines():
+            if not line.startswith("#"):
+                assert self._LINE.match(line), f"unparseable line: {line!r}"
+        # snapshot keys carry the same escaping (same _label_str)
+        assert 'c{path="we\\"ird\\\\x\\ny"}' in reg.snapshot()
+
+    def test_strict_parse_golden(self):
+        """Every line of a mixed-instrument export matches the exposition
+        grammar; TYPE lines precede their family; histogram buckets are
+        cumulative and the +Inf bucket equals _count."""
+        reg = Registry()
+        reg.counter("bytes_out", transport="netbroker").inc(7)
+        reg.gauge("num_models").set(3)
+        h = reg.histogram("lat", buckets=(0.1, 1.0))
+        for v in (0.05, 0.5, 0.7, 5.0):
+            h.observe(v)
+        q = reg.quantile_sketch("lat_q")
+        for i in range(100):
+            q.observe(i / 100.0)
+        text = reg.to_prometheus_text()
+        assert text.endswith("\n")
+        typed = set()
+        for line in text.strip().splitlines():
+            if line.startswith("#"):
+                assert self._TYPE.match(line), f"bad TYPE line: {line!r}"
+                typed.add(line.split()[2])
+            else:
+                assert self._LINE.match(line), f"unparseable line: {line!r}"
+        assert typed == {"bytes_out", "num_models", "lat", "lat_q"}
+        # histogram triplet: cumulative buckets, +Inf == _count
+        assert 'lat_bucket{le="0.1"} 1' in text
+        assert 'lat_bucket{le="1.0"} 3' in text
+        assert 'lat_bucket{le="+Inf"} 4' in text
+        assert "lat_count 4" in text
+        # summary: per-quantile lines + _sum/_count
+        assert 'lat_q{quantile="0.5"}' in text
+        assert 'lat_q{quantile="0.99"}' in text
+        assert "lat_q_count 100" in text
+        # TYPE precedes the family's first sample line
+        lines = text.splitlines()
+        assert lines.index("# TYPE lat histogram") \
+            < lines.index('lat_bucket{le="0.1"} 1')
+
+    def test_no_torn_reads_under_concurrent_observe(self):
+        """Exports racing a hot observe loop must stay self-consistent:
+        within one export the +Inf cumulative bucket equals _count for
+        every histogram (both copied under the instrument lock)."""
+        reg = Registry()
+        h = reg.histogram("hot", buckets=(0.5,))
+        q = reg.quantile_sketch("hot_q")
+        stop = threading.Event()
+
+        def writer():
+            while not stop.is_set():
+                h.observe(0.1)
+                h.observe(0.9)
+                q.observe(0.3)
+
+        threads = [threading.Thread(target=writer) for _ in range(4)]
+        for t in threads:
+            t.start()
+        try:
+            for _ in range(200):
+                text = reg.to_prometheus_text()
+                inf = count = qsum = qcount = None
+                for line in text.splitlines():
+                    if line.startswith('hot_bucket{le="+Inf"} '):
+                        inf = int(line.rsplit(" ", 1)[1])
+                    elif line.startswith("hot_count "):
+                        count = int(line.rsplit(" ", 1)[1])
+                    elif line.startswith("hot_q_sum "):
+                        qsum = float(line.rsplit(" ", 1)[1])
+                    elif line.startswith("hot_q_count "):
+                        qcount = int(line.rsplit(" ", 1)[1])
+                assert inf == count, f"torn histogram: +Inf={inf} count={count}"
+                # sketch sum/count snapshotted together: sum == 0.3 * count
+                assert abs(qsum - 0.3 * qcount) < 1e-6 * max(qcount, 1), \
+                    f"torn sketch: sum={qsum} count={qcount}"
+                snap = reg.snapshot()["hot"]
+                assert sum(snap["buckets"].values()) == snap["count"]
+        finally:
+            stop.set()
+            for t in threads:
+                t.join()
 
 
 class TestPhaseTracerConcurrency:
