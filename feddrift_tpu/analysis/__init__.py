@@ -1,0 +1,35 @@
+"""graftlint: static analysis + runtime sanitizers for this repo's bug classes.
+
+Every rule is grounded in a bug this repo actually shipped and a reviewer
+caught by hand (see docs/STATIC_ANALYSIS.md for the incident table):
+
+- R1 cfg-registry        — typo'd ``cfg.<knob>`` silently defaulting
+- R2 host-sync-in-hot-path — ``.item()``/``float()``/``np.asarray``/
+                             ``block_until_ready`` inside marked hot regions
+- R3 tap-reentrancy      — ``emit`` reachable under a non-reentrant lock from
+                             a registered bus tap (the PR 9 deadlock class)
+- R4 nondeterminism      — bare ``np.random``/``random``/``time.time`` in
+                             seeded-replay modules
+- R5 jit-static hygiene  — ``static_argnames`` not in the wrapped signature;
+                             donated-buffer reads after dispatch
+- R6 event-taxonomy      — emitted/declared/documented event-kind drift
+                             (folded in from scripts/check_events_schema.py)
+
+This package is importable without jax — the ``lint`` CLI verb runs before
+backend init, like ``report``/``regress``.
+
+Runtime companions:
+
+- :mod:`feddrift_tpu.analysis.lockorder` — test-mode lock acquisition-order
+  recorder with cycle detection (wired into tests/conftest.py).
+- :mod:`feddrift_tpu.analysis.sanitize` — ``cfg.sanitize`` debug mode:
+  tracer-leak + NaN checks and a steady-state recompile budget on top of the
+  PR 1 compile tracker.
+"""
+
+from feddrift_tpu.analysis.findings import (  # noqa: F401
+    Finding,
+    findings_to_json,
+    parse_suppressions,
+)
+from feddrift_tpu.analysis.engine import LintEngine, run_lint  # noqa: F401

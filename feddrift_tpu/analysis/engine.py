@@ -1,0 +1,111 @@
+"""graftlint engine: collect files, run rules, apply suppressions, report.
+
+Importable without jax so the ``lint`` CLI verb stays pre-backend-init.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import sys
+from typing import List, Optional, Sequence
+
+from feddrift_tpu.analysis import findings as F
+from feddrift_tpu.analysis.rules import (
+    FILE_RULES,
+    FileContext,
+    config_registry,
+)
+
+PACKAGE_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+REPO_ROOT = os.path.dirname(PACKAGE_ROOT)
+
+
+def _collect_files(paths: Sequence[str]) -> List[str]:
+    out: List[str] = []
+    for p in paths:
+        p = os.path.abspath(p)
+        if os.path.isdir(p):
+            for dirpath, dirnames, filenames in os.walk(p):
+                dirnames[:] = sorted(d for d in dirnames
+                                     if d != "__pycache__")
+                out.extend(os.path.join(dirpath, fn)
+                           for fn in sorted(filenames)
+                           if fn.endswith(".py"))
+        elif os.path.isfile(p):
+            out.append(p)
+        else:
+            raise FileNotFoundError(f"lint path does not exist: {p}")
+    return out
+
+
+class LintEngine:
+    def __init__(self, config_path: Optional[str] = None,
+                 rules: Optional[Sequence[str]] = None):
+        self.config_path = config_path or os.path.join(PACKAGE_ROOT,
+                                                       "config.py")
+        self.cfg_registry = config_registry(self.config_path)
+        self.rules = list(rules) if rules is not None \
+            else sorted(FILE_RULES) + ["R6"]
+
+    def _context(self, abspath: str) -> Optional[FileContext]:
+        with open(abspath, encoding="utf-8") as f:
+            source = f.read()
+        rel = os.path.relpath(abspath, REPO_ROOT).replace(os.sep, "/")
+        in_package = not rel.startswith("..") and \
+            rel.startswith("feddrift_tpu/")
+        path = rel if not rel.startswith("..") else abspath
+        try:
+            tree = ast.parse(source, filename=abspath)
+        except SyntaxError as e:
+            self._parse_failures.append(F.Finding(
+                rule="PARSE", severity="error", path=path,
+                line=e.lineno or 1, message=f"syntax error: {e.msg}"))
+            return None
+        return FileContext(path=path, abspath=abspath, source=source,
+                           tree=tree, cfg_registry=self.cfg_registry,
+                           in_package=in_package,
+                           rel_in_repo=rel if in_package else "")
+
+    def run(self, paths: Sequence[str], *,
+            strict: bool = False) -> List[F.Finding]:
+        self._parse_failures: List[F.Finding] = []
+        files = _collect_files(paths)
+        all_findings: List[F.Finding] = list(self._parse_failures)
+        scanned_package = False
+        for abspath in files:
+            ctx = self._context(abspath)
+            if ctx is None:
+                continue
+            scanned_package = scanned_package or ctx.in_package
+            file_findings: List[F.Finding] = []
+            for rule in self.rules:
+                fn = FILE_RULES.get(rule)
+                if fn is not None:
+                    file_findings.extend(fn(ctx))
+            F.apply_suppressions(file_findings,
+                                 F.parse_suppressions(ctx.source))
+            all_findings.extend(file_findings)
+        all_findings.extend(self._parse_failures)
+        # R6 (event-taxonomy drift) is a repo-level rule: it runs when the
+        # scan touches the package's own tree, not on external fixtures
+        if scanned_package and "R6" in self.rules:
+            from feddrift_tpu.analysis.events_schema import rule_r6
+            all_findings.extend(rule_r6(strict=strict))
+        return F.sort_findings(all_findings)
+
+
+def run_lint(paths: Sequence[str], *, strict: bool = False,
+             as_json: bool = False, out=None) -> int:
+    """CLI core: lint ``paths``, print a report, return the exit code."""
+    out = out or sys.stdout
+    engine = LintEngine()
+    results = engine.run(paths or ["feddrift_tpu"], strict=strict)
+    if as_json:
+        print(F.findings_to_json(results, strict=strict), file=out)
+    else:
+        for f in results:
+            if not f.suppressed:
+                print(f.render(), file=out)
+        print(F.summarize(results), file=out)
+    return F.exit_code(results, strict=strict)

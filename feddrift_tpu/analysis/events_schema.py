@@ -1,0 +1,160 @@
+"""Event-taxonomy consistency (lint rule R6), relocated from
+``scripts/check_events_schema.py`` (which is now a thin shim over this
+module so the chaos/perf gate stages keep working).
+
+Three-way pass: every ``emit("<kind>")`` literal must be in
+``obs.events.EVENT_KINDS``; every member of ``EVENT_KINDS`` must have a
+taxonomy row in docs/OBSERVABILITY.md; every documented kind must still
+exist. Strict mode additionally fails DEAD KINDS (taxonomy entries with
+zero emit sites anywhere in the tree).
+"""
+
+from __future__ import annotations
+
+import os
+import re
+from typing import Dict, List, Set
+
+from feddrift_tpu.analysis.findings import Finding
+
+ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+# emit("kind", ...) / .emit("kind", ...) with a string literal first arg
+_EMIT_RE = re.compile(r"""\bemit\(\s*\n?\s*["']([a-z_]+)["']""")
+# taxonomy rows: | `kind` | layer | ...
+_DOC_ROW_RE = re.compile(r"^\|\s*`([a-z_]+)`\s*\|", re.MULTILINE)
+
+# Kinds emitted through a COMPUTED first argument (obs.emit(kind, ...)),
+# which the literal scan cannot attribute: kind -> the one file whose
+# source must still contain the literal. Strict mode verifies the literal
+# is present there, so a refactor that drops the emission path still
+# trips dead-kind detection instead of hiding behind this allowlist.
+_INDIRECT_KINDS = {
+    "jit_compile": "feddrift_tpu/core/step.py",     # _note_signature's
+    "jit_recompile": "feddrift_tpu/core/step.py",   # kind = ... ternary
+}
+
+
+def emitted_kinds(pkg_dir: str) -> Dict[str, List[str]]:
+    """{kind: [file:line, ...]} for every emit() string literal."""
+    found: Dict[str, List[str]] = {}
+    for dirpath, dirnames, filenames in os.walk(pkg_dir):
+        # analysis/ is the meta layer: it quotes emit("kind") patterns in
+        # comments/regexes but never emits events itself
+        dirnames[:] = [d for d in dirnames
+                       if d not in ("__pycache__", "analysis")]
+        for fn in filenames:
+            if not fn.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fn)
+            with open(path, encoding="utf-8") as f:
+                text = f.read()
+            for m in _EMIT_RE.finditer(text):
+                line = text.count("\n", 0, m.start()) + 1
+                rel = os.path.relpath(path, ROOT)
+                found.setdefault(m.group(1), []).append(f"{rel}:{line}")
+    return found
+
+
+def documented_kinds(doc_path: str) -> Set[str]:
+    """Kinds documented in the '## Event taxonomy' table ONLY — other
+    tables in the doc (alert rules, file inventory) also use backticked
+    first columns and must not count as taxonomy rows."""
+    with open(doc_path, encoding="utf-8") as f:
+        text = f.read()
+    start = text.find("## Event taxonomy")
+    if start != -1:
+        end = text.find("\n## ", start + 1)
+        text = text[start:end if end != -1 else len(text)]
+    return set(_DOC_ROW_RE.findall(text))
+
+
+def check(strict: bool = False) -> List[str]:
+    """Returns a list of problem strings; empty = consistent.
+
+    ``strict`` additionally fails DEAD KINDS: an ``EVENT_KINDS`` member
+    with zero ``emit()`` sites anywhere in the tree is taxonomy rot — it
+    documents an event no run can ever produce (tier-1 runs strict via
+    tests/test_obs.py)."""
+    from feddrift_tpu.obs.events import EVENT_KINDS
+
+    problems: List[str] = []
+    emitted = emitted_kinds(os.path.join(ROOT, "feddrift_tpu"))
+    doc = os.path.join(ROOT, "docs", "OBSERVABILITY.md")
+    if not os.path.isfile(doc):
+        return [f"missing taxonomy doc: {doc}"]
+    documented = documented_kinds(doc)
+
+    for kind, sites in sorted(emitted.items()):
+        if kind not in EVENT_KINDS:
+            problems.append(
+                f"emitted kind {kind!r} not in EVENT_KINDS ({sites[0]})")
+    for kind in sorted(EVENT_KINDS - documented):
+        problems.append(
+            f"kind {kind!r} in EVENT_KINDS but undocumented in "
+            "docs/OBSERVABILITY.md")
+    for kind in sorted(documented - EVENT_KINDS):
+        problems.append(
+            f"kind {kind!r} documented in docs/OBSERVABILITY.md but "
+            "missing from EVENT_KINDS (stale docs?)")
+    if strict:
+        for kind in sorted(EVENT_KINDS - set(emitted)):
+            site = _INDIRECT_KINDS.get(kind)
+            if site is not None:
+                with open(os.path.join(ROOT, site), encoding="utf-8") as f:
+                    if f'"{kind}"' in f.read():
+                        continue        # indirect emission still in place
+            problems.append(
+                f"kind {kind!r} has ZERO emit sites in feddrift_tpu/ — "
+                "dead taxonomy entry (remove it, or emit it)")
+    # sanity: the scan itself must see emission sites, otherwise a regex
+    # rot would make this check pass vacuously
+    if not emitted:
+        problems.append("scan found no emit() sites — checker regex broken?")
+    return problems
+
+
+_SITE_RE = re.compile(r"\(([^():]+\.py):(\d+)\)")
+
+
+def rule_r6(strict: bool = False) -> List[Finding]:
+    """R6 event-taxonomy drift, as lint findings. Problems that name an
+    emit site are attributed to it; taxonomy/doc drift is attributed to
+    the EVENT_KINDS declaration and the doc table respectively."""
+    events_rel = os.path.join("feddrift_tpu", "obs", "events.py")
+    doc_rel = os.path.join("docs", "OBSERVABILITY.md")
+    out: List[Finding] = []
+    for p in check(strict=strict):
+        m = _SITE_RE.search(p)
+        if m:
+            path, line = m.group(1), int(m.group(2))
+        elif "OBSERVABILITY.md but" in p or "missing taxonomy doc" in p:
+            path, line = doc_rel, 1
+        else:
+            path, line = events_rel, 1
+        out.append(Finding(
+            rule="R6", severity="error", path=path, line=line, message=p,
+            hint="keep EVENT_KINDS, emit() literals and the "
+                 "docs/OBSERVABILITY.md taxonomy table in lockstep"))
+    return out
+
+
+def main(argv: List[str]) -> int:
+    """Entry point preserved for the scripts/check_events_schema.py shim."""
+    import sys
+    if "--list" in argv:
+        # machine-consumable taxonomy dump, one kind per line (used by
+        # tests/test_obs_perf.py and handy for grepping run artifacts)
+        from feddrift_tpu.obs.events import EVENT_KINDS
+        for kind in sorted(EVENT_KINDS):
+            print(kind)
+        return 0
+    problems = check(strict="--strict" in argv)
+    for p in problems:
+        print(f"check_events_schema: {p}", file=sys.stderr)
+    if not problems:
+        print(f"check_events_schema: OK "
+              f"({len(emitted_kinds(os.path.join(ROOT, 'feddrift_tpu')))} "
+              "distinct kinds emitted, taxonomy consistent)")
+    return 1 if problems else 0

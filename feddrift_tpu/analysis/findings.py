@@ -1,0 +1,143 @@
+"""Findings model for graftlint: rule id, severity, location, hint,
+``# lint: <rule>-ok`` suppressions, and the stable ``--json`` schema."""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import re
+from typing import Dict, List, Optional
+
+JSON_SCHEMA_VERSION = 1
+
+#: ``# lint: r1-ok``, ``# lint: r1-ok (why)``, ``# lint: r2-ok,r4-ok (why)``
+#: — also matches the hot-region markers, which share the ``# lint:`` prefix
+#: but are handled by rule R2, not here.
+_SUPPRESS_RE = re.compile(
+    r"#\s*lint:\s*(?P<rules>[rR]\d+-ok(?:\s*,\s*[rR]\d+-ok)*)"
+    r"(?:\s*\((?P<why>[^)]*)\))?")
+
+
+@dataclasses.dataclass
+class Finding:
+    """One lint hit. ``line`` is 1-based; ``path`` is repo-relative when the
+    engine can make it so, absolute otherwise."""
+
+    rule: str            # "R1".."R6"
+    severity: str        # "error" | "warn"
+    path: str
+    line: int
+    message: str
+    hint: str = ""
+    suppressed: bool = False
+    justification: str = ""
+
+    def location(self) -> str:
+        return f"{self.path}:{self.line}"
+
+    def render(self) -> str:
+        sup = f"  [suppressed: {self.justification or 'no justification'}]" \
+            if self.suppressed else ""
+        hint = f"\n    hint: {self.hint}" if self.hint else ""
+        return (f"{self.location()}: {self.rule} {self.severity}: "
+                f"{self.message}{sup}{hint}")
+
+    def to_dict(self) -> Dict[str, object]:
+        return dataclasses.asdict(self)
+
+
+def parse_suppressions(source: str) -> Dict[int, Dict[str, str]]:
+    """Map line -> {RULE: justification} for every ``# lint: rX-ok`` comment.
+
+    A suppression covers the finding on its own line (trailing comment) and,
+    when the comment is the only thing on its line, the next non-blank line —
+    so both styles work:
+
+        x = cfg.knob  # lint: r1-ok (legacy alias)
+
+        # lint: r1-ok (legacy alias)
+        x = cfg.knob
+    """
+    out: Dict[int, Dict[str, str]] = {}
+    lines = source.splitlines()
+    for i, text in enumerate(lines, start=1):
+        m = _SUPPRESS_RE.search(text)
+        if not m:
+            continue
+        why = (m.group("why") or "").strip()
+        rules = {r.split("-")[0].upper(): why
+                 for r in re.split(r"\s*,\s*", m.group("rules"))}
+        out.setdefault(i, {}).update(rules)
+        if text[:m.start()].strip() == "":  # standalone comment line
+            j = i + 1
+            while j <= len(lines) and (not lines[j - 1].strip() or
+                                       lines[j - 1].lstrip().startswith("#")):
+                j += 1
+            if j <= len(lines):
+                out.setdefault(j, {}).update(rules)
+    return out
+
+
+def apply_suppressions(findings: List[Finding],
+                       suppressions: Dict[int, Dict[str, str]]) -> None:
+    for f in findings:
+        rules = suppressions.get(f.line, {})
+        if f.rule in rules:
+            f.suppressed = True
+            f.justification = rules[f.rule]
+
+
+def findings_to_json(findings: List[Finding], *,
+                     strict: bool = False) -> str:
+    active = [f for f in findings if not f.suppressed]
+    counts: Dict[str, int] = {}
+    for f in active:
+        counts[f.rule] = counts.get(f.rule, 0) + 1
+    doc = {
+        "version": JSON_SCHEMA_VERSION,
+        "strict": strict,
+        "counts": counts,
+        "suppressed": sum(1 for f in findings if f.suppressed),
+        "findings": [f.to_dict() for f in findings],
+    }
+    return json.dumps(doc, indent=2, sort_keys=True)
+
+
+def exit_code(findings: List[Finding], *, strict: bool = False) -> int:
+    """1 iff any unsuppressed finding should fail the run: errors always,
+    warns only under ``--strict``."""
+    for f in findings:
+        if f.suppressed:
+            continue
+        if f.severity == "error" or strict:
+            return 1
+    return 0
+
+
+def sort_findings(findings: List[Finding]) -> List[Finding]:
+    return sorted(findings, key=lambda f: (f.path, f.line, f.rule))
+
+
+def summarize(findings: List[Finding]) -> str:
+    active = [f for f in findings if not f.suppressed]
+    sup = sum(1 for f in findings if f.suppressed)
+    if not active:
+        return (f"graftlint: clean ({sup} suppressed)" if sup
+                else "graftlint: clean")
+    by_rule: Dict[str, int] = {}
+    for f in active:
+        by_rule[f.rule] = by_rule.get(f.rule, 0) + 1
+    parts = ", ".join(f"{r}={n}" for r, n in sorted(by_rule.items()))
+    return f"graftlint: {len(active)} finding(s) ({parts}), {sup} suppressed"
+
+
+def maybe_relpath(path: str, root: Optional[str]) -> str:
+    if root:
+        try:
+            import os
+            rel = os.path.relpath(path, root)
+            if not rel.startswith(".."):
+                return rel
+        except ValueError:
+            pass
+    return path

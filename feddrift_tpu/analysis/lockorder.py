@@ -1,0 +1,273 @@
+"""Test-mode lock-order recorder: acquisition-graph cycle detection.
+
+The PR 9 incident: AlertMonitor registered itself as a bus tap and then
+emitted *under its own non-reentrant Lock*; taps run synchronously on the
+emitting thread, so the tap re-entered itself and self-deadlocked the
+whole observability plane. The static side of that class is lint rule R3;
+this module is the runtime side, wired into tests/conftest.py for the
+threaded suites.
+
+Install wraps the ``threading.Lock``/``threading.RLock`` factories so that
+every lock subsequently created *by repo code* (filtered by the creator's
+source file) is instrumented:
+
+- per-thread held-lock stacks record an acquisition-order edge
+  ``already-held -> newly-acquired`` labelled with both creation sites;
+- a same-thread re-acquisition of a held non-reentrant Lock — the PR 9
+  class, which would block forever — is recorded as a self-edge violation
+  and reported immediately instead of hanging the suite;
+- :meth:`LockOrderRecorder.check` runs DFS cycle detection over the
+  accumulated edge set: a cycle means two threads can acquire the same
+  locks in opposite orders, i.e. a latent deadlock no single run need hit.
+
+Deliberately zero-dependency and stdlib-only; never active outside tests.
+"""
+
+from __future__ import annotations
+
+import threading
+import traceback
+from typing import Dict, List, Optional, Set, Tuple
+
+
+class LockOrderViolation(AssertionError):
+    """Raised by check()/acquire-time detection on a provable deadlock."""
+
+
+class _Instrumented:
+    """Wrapper around one threading.Lock/RLock instance."""
+
+    __slots__ = ("_lock", "_reentrant", "site", "_rec", "_owner",
+                 "_count")
+
+    def __init__(self, rec: "LockOrderRecorder", reentrant: bool,
+                 site: str, raw_lock):
+        # raw_lock comes from the ORIGINAL factory captured at install();
+        # calling threading.Lock() here would re-enter the patched one
+        self._lock = raw_lock
+        self._reentrant = reentrant
+        self.site = site
+        self._rec = rec
+        self._owner: Optional[int] = None
+        self._count = 0
+
+    # -- lock protocol ------------------------------------------------------
+
+    def acquire(self, blocking: bool = True, timeout: float = -1):
+        me = threading.get_ident()
+        if not self._reentrant and self._owner == me:
+            # PR 9 class: this acquire would block forever. Record the
+            # self-cycle, then raise instead of hanging the test run.
+            self._rec.record_self_deadlock(self)
+            raise LockOrderViolation(
+                f"same-thread re-acquisition of non-reentrant lock "
+                f"created at {self.site} — this is a self-deadlock "
+                "(the PR 9 tap-re-entrancy class)")
+        self._rec.note_acquire(self)
+        ok = self._lock.acquire(blocking, timeout)
+        if ok:
+            self._owner = me
+            self._count += 1
+            self._rec.push_held(self)
+        else:
+            self._rec.abort_acquire(self)
+        return ok
+
+    def release(self):
+        self._count -= 1
+        if self._count == 0:
+            self._owner = None
+        self._rec.pop_held(self)
+        self._lock.release()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+    def locked(self):
+        return self._lock.locked() if not self._reentrant else \
+            self._owner is not None
+
+
+class LockOrderRecorder:
+    """Monkeypatches the threading lock factories; collects the global
+    acquisition-order graph across all instrumented locks."""
+
+    def __init__(self, path_filters: Tuple[str, ...] = ("feddrift_tpu",
+                                                        "tests")):
+        self.path_filters = path_filters
+        self._tls = threading.local()
+        self._mu = threading.Lock()     # guards the graph, never wrapped
+        # edge (site_a -> site_b): thread acquired b while holding a
+        self.edges: Dict[Tuple[str, str], int] = {}
+        self.violations: List[str] = []
+        self.locks_created = 0
+        # distinct locks created at the same source line get #2, #3 …
+        # suffixes so nested acquisition of same-site siblings (striped
+        # locks, comprehension-created pools) is not a spurious self-edge
+        self._site_counts: Dict[str, int] = {}
+        self._orig_lock = None
+        self._orig_rlock = None
+
+    # -- factory patching ---------------------------------------------------
+
+    @staticmethod
+    def _creation_site() -> str:
+        for frame in reversed(traceback.extract_stack()[:-3]):
+            return f"{frame.filename}:{frame.lineno}"
+        return "<unknown>"
+
+    def _should_wrap(self) -> bool:
+        # instrument only locks created by repo/test code, two frames up
+        # (caller of the patched factory); stdlib/third-party locks keep
+        # their native type so we never perturb interpreter internals
+        stack = traceback.extract_stack()
+        for frame in reversed(stack[:-2]):
+            fn = frame.filename.replace("\\", "/")
+            if "/analysis/lockorder.py" in fn:
+                continue
+            return any(f"/{p}/" in fn or fn.endswith(f"/{p}")
+                       for p in self.path_filters)
+        return False
+
+    def install(self) -> "LockOrderRecorder":
+        assert self._orig_lock is None, "recorder already installed"
+        self._orig_lock = threading.Lock
+        self._orig_rlock = threading.RLock
+        rec = self
+
+        def make(reentrant: bool, orig):
+            def factory():
+                if not rec._should_wrap():
+                    return orig()
+                stack = traceback.extract_stack()[:-1]
+                site = "<unknown>"
+                for frame in reversed(stack):
+                    fn = frame.filename.replace("\\", "/")
+                    if "/analysis/lockorder.py" not in fn:
+                        site = f"{frame.filename}:{frame.lineno}"
+                        break
+                with rec._mu:
+                    rec.locks_created += 1
+                    n = rec._site_counts.get(site, 0) + 1
+                    rec._site_counts[site] = n
+                    if n > 1:
+                        site = f"{site}#{n}"
+                return _Instrumented(rec, reentrant, site, orig())
+            return factory
+
+        threading.Lock = make(False, self._orig_lock)
+        threading.RLock = make(True, self._orig_rlock)
+        return self
+
+    def uninstall(self) -> None:
+        if self._orig_lock is not None:
+            threading.Lock = self._orig_lock
+            threading.RLock = self._orig_rlock
+            self._orig_lock = self._orig_rlock = None
+
+    def __enter__(self) -> "LockOrderRecorder":
+        return self.install()
+
+    def __exit__(self, *exc) -> None:
+        self.uninstall()
+
+    # -- acquisition bookkeeping -------------------------------------------
+
+    def _held(self) -> List[_Instrumented]:
+        held = getattr(self._tls, "held", None)
+        if held is None:
+            held = self._tls.held = []
+        return held
+
+    def note_acquire(self, lock: _Instrumented) -> None:
+        held = self._held()
+        if not held:
+            return
+        with self._mu:
+            for h in held:
+                if h is lock:       # RLock re-entry: no new edge
+                    continue
+                edge = (h.site, lock.site)
+                self.edges[edge] = self.edges.get(edge, 0) + 1
+
+    def push_held(self, lock: _Instrumented) -> None:
+        self._held().append(lock)
+
+    def pop_held(self, lock: _Instrumented) -> None:
+        held = self._held()
+        for i in range(len(held) - 1, -1, -1):
+            if held[i] is lock:
+                del held[i]
+                return
+
+    def abort_acquire(self, lock: _Instrumented) -> None:
+        pass    # non-blocking acquire failed: nothing was pushed
+
+    def record_self_deadlock(self, lock: _Instrumented) -> None:
+        with self._mu:
+            edge = (lock.site, lock.site)
+            self.edges[edge] = self.edges.get(edge, 0) + 1
+            self.violations.append(
+                f"self-deadlock: non-reentrant lock {lock.site} "
+                "re-acquired by its holding thread")
+
+    # -- analysis -----------------------------------------------------------
+
+    def find_cycle(self) -> Optional[List[str]]:
+        """DFS over the site-level acquisition graph; returns one cycle as
+        a site list (first == last), or None if the graph is acyclic."""
+        with self._mu:
+            adj: Dict[str, Set[str]] = {}
+            for a, b in self.edges:
+                adj.setdefault(a, set()).add(b)
+        WHITE, GRAY, BLACK = 0, 1, 2
+        color = {n: WHITE for n in adj}
+        parent: Dict[str, str] = {}
+
+        def dfs(n: str) -> Optional[List[str]]:
+            color[n] = GRAY
+            for m in sorted(adj.get(n, ())):
+                if color.get(m, WHITE) == GRAY:
+                    cyc = [m, n]
+                    cur = n
+                    while cur != m:
+                        cur = parent[cur]
+                        cyc.append(cur)
+                    cyc.reverse()
+                    return cyc
+                if color.get(m, WHITE) == WHITE:
+                    parent[m] = n
+                    got = dfs(m)
+                    if got:
+                        return got
+            color[n] = BLACK
+            return None
+
+        for n in sorted(adj):
+            if color[n] == WHITE:
+                got = dfs(n)
+                if got:
+                    return got
+        return None
+
+    def check(self) -> None:
+        """Raise LockOrderViolation on any recorded violation or on a cycle
+        in the acquisition graph; no-op when the graph is acyclic."""
+        if self.violations:
+            raise LockOrderViolation("; ".join(self.violations))
+        cyc = self.find_cycle()
+        if cyc:
+            raise LockOrderViolation(
+                "lock acquisition-order cycle (latent deadlock): "
+                + " -> ".join(cyc))
+
+    def summary(self) -> str:
+        with self._mu:
+            return (f"lockorder: {self.locks_created} locks instrumented, "
+                    f"{len(self.edges)} acquisition edges, "
+                    f"{len(self.violations)} violations")

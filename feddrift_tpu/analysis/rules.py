@@ -1,0 +1,629 @@
+"""graftlint rules R1–R5 (AST passes; R6 lives in events_schema).
+
+Each rule is a function ``(FileContext) -> list[Finding]``. The engine
+builds one FileContext per scanned file and runs every applicable rule;
+suppressions are applied afterwards by the engine, so rules always report.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+import re
+from typing import Dict, FrozenSet, List, Optional, Sequence, Set, Tuple
+
+from feddrift_tpu.analysis.findings import Finding
+
+
+@dataclasses.dataclass
+class FileContext:
+    path: str            # as reported in findings (repo-relative if possible)
+    abspath: str
+    source: str
+    tree: ast.AST
+    cfg_registry: FrozenSet[str]     # declared ExperimentConfig names
+    in_package: bool                 # file lives under feddrift_tpu/
+    rel_in_repo: str                 # repo-relative posix path ("" if outside)
+
+
+def _unparse(node: Optional[ast.AST]) -> str:
+    if node is None:
+        return ""
+    try:
+        return ast.unparse(node)
+    except Exception:
+        return ""
+
+
+# --------------------------------------------------------------------------
+# R1: cfg-registry — every cfg.<attr> / getattr(cfg, "...") must resolve to
+# a name declared on ExperimentConfig. Catches typo'd knobs that silently
+# default (a 60+ knob surface makes this the likeliest silent bug).
+# --------------------------------------------------------------------------
+
+def config_registry(config_path: str) -> FrozenSet[str]:
+    """Names declared on ExperimentConfig: annotated fields, plain class
+    attrs, methods and properties."""
+    with open(config_path, encoding="utf-8") as f:
+        tree = ast.parse(f.read(), filename=config_path)
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef) and node.name == "ExperimentConfig":
+            names: Set[str] = set()
+            for item in node.body:
+                if isinstance(item, ast.AnnAssign) and \
+                        isinstance(item.target, ast.Name):
+                    names.add(item.target.id)
+                elif isinstance(item, ast.Assign):
+                    for t in item.targets:
+                        if isinstance(t, ast.Name):
+                            names.add(t.id)
+                elif isinstance(item, (ast.FunctionDef,
+                                       ast.AsyncFunctionDef)):
+                    names.add(item.name)
+            return frozenset(names)
+    raise RuntimeError(f"ExperimentConfig not found in {config_path}")
+
+
+_CFG_NAMES = ("cfg", "config")
+
+
+class _R1Visitor(ast.NodeVisitor):
+    """Scope-aware cfg attribute checker.
+
+    The repo convention is that a variable named ``cfg``/``config`` holds an
+    ExperimentConfig. Exemptions, so e.g. turboagg's ``cfg: RingConfig``
+    doesn't false-positive:
+
+    - a function whose ``cfg`` param is annotated with any other type is
+      exempt for bare ``cfg.X`` accesses;
+    - a class whose ``__init__`` takes a non-ExperimentConfig ``cfg`` is
+      exempt for ``self.cfg.X`` and for ``cfg = self.cfg`` locals;
+    - a local ``cfg = SomethingElseConfig(...)`` assignment exempts the
+      enclosing function.
+    """
+
+    def __init__(self, ctx: FileContext):
+        self.ctx = ctx
+        self.findings: List[Finding] = []
+        self._class_exempt = [False]
+        self._scope_exempt = [False]
+
+    # -- exemption plumbing -------------------------------------------------
+
+    @staticmethod
+    def _ann_is_experiment(ann: Optional[ast.AST]) -> Optional[bool]:
+        """True/False for an annotation, None when unannotated."""
+        if ann is None:
+            return None
+        return "ExperimentConfig" in _unparse(ann)
+
+    def _class_cfg_exempt(self, node: ast.ClassDef) -> bool:
+        for item in node.body:
+            if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and item.name == "__init__":
+                for a in (item.args.posonlyargs + item.args.args
+                          + item.args.kwonlyargs):
+                    if a.arg in _CFG_NAMES:
+                        return self._ann_is_experiment(a.annotation) is False
+        return False
+
+    def _func_cfg_exempt(self, node) -> bool:
+        for a in (node.args.posonlyargs + node.args.args
+                  + node.args.kwonlyargs):
+            if a.arg in _CFG_NAMES:
+                return self._ann_is_experiment(a.annotation) is False
+        # local rebinds: cfg = self.cfg inherits the class verdict;
+        # cfg = OtherConfig(...) exempts outright
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Assign) and any(
+                    isinstance(t, ast.Name) and t.id in _CFG_NAMES
+                    for t in sub.targets):
+                src = _unparse(sub.value)
+                if re.fullmatch(r"self\.(cfg|config)", src):
+                    if self._class_exempt[-1]:
+                        return True
+                elif re.search(r"\b(?!ExperimentConfig\b)\w+Config\b", src):
+                    return True
+        return False
+
+    # -- traversal ----------------------------------------------------------
+
+    def visit_ClassDef(self, node: ast.ClassDef) -> None:
+        self._class_exempt.append(self._class_cfg_exempt(node))
+        self.generic_visit(node)
+        self._class_exempt.pop()
+
+    def _visit_func(self, node) -> None:
+        self._scope_exempt.append(self._func_cfg_exempt(node))
+        self.generic_visit(node)
+        self._scope_exempt.pop()
+
+    visit_FunctionDef = _visit_func
+    visit_AsyncFunctionDef = _visit_func
+
+    # -- checks -------------------------------------------------------------
+
+    @staticmethod
+    def _recv_is_cfg(v: ast.Attribute) -> bool:
+        """``X.cfg`` counts for any base; ``X.config`` only for self —
+        'config' is too common a sub-attribute on other libraries
+        (jax.config, wandb.config) to assume it's an ExperimentConfig."""
+        if v.attr == "cfg":
+            return True
+        return v.attr == "config" and \
+            isinstance(v.value, ast.Name) and v.value.id == "self"
+
+    def _check_attr(self, attr: str, line: int, recv: str) -> None:
+        if attr in self.ctx.cfg_registry or attr.startswith("__"):
+            return
+        self.findings.append(Finding(
+            rule="R1", severity="error", path=self.ctx.path, line=line,
+            message=f"'{recv}.{attr}' does not resolve to a declared "
+                    "ExperimentConfig field — typo'd knobs silently default",
+            hint="declare the field in feddrift_tpu/config.py, fix the "
+                 "spelling, or annotate the cfg parameter with its real "
+                 "(non-ExperimentConfig) type"))
+
+    def visit_Attribute(self, node: ast.Attribute) -> None:
+        v = node.value
+        if isinstance(v, ast.Name) and v.id in _CFG_NAMES:
+            if not self._scope_exempt[-1]:
+                self._check_attr(node.attr, node.lineno, v.id)
+        elif isinstance(v, ast.Attribute) and self._recv_is_cfg(v):
+            # self.cfg.X / exp.cfg.X: trust the enclosing-class verdict for
+            # self; other receivers follow the package convention
+            is_self = isinstance(v.value, ast.Name) and v.value.id == "self"
+            if not (is_self and self._class_exempt[-1]):
+                self._check_attr(node.attr, node.lineno,
+                                 _unparse(v) or "cfg")
+        self.generic_visit(node)
+
+    def visit_Call(self, node: ast.Call) -> None:
+        f = node.func
+        if isinstance(f, ast.Name) and f.id == "getattr" and \
+                len(node.args) >= 2:
+            tgt = node.args[0]
+            is_cfg = (isinstance(tgt, ast.Name) and tgt.id in _CFG_NAMES
+                      and not self._scope_exempt[-1]) or \
+                     (isinstance(tgt, ast.Attribute)
+                      and self._recv_is_cfg(tgt)
+                      and not (isinstance(tgt.value, ast.Name)
+                               and tgt.value.id == "self"
+                               and self._class_exempt[-1]))
+            name = node.args[1]
+            if is_cfg and isinstance(name, ast.Constant) and \
+                    isinstance(name.value, str):
+                self._check_attr(name.value, node.lineno, _unparse(tgt))
+        self.generic_visit(node)
+
+
+def rule_r1(ctx: FileContext) -> List[Finding]:
+    v = _R1Visitor(ctx)
+    v.visit(ctx.tree)
+    return v.findings
+
+
+# --------------------------------------------------------------------------
+# R2: host-sync-in-hot-path — device->host syncs inside regions marked
+#   # lint: hot-path-begin [(label)] ... # lint: hot-path-end
+# Each .item()/float()/np.asarray/block_until_ready in a hot region is a
+# dispatch-gap contributor critical_path can only observe after the fact.
+# --------------------------------------------------------------------------
+
+_HOT_BEGIN_RE = re.compile(r"#\s*lint:\s*hot-path-begin\b")
+_HOT_END_RE = re.compile(r"#\s*lint:\s*hot-path-end\b")
+
+_SYNC_ATTRS = ("item", "block_until_ready", "device_get")
+
+
+def _hot_regions(ctx: FileContext) -> Tuple[List[Tuple[int, int]],
+                                            List[Finding]]:
+    regions: List[Tuple[int, int]] = []
+    findings: List[Finding] = []
+    open_line: Optional[int] = None
+    for i, text in enumerate(ctx.source.splitlines(), start=1):
+        if _HOT_BEGIN_RE.search(text):
+            if open_line is not None:
+                findings.append(Finding(
+                    rule="R2", severity="error", path=ctx.path, line=i,
+                    message="nested/unterminated hot-path-begin "
+                            f"(previous opened at line {open_line})",
+                    hint="close the previous region with "
+                         "'# lint: hot-path-end' first"))
+            open_line = i
+        elif _HOT_END_RE.search(text):
+            if open_line is None:
+                findings.append(Finding(
+                    rule="R2", severity="error", path=ctx.path, line=i,
+                    message="hot-path-end without a matching begin",
+                    hint="add '# lint: hot-path-begin' above the region"))
+            else:
+                regions.append((open_line, i))
+                open_line = None
+    if open_line is not None:
+        findings.append(Finding(
+            rule="R2", severity="error", path=ctx.path, line=open_line,
+            message="hot-path-begin never closed",
+            hint="add '# lint: hot-path-end' after the region"))
+    return regions, findings
+
+
+def rule_r2(ctx: FileContext) -> List[Finding]:
+    regions, findings = _hot_regions(ctx)
+    if not regions:
+        return findings
+
+    def in_region(line: int) -> bool:
+        return any(a < line < b for a, b in regions)
+
+    def flag(node: ast.AST, what: str) -> None:
+        findings.append(Finding(
+            rule="R2", severity="error", path=ctx.path, line=node.lineno,
+            message=f"host sync '{what}' inside a marked hot region — "
+                    "blocks dispatch and serializes the round loop",
+            hint="move it off the hot path (post-loop, async fetch, or "
+                 "on-device reduction), or suppress with a justification"))
+
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call) or not in_region(node.lineno):
+            continue
+        f = node.func
+        if isinstance(f, ast.Attribute):
+            if f.attr in _SYNC_ATTRS and (f.attr != "item" or not node.args):
+                flag(node, _unparse(f) + "()")
+            elif f.attr in ("asarray", "array") and \
+                    isinstance(f.value, ast.Name) and \
+                    f.value.id in ("np", "numpy"):
+                flag(node, _unparse(f) + "(...)")
+            elif f.attr == "fetch" and "multihost" in _unparse(f.value):
+                flag(node, _unparse(f) + "(...)")
+        elif isinstance(f, ast.Name):
+            if f.id == "float" and node.args and \
+                    not isinstance(node.args[0], ast.Constant):
+                flag(node, "float(...)")
+            elif f.id in ("block_until_ready", "device_get"):
+                flag(node, f.id + "()")
+    return findings
+
+
+# --------------------------------------------------------------------------
+# R3: tap-reentrancy — emit() must not be reachable while a NON-reentrant
+# threading.Lock is held on a path starting from a bus-tap entry point.
+# This is exactly the PR 9 AlertMonitor deadlock: taps run synchronously on
+# the emitting thread, so a tap that emits under its own plain Lock
+# re-enters itself and self-deadlocks. Emit under an RLock is the
+# documented-safe pattern and does not fire.
+# --------------------------------------------------------------------------
+
+def _self_attr(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Attribute) and \
+            isinstance(node.value, ast.Name) and node.value.id == "self":
+        return node.attr
+    return None
+
+
+def _is_emit_call(node: ast.Call) -> bool:
+    f = node.func
+    if isinstance(f, ast.Name) and f.id == "emit":
+        return True
+    return isinstance(f, ast.Attribute) and f.attr == "emit"
+
+
+class _ClassInfo:
+    def __init__(self, node: ast.ClassDef):
+        self.node = node
+        self.methods: Dict[str, ast.AST] = {
+            m.name: m for m in node.body
+            if isinstance(m, (ast.FunctionDef, ast.AsyncFunctionDef))}
+        self.locks: Dict[str, str] = {}     # self attr -> "Lock" | "RLock"
+        self.tap_roots: Set[str] = set()
+        for m in self.methods.values():
+            for sub in ast.walk(m):
+                if isinstance(sub, ast.Assign) and \
+                        isinstance(sub.value, ast.Call):
+                    f = sub.value.func
+                    if isinstance(f, ast.Attribute) and \
+                            f.attr in ("Lock", "RLock") and \
+                            "threading" in _unparse(f.value):
+                        for t in sub.targets:
+                            attr = _self_attr(t)
+                            if attr:
+                                self.locks[attr] = f.attr
+                if isinstance(sub, ast.Call) and \
+                        isinstance(sub.func, ast.Attribute) and \
+                        sub.func.attr == "add_tap":
+                    for a in sub.args:
+                        attr = _self_attr(a)
+                        if attr:
+                            self.tap_roots.add(attr)
+
+
+class _R3Scanner:
+    def __init__(self, ctx: FileContext, info: _ClassInfo):
+        self.ctx = ctx
+        self.info = info
+        self.findings: List[Finding] = []
+        self._seen: Set[Tuple[str, FrozenSet[str]]] = set()
+
+    def scan_root(self, root: str) -> None:
+        self._scan_method(root, frozenset(), root)
+
+    def _scan_method(self, name: str, held: FrozenSet[str],
+                     root: str) -> None:
+        key = (name, held)
+        if key in self._seen:
+            return
+        self._seen.add(key)
+        node = self.info.methods.get(name)
+        if node is not None:
+            for stmt in node.body:
+                self._visit(stmt, held, root)
+
+    def _visit(self, node: ast.AST, held: FrozenSet[str],
+               root: str) -> None:
+        if isinstance(node, ast.With):
+            acquired = set()
+            for item in node.items:
+                self._visit(item.context_expr, held, root)
+                attr = _self_attr(item.context_expr)
+                if attr and self.info.locks.get(attr) == "Lock":
+                    acquired.add(attr)
+            inner = held | frozenset(acquired)
+            for stmt in node.body:
+                self._visit(stmt, inner, root)
+            return
+        if isinstance(node, ast.Call):
+            if held and _is_emit_call(node):
+                locks = ", ".join(f"self.{a}" for a in sorted(held))
+                self.findings.append(Finding(
+                    rule="R3", severity="error", path=self.ctx.path,
+                    line=node.lineno,
+                    message=f"emit() reachable from tap "
+                            f"'{self.info.node.name}.{root}' while holding "
+                            f"non-reentrant {locks} — taps run on the "
+                            "emitting thread, so this re-enters and "
+                            "deadlocks",
+                    hint="use threading.RLock() for locks held across "
+                         "emit(), or emit after releasing the lock"))
+            callee = _self_attr(node.func)
+            if callee and callee in self.info.methods:
+                self._scan_method(callee, held, root)
+        for child in ast.iter_child_nodes(node):
+            self._visit(child, held, root)
+
+
+def rule_r3(ctx: FileContext) -> List[Finding]:
+    findings: List[Finding] = []
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.ClassDef):
+            continue
+        info = _ClassInfo(node)
+        if not info.tap_roots or not info.locks:
+            continue
+        scanner = _R3Scanner(ctx, info)
+        for root in sorted(info.tap_roots):
+            scanner.scan_root(root)
+        findings.extend(scanner.findings)
+    return findings
+
+
+# --------------------------------------------------------------------------
+# R4: nondeterminism — bare np.random.* / random.* / time.time() in
+# seeded-replay modules. Cluster decisions must replay bitwise under
+# kill/resume and megastep fusion; any unseeded draw or wall-clock input
+# breaks that. Explicitly-seeded constructors are allowed.
+# --------------------------------------------------------------------------
+
+#: repo-relative prefixes whose modules feed the seeded replay path
+R4_MODULE_PREFIXES = (
+    "feddrift_tpu/algorithms/",
+    "feddrift_tpu/core/",
+    "feddrift_tpu/data/",
+    "feddrift_tpu/platform/registry.py",
+    "feddrift_tpu/resilience/participation.py",
+    "feddrift_tpu/utils/prng.py",
+)
+
+_NP_RANDOM_ALLOWED = {"default_rng", "RandomState", "Generator",
+                      "SeedSequence", "PCG64", "Philox"}
+_STDLIB_RANDOM_ALLOWED = {"Random", "SystemRandom"}
+
+
+def _r4_applies(ctx: FileContext) -> bool:
+    if not ctx.in_package:
+        return True     # golden fixtures / arbitrary paths: all rules run
+    rel = ctx.rel_in_repo
+    return any(rel.startswith(p) if p.endswith("/") else rel == p
+               for p in R4_MODULE_PREFIXES)
+
+
+def rule_r4(ctx: FileContext) -> List[Finding]:
+    if not _r4_applies(ctx):
+        return []
+    findings: List[Finding] = []
+
+    def flag(node: ast.AST, what: str) -> None:
+        findings.append(Finding(
+            rule="R4", severity="error", path=ctx.path, line=node.lineno,
+            message=f"'{what}' in a seeded-replay module — breaks bitwise "
+                    "kill/resume and megastep-parity replay",
+            hint="draw from the experiment-seeded generator "
+                 "(utils/prng.py) or pass the value in from the driver"))
+
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        src = _unparse(f)
+        if isinstance(f, ast.Attribute):
+            base = _unparse(f.value)
+            if base in ("np.random", "numpy.random") and \
+                    f.attr not in _NP_RANDOM_ALLOWED:
+                flag(node, src + "()")
+            elif base == "random" and f.attr not in _STDLIB_RANDOM_ALLOWED:
+                flag(node, src + "()")
+            elif base == "time" and f.attr in ("time", "time_ns"):
+                flag(node, src + "()")
+    return findings
+
+
+# --------------------------------------------------------------------------
+# R5: jit-static hygiene — static_argnames entries must exist in the
+# wrapped signature (a mismatched name is silently ignored by jax and the
+# argument becomes a traced value: a new compile per distinct value, the
+# PR 10 silent-recompile class), static_argnums must be in positional
+# range, and donated buffers must not be read after dispatch in the same
+# scope (donation invalidates the buffer).
+# --------------------------------------------------------------------------
+
+def _jit_call_parts(call: ast.Call) -> Optional[Dict[str, ast.AST]]:
+    """Return the keyword map for a jax.jit(...) or partial(jax.jit, ...)
+    call, else None."""
+    f = call.func
+    src = _unparse(f)
+    if src in ("jax.jit", "jit"):
+        return {kw.arg: kw.value for kw in call.keywords if kw.arg}
+    if isinstance(f, ast.Name) and f.id == "partial" and call.args and \
+            _unparse(call.args[0]) in ("jax.jit", "jit"):
+        return {kw.arg: kw.value for kw in call.keywords if kw.arg}
+    return None
+
+
+def _const_strs(node: ast.AST) -> Optional[List[str]]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return [node.value]
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for e in node.elts:
+            if isinstance(e, ast.Constant) and isinstance(e.value, str):
+                out.append(e.value)
+            else:
+                return None
+        return out
+    return None
+
+
+def _const_ints(node: ast.AST) -> Optional[List[int]]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return [node.value]
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for e in node.elts:
+            if isinstance(e, ast.Constant) and isinstance(e.value, int):
+                out.append(e.value)
+            else:
+                return None
+        return out
+    return None
+
+
+def _check_sig(ctx: FileContext, call: ast.Call, kws: Dict[str, ast.AST],
+               fn: ast.AST, findings: List[Finding]) -> None:
+    args = fn.args
+    names = [a.arg for a in args.posonlyargs + args.args + args.kwonlyargs]
+    pos_n = len(args.posonlyargs) + len(args.args)
+    statics = _const_strs(kws.get("static_argnames")) \
+        if "static_argnames" in kws else []
+    for s in statics or []:
+        if s not in names and args.kwarg is None:
+            findings.append(Finding(
+                rule="R5", severity="error", path=ctx.path,
+                line=call.lineno,
+                message=f"static_argnames entry '{s}' is not a parameter "
+                        f"of '{fn.name}' — jax silently ignores it and "
+                        "the argument stays traced (recompile per value)",
+                hint=f"parameters are: {', '.join(names)}"))
+    nums = _const_ints(kws.get("static_argnums")) \
+        if "static_argnums" in kws else []
+    for n in nums or []:
+        if args.vararg is None and not (0 <= n < pos_n):
+            findings.append(Finding(
+                rule="R5", severity="error", path=ctx.path,
+                line=call.lineno,
+                message=f"static_argnums index {n} is out of range for "
+                        f"'{fn.name}' ({pos_n} positional parameters)",
+                hint="static_argnums indexes the positional parameter "
+                     "list of the wrapped function"))
+
+
+def _donated_read_scan(ctx: FileContext, scope_body: Sequence[ast.AST],
+                       findings: List[Finding]) -> None:
+    """Within one straight-line scope: g = jax.jit(f, donate_argnums=...)
+    then g(x, y); any later read of a donated argument name is a read of
+    an invalidated buffer."""
+    jitted: Dict[str, List[int]] = {}
+    donated: Dict[str, int] = {}    # var name -> call line that donated it
+    for stmt in scope_body:
+        # rebinding a name un-donates it
+        if isinstance(stmt, ast.Assign):
+            for t in stmt.targets:
+                if isinstance(t, ast.Name):
+                    donated.pop(t.id, None)
+            if isinstance(stmt.value, ast.Call):
+                kws = _jit_call_parts(stmt.value)
+                if kws is not None and "donate_argnums" in kws:
+                    nums = _const_ints(kws["donate_argnums"]) or []
+                    for t in stmt.targets:
+                        if isinstance(t, ast.Name):
+                            jitted[t.id] = nums
+                    continue
+        for node in ast.walk(stmt):
+            if isinstance(node, ast.Call) and \
+                    isinstance(node.func, ast.Name) and \
+                    node.func.id in jitted:
+                for i in jitted[node.func.id]:
+                    if i < len(node.args) and \
+                            isinstance(node.args[i], ast.Name):
+                        donated[node.args[i].id] = node.lineno
+            elif isinstance(node, ast.Name) and \
+                    isinstance(node.ctx, ast.Load) and node.id in donated \
+                    and node.lineno > donated[node.id]:
+                findings.append(Finding(
+                    rule="R5", severity="error", path=ctx.path,
+                    line=node.lineno,
+                    message=f"read of '{node.id}' after it was donated to "
+                            f"a jit call at line {donated[node.id]} — the "
+                            "buffer is invalidated by donation",
+                    hint="use the jit call's result, or drop "
+                         "donate_argnums for this argument"))
+                donated.pop(node.id)    # one report per donation
+
+
+def rule_r5(ctx: FileContext) -> List[Finding]:
+    findings: List[Finding] = []
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                if isinstance(dec, ast.Call):
+                    kws = _jit_call_parts(dec)
+                    if kws is not None:
+                        _check_sig(ctx, dec, kws, node, findings)
+            _donated_read_scan(ctx, node.body, findings)
+        elif isinstance(node, ast.Module):
+            _donated_read_scan(ctx, node.body, findings)
+        elif isinstance(node, ast.Assign) and isinstance(node.value,
+                                                         ast.Call):
+            # g = jax.jit(f, static_argnames=...): resolve f in-module
+            kws = _jit_call_parts(node.value)
+            if kws is not None and node.value.args:
+                tgt = node.value.args[-1] if isinstance(
+                    node.value.func, ast.Name) and \
+                    node.value.func.id == "partial" else node.value.args[0]
+                if isinstance(tgt, ast.Name):
+                    for sub in ast.walk(ctx.tree):
+                        if isinstance(sub, (ast.FunctionDef,
+                                            ast.AsyncFunctionDef)) and \
+                                sub.name == tgt.id:
+                            _check_sig(ctx, node.value, kws, sub, findings)
+                            break
+    return findings
+
+
+FILE_RULES = {
+    "R1": rule_r1,
+    "R2": rule_r2,
+    "R3": rule_r3,
+    "R4": rule_r4,
+    "R5": rule_r5,
+}

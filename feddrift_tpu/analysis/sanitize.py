@@ -1,0 +1,124 @@
+"""``cfg.sanitize`` debug mode: tracer-leak + NaN checks and an absolute
+steady-state recompile budget.
+
+The PR 10 incident: a sharding/committed-ness mismatch in the megastep
+cache key silently recompiled the full round program every block — caught
+only because a reviewer eyeballed wall-clock. The PR 1 compile tracker
+already emits ``jit_compile``/``jit_recompile`` events with iteration
+context; sanitize mode turns those into a hard budget: after warm-up,
+more than ``cfg.sanitize_recompile_budget`` recompiles fails the run
+instead of silently burning the accelerator.
+
+Deliberately cheap: a bus tap counting events, checked from the driver
+loop between rounds — nothing on the dispatch path.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Dict, List, Optional
+
+log = logging.getLogger("feddrift_tpu")
+
+_JAX_FLAGS = ("jax_check_tracer_leaks", "jax_debug_nans")
+
+
+def apply_jax_flags(enable: bool = True) -> Dict[str, object]:
+    """Flip jax_check_tracer_leaks/jax_debug_nans; returns the previous
+    values so tests can restore them."""
+    import jax
+
+    prev: Dict[str, object] = {}
+    for flag in _JAX_FLAGS:
+        prev[flag] = getattr(jax.config, flag)
+        jax.config.update(flag, enable)
+    return prev
+
+
+def restore_jax_flags(prev: Dict[str, object]) -> None:
+    import jax
+
+    for flag, value in prev.items():
+        jax.config.update(flag, value)
+
+
+class RecompileBudget:
+    """Bus tap: count ``jit_recompile`` events past warm-up against an
+    absolute budget. ``check()`` raises once the budget is exceeded —
+    call it from the driver loop (host-side, between rounds), never from
+    the tap itself (taps must stay non-throwing and off the hot path)."""
+
+    def __init__(self, budget: int):
+        self.budget = budget
+        self._lock = threading.RLock()   # tap + driver threads; RLock so a
+        #                                  check() under an emit path can't
+        #                                  re-enter-deadlock (R3 discipline)
+        self._steady = False
+        self.steady_recompiles = 0
+        self.sites: List[str] = []
+
+    def attach(self, bus) -> "RecompileBudget":
+        bus.add_tap(self.observe)
+        return self
+
+    def mark_steady(self) -> None:
+        """Driver calls this once warm-up compiles are done (end of the
+        first iteration); only recompiles after it count."""
+        with self._lock:
+            self._steady = True
+
+    def observe(self, rec: dict) -> None:
+        if rec.get("kind") != "jit_recompile":
+            return
+        with self._lock:
+            if not self._steady:
+                return
+            self.steady_recompiles += 1
+            if len(self.sites) < 16:
+                self.sites.append(
+                    f"fn={rec.get('fn', '?')} "
+                    f"signatures={rec.get('signature_count', '?')}")
+
+    def exceeded(self) -> bool:
+        with self._lock:
+            return 0 < self.budget < self.steady_recompiles \
+                if self.budget else False
+
+    def check(self) -> None:
+        with self._lock:
+            if self.budget and self.steady_recompiles > self.budget:
+                detail = "; ".join(self.sites[:4])
+                raise RuntimeError(
+                    f"sanitize: {self.steady_recompiles} steady-state "
+                    f"recompiles exceed the budget of {self.budget} "
+                    f"(first sites: {detail}) — a cache-key mismatch is "
+                    "silently recompiling the round program (the PR 10 "
+                    "class); diff the jit_recompile events' signatures")
+
+
+class Sanitizer:
+    """Everything ``cfg.sanitize`` turns on, in one handle the runner owns:
+    jax strict flags at construction, a recompile budget tapped into the
+    experiment bus, checked between rounds."""
+
+    def __init__(self, cfg, bus=None):
+        self.prev_flags = apply_jax_flags(True)
+        self.budget: Optional[RecompileBudget] = None
+        if getattr(cfg, "sanitize_recompile_budget", 0):
+            self.budget = RecompileBudget(cfg.sanitize_recompile_budget)
+            if bus is not None:
+                self.budget.attach(bus)
+        log.info("sanitize: tracer-leak + NaN checks on, recompile "
+                 "budget=%s", cfg.sanitize_recompile_budget or "off")
+
+    def mark_steady(self) -> None:
+        if self.budget is not None:
+            self.budget.mark_steady()
+
+    def check(self) -> None:
+        if self.budget is not None:
+            self.budget.check()
+
+    def close(self) -> None:
+        restore_jax_flags(self.prev_flags)

@@ -21,6 +21,7 @@ checkpoint.
     python -m feddrift_tpu regress bench_new.json --baseline BENCH_r05.json
     python -m feddrift_tpu critical_path runs/my-run  # round segment breakdown
     python -m feddrift_tpu fleet 127.0.0.1:7777  # live multi-process ops table
+    python -m feddrift_tpu lint feddrift_tpu/  # graftlint static analysis
 
 Logging is configured in exactly one place (obs.setup_logging), driven by
 the ``--log_level`` flag every subcommand accepts.
@@ -181,10 +182,24 @@ def main(argv: list[str] | None = None) -> int:
     fl_p.add_argument("--min-lanes", type=int, default=0)
     fl_p.add_argument("--json", action="store_true")
 
+    li_p = sub.add_parser(
+        "lint",
+        help="graftlint: static-analysis pass over the package "
+             "(analysis/ rules R1-R6 — cfg registry, hot-path host "
+             "syncs, tap re-entrancy, nondeterminism, jit-static "
+             "hygiene, event-taxonomy drift); exit 1 on findings")
+    li_p.add_argument("paths", nargs="*", default=["feddrift_tpu"],
+                      help="files/directories to lint "
+                           "(default: feddrift_tpu/)")
+    li_p.add_argument("--json", action="store_true",
+                      help="machine-readable findings (stable schema)")
+    li_p.add_argument("--strict", action="store_true",
+                      help="also fail warnings and dead event kinds")
+
     # --log_level is also accepted after the subcommand for convenience
     # (SUPPRESS default: an absent post-subcommand flag must not clobber a
     # pre-subcommand one — both write the same namespace attribute)
-    for p in (run_p, res_p, rep_p, reg_p, lin_p, cp_p, fl_p):
+    for p in (run_p, res_p, rep_p, reg_p, lin_p, cp_p, fl_p, li_p):
         p.add_argument("--log_level", type=str, default=argparse.SUPPRESS,
                        help=argparse.SUPPRESS)
 
@@ -237,6 +252,12 @@ def main(argv: list[str] | None = None) -> int:
              "--duration", str(args.duration), "--poll", str(args.poll),
              "--min-lanes", str(args.min_lanes)]
             + (["--json"] if args.json else []))
+
+    if args.cmd == "lint":
+        # pure host-side: the AST engine imports neither jax nor the
+        # package's device modules
+        from feddrift_tpu.analysis.engine import run_lint
+        return run_lint(args.paths, strict=args.strict, as_json=args.json)
 
     if getattr(args, "platform", ""):
         import jax

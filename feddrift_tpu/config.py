@@ -118,6 +118,13 @@ class ExperimentConfig:
     # Debug mode: validate round-input invariants every iteration and raise
     # inside the op that produces a NaN (utils/invariants.py).
     debug_checks: bool = False
+    # Sanitizer mode (analysis/sanitize.py): flips jax_check_tracer_leaks +
+    # jax_debug_nans and holds steady-state jit recompiles (the compile
+    # tracker's jit_recompile events, after the first iteration's warm-up)
+    # to an absolute budget — the run fails loudly instead of silently
+    # recompiling the round program every block (the PR 10 class).
+    sanitize: bool = False
+    sanitize_recompile_budget: int = 8   # 0 = no budget, flags only
     out_dir: str = "./runs"
     checkpoint_every_iteration: bool = True
 
@@ -319,6 +326,8 @@ class ExperimentConfig:
             raise ValueError("time_stretch must be >= 1")
         if self.megastep_k < 1:
             raise ValueError("megastep_k must be >= 1")
+        if self.sanitize_recompile_budget < 0:
+            raise ValueError("sanitize_recompile_budget must be >= 0")
         if self.decision_cadence < 1:
             raise ValueError("decision_cadence must be >= 1")
         if self.divergence_spike_factor <= 1.0:

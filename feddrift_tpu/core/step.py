@@ -396,6 +396,8 @@ class TrainStep:
              lr_scale, client_mask, byz_modes, stale_params, edge_ids,
              edge_mask, edge_modes, codec_prev),
             {"keep_client_params": keep_client_params})
+        # lint: hot-path-begin (tracked dispatch wrapper)
+        # lint: r4-ok (telemetry wall stamp; never a replay input)
         t0w, p0 = time.time(), time.perf_counter()
         out = self._train_round_jit(
             params, opt_states, key, x, y, time_w, sample_w, feat_mask,
@@ -407,6 +409,7 @@ class TrainStep:
             # its duration is the compile cost, worth its own trace slice
             obs.spans.record("jit_compile", t0w, time.perf_counter() - p0,
                              cat="round", fn="train_round", event=kind)
+        # lint: hot-path-end
         return out if with_agg_stats else out[:5]
 
     @partial(jax.jit, static_argnums=0,
@@ -473,6 +476,8 @@ class TrainStep:
              feat_mask, lr_scale, R, freq, t, client_masks, byz_modes,
              edge_ids, edge_masks, edge_byz),
             {"byz_stale": byz_stale})
+        # lint: hot-path-begin (tracked dispatch wrapper)
+        # lint: r4-ok (telemetry wall stamp; never a replay input)
         t0w, p0 = time.time(), time.perf_counter()
         out = self._train_iteration_eval_jit(
             params, opt_states, iter_key, x, y, time_w, sample_w, feat_mask,
@@ -482,6 +487,7 @@ class TrainStep:
             obs.spans.record("jit_compile", t0w, time.perf_counter() - p0,
                              cat="round", fn="train_iteration_eval",
                              event=kind)
+        # lint: hot-path-end
         return out if with_agg_stats else out[:6]
 
     @partial(jax.jit, static_argnums=(0, 10, 11), donate_argnums=(1, 2),
@@ -624,6 +630,8 @@ class TrainStep:
             kind, "train_megastep", type(self)._train_megastep_jit,
             (params, base_key, x, y, time_ws, sample_w, feat_mask, lr_scale,
              t0, R, freq, K, client_masks))
+        # lint: hot-path-begin (tracked dispatch wrapper)
+        # lint: r4-ok (telemetry wall stamp; never a replay input)
         t0w, p0 = time.time(), time.perf_counter()
         out = self._train_megastep_jit(
             params, base_key, x, y, time_ws, sample_w, feat_mask, lr_scale,
@@ -631,6 +639,7 @@ class TrainStep:
         if kind is not None:
             obs.spans.record("jit_compile", t0w, time.perf_counter() - p0,
                              cat="round", fn="train_megastep", event=kind)
+        # lint: hot-path-end
         return out
 
     # NOTE: no buffer donation here — every output is K-stacked, so the

@@ -356,6 +356,10 @@ class Experiment:
         if cfg.debug_checks:
             from feddrift_tpu.utils.invariants import enable_nan_debugging
             enable_nan_debugging()
+        self.sanitizer = None
+        if cfg.sanitize:
+            from feddrift_tpu.analysis.sanitize import Sanitizer
+            self.sanitizer = Sanitizer(cfg, bus=self.events)
 
     def _make_apply(self):
         """Forward fn honoring cfg.compute_dtype.
@@ -998,6 +1002,8 @@ class Experiment:
                 self.pool.params)
         keep_cp = self.algo.needs_client_params or (
             byz is not None and byz.has_stale)
+        # lint: hot-path-begin (per-round dispatch loop — every host sync
+        # here serializes all comm_round dispatches)
         for r in range(cfg.comm_round):
             self.events.set_context(round=self.global_round)
             tw, sw, fm, lr_scale = self.algo.round_inputs(t, r)
@@ -1034,6 +1040,7 @@ class Experiment:
                     # attributes device time to this phase instead of letting
                     # async dispatch spill it into whichever phase blocks next
                     blk_w, blk0 = time.time(), time.perf_counter()
+                    # lint: r2-ok (attribution sample, rate-gated)
                     jax.block_until_ready(new_params)
                     blk_dt = time.perf_counter() - blk0
                     self.spans.record("device_compute", blk_w, blk_dt,
@@ -1047,6 +1054,7 @@ class Experiment:
                     self._codec_prev = codec_prev
                 if self._robust_active or self.hierarchy:
                     self._emit_robust_stats(
+                        # lint: r2-ok (tiny gated [M, 3] evidence fetch)
                         multihost.fetch(agg_stats), self.global_round)
                 if self._check_divergence(losses, n):
                     # rollback: pre-round params, fresh optimizer state (the
@@ -1068,6 +1076,7 @@ class Experiment:
                     self.evaluate(t, r)
                 self._seg_add("eval", time.perf_counter() - ev0)
             self.global_round += 1
+        # lint: hot-path-end
 
     def _stream_view(self, t: int):
         """Device view [C_pad, 2, N, ...] of steps (t, t+1), prefetched one
@@ -1147,6 +1156,7 @@ class Experiment:
         # checkpoint already pays, taken only when the guard is armed.
         host_prev = (jax.tree_util.tree_map(np.asarray, self.pool.params)
                      if self.divergence_guard is not None else None)
+        # lint: hot-path-begin (fused dispatch: one program per time step)
         with self.tracer.phase("train_round"):
             disp0 = time.perf_counter()
             new_params, opt_states, n, losses, bufs, total, agg_stats = \
@@ -1164,6 +1174,7 @@ class Experiment:
             # sample covers them too (the stats/eval fetches below would
             # block here anyway — this only attributes the wait).
             blk_w, blk0 = time.time(), time.perf_counter()
+            # lint: r2-ok (one dispatch-to-ready sample per fused step)
             jax.block_until_ready(new_params)
             blk_dt = time.perf_counter() - blk0
             self.spans.record("device_compute", blk_w, blk_dt, cat="round",
@@ -1173,7 +1184,9 @@ class Experiment:
             if self._robust_active or self.hierarchy:
                 # one bulk [R, M, 3] (hierarchy: [R, 1+E, M, 3]) fetch
                 # -> one event per fused round
+                # lint: r2-ok (single bulk [R, M, 3] stats fetch, gated)
                 for rr, row in enumerate(np.asarray(
+                        # lint: r2-ok (same bulk fetch, second call site)
                         multihost.fetch(agg_stats))):
                     self._emit_robust_stats(row, g0 + rr)
             if self._check_divergence(losses, n):
@@ -1192,6 +1205,7 @@ class Experiment:
         ev0 = time.perf_counter()
         with self.tracer.phase("eval"):
             C = self.C_
+            # lint: r2-ok (the design point: ONE bulk D2H per time step)
             bufs, total, n = multihost.fetch((bufs, total, n))
             corr_tr, loss_tr, corr_te, loss_te = bufs
             for slot, r in enumerate(self.step.eval_rounds(R, freq)):
@@ -1201,6 +1215,7 @@ class Experiment:
                                total[:C])
         self._seg_add("eval", time.perf_counter() - ev0)
         self.global_round = g0 + R
+        # lint: hot-path-end
         # The final eval slot holds acc(final params, step t) and
         # acc(final params, step t+1) — offer both so end_iteration
         # consumers (MultiModel selection) and the next cluster phase each
@@ -1302,6 +1317,7 @@ class Experiment:
         if cms_list[0] is not None:
             cms = jnp.asarray(np.stack(cms_list))     # [K, R, C_pad]
         # -- dispatch --------------------------------------------------
+        # lint: hot-path-begin (megastep: one program per K-step block)
         with self.tracer.phase("train_round"):
             disp0 = time.perf_counter()
             ps, ns, ls, bufs, total, agg_stats = self.step.train_megastep(
@@ -1309,12 +1325,14 @@ class Experiment:
                 lr_scale, jnp.int32(t0), R, freq, K, cms)
             self._seg_add("dispatch", time.perf_counter() - disp0)
             blk_w, blk0 = time.time(), time.perf_counter()
+            # lint: r2-ok (one dispatch-to-ready sample per K-step block)
             jax.block_until_ready(ps)
             blk_dt = time.perf_counter() - blk0
             self.spans.record("device_compute", blk_w, blk_dt, cat="round",
                               iteration=t0, round=g0)
             self._seg_add("device_compute", blk_dt)
             self._profiled_rounds += K * R
+        # lint: hot-path-end
         # -- replay ----------------------------------------------------
         C = self.C_
         ns_h, ls_h, bufs_h, total_h = multihost.fetch((ns, ls, bufs, total))
@@ -1454,6 +1472,11 @@ class Experiment:
                     else:
                         self.run_iteration(t)
                         t += 1
+                    if self.sanitizer is not None:
+                        # raises past the steady-state recompile budget;
+                        # the first block's warm-up compiles don't count
+                        self.sanitizer.check()
+                        self.sanitizer.mark_steady()
                     if pre.requested:
                         # preemption: the block ending at t-1 just
                         # completed — persist it and exit cleanly;

@@ -1,19 +1,10 @@
-"""Static event-taxonomy check: emitted kinds <-> documented kinds.
+"""Static event-taxonomy check — thin shim.
 
-Three-way consistency pass, run by the tier-1 suite (tests/test_obs.py)
-and usable standalone:
-
-1. every ``emit("<kind>", ...)`` literal in ``feddrift_tpu/`` must be a
-   member of ``obs.events.EVENT_KINDS`` (the runtime also enforces this,
-   but only on the code paths a given run happens to execute);
-2. every member of ``EVENT_KINDS`` must appear as a ``| `kind` |`` row in
-   docs/OBSERVABILITY.md's taxonomy table;
-3. every kind documented in that table must still exist in
-   ``EVENT_KINDS`` (no stale docs).
-
-Together with ``emit()``'s runtime validation this makes it impossible to
-ship a new event kind that is undocumented, or documentation for an event
-that no longer exists.
+The implementation moved into the lint engine as rule R6
+(feddrift_tpu/analysis/events_schema.py); ``python -m feddrift_tpu lint``
+runs it on every pass. This script keeps the historical entry point and
+API (``check``, ``emitted_kinds``, ``documented_kinds``, ``main``) so the
+chaos/perf gate stages and tests/test_obs.py keep working unchanged:
 
     python scripts/check_events_schema.py          # exit 0 = consistent
     python scripts/check_events_schema.py --strict # + dead-kind detection
@@ -23,120 +14,23 @@ that no longer exists.
 from __future__ import annotations
 
 import os
-import re
 import sys
 
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, ROOT)
 
-# emit("kind", ...) / .emit("kind", ...) with a string literal first arg
-_EMIT_RE = re.compile(r"""\bemit\(\s*\n?\s*["']([a-z_]+)["']""")
-# taxonomy rows: | `kind` | layer | ...
-_DOC_ROW_RE = re.compile(r"^\|\s*`([a-z_]+)`\s*\|", re.MULTILINE)
-
-# Kinds emitted through a COMPUTED first argument (obs.emit(kind, ...)),
-# which the literal scan cannot attribute: kind -> the one file whose
-# source must still contain the literal. Strict mode verifies the literal
-# is present there, so a refactor that drops the emission path still
-# trips dead-kind detection instead of hiding behind this allowlist.
-_INDIRECT_KINDS = {
-    "jit_compile": "feddrift_tpu/core/step.py",     # _note_signature's
-    "jit_recompile": "feddrift_tpu/core/step.py",   # kind = ... ternary
-}
-
-
-def emitted_kinds(pkg_dir: str) -> dict[str, list[str]]:
-    """{kind: [file:line, ...]} for every emit() string literal."""
-    found: dict[str, list[str]] = {}
-    for dirpath, dirnames, filenames in os.walk(pkg_dir):
-        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
-        for fn in filenames:
-            if not fn.endswith(".py"):
-                continue
-            path = os.path.join(dirpath, fn)
-            with open(path, encoding="utf-8") as f:
-                text = f.read()
-            for m in _EMIT_RE.finditer(text):
-                line = text.count("\n", 0, m.start()) + 1
-                rel = os.path.relpath(path, ROOT)
-                found.setdefault(m.group(1), []).append(f"{rel}:{line}")
-    return found
-
-
-def documented_kinds(doc_path: str) -> set[str]:
-    """Kinds documented in the '## Event taxonomy' table ONLY — other
-    tables in the doc (alert rules, file inventory) also use backticked
-    first columns and must not count as taxonomy rows."""
-    with open(doc_path, encoding="utf-8") as f:
-        text = f.read()
-    start = text.find("## Event taxonomy")
-    if start != -1:
-        end = text.find("\n## ", start + 1)
-        text = text[start:end if end != -1 else len(text)]
-    return set(_DOC_ROW_RE.findall(text))
-
-
-def check(strict: bool = False) -> list[str]:
-    """Returns a list of problem strings; empty = consistent.
-
-    ``strict`` additionally fails DEAD KINDS: an ``EVENT_KINDS`` member
-    with zero ``emit()`` sites anywhere in the tree is taxonomy rot — it
-    documents an event no run can ever produce (tier-1 runs strict via
-    tests/test_obs.py)."""
-    from feddrift_tpu.obs.events import EVENT_KINDS
-
-    problems: list[str] = []
-    emitted = emitted_kinds(os.path.join(ROOT, "feddrift_tpu"))
-    doc = os.path.join(ROOT, "docs", "OBSERVABILITY.md")
-    if not os.path.isfile(doc):
-        return [f"missing taxonomy doc: {doc}"]
-    documented = documented_kinds(doc)
-
-    for kind, sites in sorted(emitted.items()):
-        if kind not in EVENT_KINDS:
-            problems.append(
-                f"emitted kind {kind!r} not in EVENT_KINDS ({sites[0]})")
-    for kind in sorted(EVENT_KINDS - documented):
-        problems.append(
-            f"kind {kind!r} in EVENT_KINDS but undocumented in "
-            "docs/OBSERVABILITY.md")
-    for kind in sorted(documented - EVENT_KINDS):
-        problems.append(
-            f"kind {kind!r} documented in docs/OBSERVABILITY.md but "
-            "missing from EVENT_KINDS (stale docs?)")
-    if strict:
-        for kind in sorted(EVENT_KINDS - set(emitted)):
-            site = _INDIRECT_KINDS.get(kind)
-            if site is not None:
-                with open(os.path.join(ROOT, site), encoding="utf-8") as f:
-                    if f'"{kind}"' in f.read():
-                        continue        # indirect emission still in place
-            problems.append(
-                f"kind {kind!r} has ZERO emit sites in feddrift_tpu/ — "
-                "dead taxonomy entry (remove it, or emit it)")
-    # sanity: the scan itself must see emission sites, otherwise a regex
-    # rot would make this check pass vacuously
-    if not emitted:
-        problems.append("scan found no emit() sites — checker regex broken?")
-    return problems
+from feddrift_tpu.analysis.events_schema import (  # noqa: E402,F401
+    _EMIT_RE,
+    _INDIRECT_KINDS,
+    check,
+    documented_kinds,
+    emitted_kinds,
+)
+from feddrift_tpu.analysis.events_schema import main as _main  # noqa: E402
 
 
 def main() -> int:
-    if "--list" in sys.argv[1:]:
-        # machine-consumable taxonomy dump, one kind per line (used by
-        # tests/test_obs_perf.py and handy for grepping run artifacts)
-        from feddrift_tpu.obs.events import EVENT_KINDS
-        for kind in sorted(EVENT_KINDS):
-            print(kind)
-        return 0
-    problems = check(strict="--strict" in sys.argv[1:])
-    for p in problems:
-        print(f"check_events_schema: {p}", file=sys.stderr)
-    if not problems:
-        print(f"check_events_schema: OK "
-              f"({len(emitted_kinds(os.path.join(ROOT, 'feddrift_tpu')))} "
-              "distinct kinds emitted, taxonomy consistent)")
-    return 1 if problems else 0
+    return _main(sys.argv[1:])
 
 
 if __name__ == "__main__":

@@ -1,0 +1,27 @@
+#!/usr/bin/env bash
+# Static-analysis gate: graftlint over the package, the event-taxonomy
+# check in strict mode, and a lock-order smoke test that re-detects the
+# PR 9 tap-re-entrancy deadlock fixture. All three stages are pre-bench
+# and CPU-cheap (~seconds); run before perf_gate.sh or standalone:
+#
+#     bash scripts/lint_gate.sh
+#
+# Exit nonzero iff any stage finds a problem.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+echo "[lint_gate 1/3] graftlint: R1-R6 over feddrift_tpu/ (strict)"
+python -m feddrift_tpu lint feddrift_tpu/ --strict
+
+echo "[lint_gate 2/3] event taxonomy: emitted == declared == documented"
+python scripts/check_events_schema.py --strict
+
+echo "[lint_gate 3/3] lock-order smoke: PR 9 fixture must be detected"
+# tests/test_lockorder.py holds the canonical fixtures (BadMonitor tap
+# re-entrancy, order inversion, RLock fix). The recorder instruments
+# locks by creator source file, so the fixture must live in a real file
+# under tests/ — a heredoc's locks come from <stdin> and are skipped.
+JAX_PLATFORMS=cpu python -m pytest tests/test_lockorder.py -q \
+    -p no:cacheprovider -p no:xdist -p no:randomly
+
+echo "lint_gate: OK"

@@ -16,13 +16,18 @@ cd "$(dirname "$0")/.."
 out="$(mktemp -d)"
 trap 'rm -rf "$out"' EXIT
 
-echo "[perf_gate 1/7] warm run (populates the persistent compile cache)"
+echo "[perf_gate 1/8] graftlint: static analysis must be clean"
+# cheapest stage first: the lint verb is pre-jax and runs in ~1s; a dirty
+# tree fails the gate before any bench spends minutes compiling
+python -m feddrift_tpu lint feddrift_tpu/ --strict
+
+echo "[perf_gate 2/8] warm run (populates the persistent compile cache)"
 python bench.py --smoke --cpu > "$out/warm.json"
 
-echo "[perf_gate 2/7] measured run"
+echo "[perf_gate 3/8] measured run"
 python bench.py --smoke --cpu > "$out/bench.json"
 
-echo "[perf_gate 3/7] cost-model + critical-path fields present"
+echo "[perf_gate 4/8] cost-model + critical-path fields present"
 python - "$out/bench.json" <<'EOF'
 import json, sys
 d = json.loads(open(sys.argv[1]).read().strip().splitlines()[-1])
@@ -39,7 +44,7 @@ print(f"  mfu_estimate={d['mfu_estimate']} (source={d['mfu']['source']}), "
       f"round_wall_p99_s={d['round_wall_p99_s']}")
 EOF
 
-echo "[perf_gate 4/7] critical_path on a smoke run dir"
+echo "[perf_gate 5/8] critical_path on a smoke run dir"
 # bench.py runs without an out_dir (no spans.jsonl), so the attribution
 # verb gets its own tiny recorded run: 2 iterations, per-round path.
 JAX_PLATFORMS=cpu python -m feddrift_tpu run \
@@ -63,7 +68,7 @@ print(f"  dominant_segment={d['dominant_segment']}, "
       f"host_overhead_frac_mean={d['host_overhead_frac_mean']}")
 EOF
 
-echo "[perf_gate 5/7] megastep: K=4 vs K=1 bitwise parity + zero steady recompiles"
+echo "[perf_gate 6/8] megastep: K=4 vs K=1 bitwise parity + zero steady recompiles"
 # the megastep fuses K whole iterations into one device program; the gate
 # is (a) bitwise-identical params/accuracy vs the K=1 driver and (b) no
 # jit cache growth past the single warm-up compile across blocks
@@ -96,7 +101,7 @@ print(f"  parity OK (leafdiff=0.0, {len(a4)} eval points), "
       f"megastep cache entries={n}")
 EOF
 
-echo "[perf_gate 6/7] regress: self-comparison (warm), then vs BENCH_r05.json"
+echo "[perf_gate 7/8] regress: self-comparison (warm), then vs BENCH_r05.json"
 # back-to-back smoke runs on a busy 1-core host: generous relative noise
 # margins, but identical round counts make every metric comparable
 python -m feddrift_tpu regress "$out/bench.json" --baseline "$out/warm.json" \
@@ -107,7 +112,7 @@ python -m feddrift_tpu regress "$out/bench.json" --baseline "$out/warm.json" \
 python -m feddrift_tpu regress "$out/bench.json" --baseline BENCH_r05.json \
     --tol-rounds 0.9 --tol-acc 0.15
 
-echo "[perf_gate 7/7] ops plane overhead: enabled run within 2% of disabled"
+echo "[perf_gate 8/8] ops plane overhead: enabled run within 2% of disabled"
 # The /metrics + /healthz server, SLO engine and status tap must stay off
 # the hot path. Resolving a 2% bound on a noisy 1-core host needs a
 # paired design: BOTH experiments live in one process, iterations

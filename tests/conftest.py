@@ -36,3 +36,28 @@ def _clear_jax_caches_between_modules():
     yield
     import jax
     jax.clear_caches()
+
+
+# The threaded suites run under the lock-order recorder
+# (analysis/lockorder.py): every repo-created lock is instrumented, a
+# same-thread re-acquisition of a non-reentrant Lock (the PR 9 tap
+# re-entrancy deadlock) raises instead of hanging, and at module teardown
+# the accumulated acquisition graph must be ACYCLIC — a cycle is a latent
+# deadlock two threads can hit even if this run didn't.
+_LOCKORDER_MODULES = ("test_live_ops", "test_resilience", "test_prefetch")
+
+
+@pytest.fixture(autouse=True, scope="module")
+def _lock_order_recorder(request):
+    name = request.module.__name__.rsplit(".", 1)[-1]
+    if name not in _LOCKORDER_MODULES:
+        yield None
+        return
+    from feddrift_tpu.analysis.lockorder import LockOrderRecorder
+    rec = LockOrderRecorder()
+    rec.install()
+    try:
+        yield rec
+    finally:
+        rec.uninstall()
+    rec.check()     # raises LockOrderViolation on any recorded cycle

@@ -37,6 +37,22 @@ class _FedAvgServer(ServerManager):
             msg.add_params(ARG_EXTRA_INFO, {"round": 0})
             self.send_message(msg)
 
+    def rebroadcast(self):
+        """At-least-once nudge for lossy transports (test_resilience chaos
+        e2e): resend the current round's sync — or the init — to every
+        client. Duplicate-safe, including from another thread: a client's
+        response is a deterministic function of the round's params, and
+        _on_model overwrites by sender, so a repeated message can never
+        skew the aggregate or double-count a client."""
+        if self.round_idx == 0 and not self.received:
+            self.send_init_msg()
+            return
+        for c in range(1, self.size):
+            msg = Message(MsgType.S2C_SYNC_MODEL, 0, c)
+            msg.add_params(ARG_MODEL_PARAMS, self.params)
+            msg.add_params(ARG_EXTRA_INFO, {"round": self.round_idx})
+            self.send_message(msg)
+
     def _on_model(self, msg):
         self.received[msg.sender_id] = msg.get(ARG_MODEL_AND_NUM_SAMPLES)
         if len(self.received) < self.size - 1:

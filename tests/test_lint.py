@@ -1,0 +1,301 @@
+"""graftlint engine tests: golden bad-code fixtures per rule (each fires
+exactly once with the expected rule id and file:line), suppression
+comments silence findings, the --json schema is stable, exit codes are
+1-on-findings / 0-on-clean, and — the tier-1 gate — the merged tree
+itself lints clean."""
+
+import io
+import json
+import os
+import textwrap
+
+import pytest
+
+from feddrift_tpu.analysis import events_schema
+from feddrift_tpu.analysis.engine import LintEngine, run_lint
+from feddrift_tpu.analysis.findings import (
+    Finding,
+    exit_code,
+    findings_to_json,
+    parse_suppressions,
+)
+from feddrift_tpu.cli import main as cli_main
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG = os.path.join(REPO, "feddrift_tpu")
+
+
+@pytest.fixture(scope="module")
+def engine():
+    return LintEngine()
+
+
+def _lint_file(engine, tmp_path, name, source):
+    p = tmp_path / name
+    p.write_text(textwrap.dedent(source))
+    return p, engine.run([str(p)])
+
+
+# ---------------------------------------------------------------- fixtures
+GOLDEN = {
+    "R1": """\
+        def f(cfg):
+            return cfg.not_a_real_knob
+        """,
+    "R2": """\
+        def hot(x):
+            # lint: hot-path-begin
+            v = x.item()
+            # lint: hot-path-end
+            return v
+        """,
+    "R3": """\
+        import threading
+
+        class BadMonitor:
+            def __init__(self, bus):
+                self._lock = threading.Lock()
+                self.bus = bus
+
+            def attach(self, bus):
+                bus.add_tap(self.observe)
+
+            def observe(self, rec):
+                with self._lock:
+                    self._raise(rec)
+
+            def _raise(self, rec):
+                self.bus.emit("alert_raised", source="bad")
+        """,
+    "R4": """\
+        import time
+
+        def decide():
+            return time.time()
+        """,
+    "R5": """\
+        from functools import partial
+        import jax
+
+        @partial(jax.jit, static_argnames=("num_steps",))
+        def body(x, steps):
+            return x
+        """,
+}
+GOLDEN_LINE = {"R1": 2, "R2": 3, "R3": 16, "R4": 4, "R5": 4}
+
+
+@pytest.mark.parametrize("rule", sorted(GOLDEN))
+def test_golden_fixture_fires_exactly_once(engine, tmp_path, rule):
+    p, findings = _lint_file(engine, tmp_path, f"bad_{rule.lower()}.py",
+                             GOLDEN[rule])
+    assert [f.rule for f in findings] == [rule], findings
+    f = findings[0]
+    assert not f.suppressed
+    assert f.path == str(p)
+    assert f.line == GOLDEN_LINE[rule], f.render()
+    assert f.severity == "error"
+
+
+@pytest.mark.parametrize("rule", sorted(GOLDEN))
+def test_golden_fixture_exits_1_via_cli(tmp_path, rule):
+    p = tmp_path / f"bad_{rule.lower()}.py"
+    p.write_text(textwrap.dedent(GOLDEN[rule]))
+    assert cli_main(["lint", str(p)]) == 1
+
+
+def test_suppression_comment_silences(engine, tmp_path):
+    src = """\
+        def f(cfg):
+            return cfg.not_a_real_knob  # lint: r1-ok (golden suppression)
+        """
+    p, findings = _lint_file(engine, tmp_path, "ok.py", src)
+    assert len(findings) == 1 and findings[0].suppressed
+    assert findings[0].justification == "golden suppression"
+    assert exit_code(findings) == 0
+    assert cli_main(["lint", str(p)]) == 0
+
+
+def test_standalone_suppression_covers_next_code_line(tmp_path):
+    src = textwrap.dedent("""\
+        # lint: r1-ok (standalone)
+        # a second comment line between suppression and code
+        x = cfg.not_a_real_knob
+        """)
+    sup = parse_suppressions(src)
+    assert sup[3] == {"R1": "standalone"}
+
+
+def test_clean_file_exits_0(engine, tmp_path):
+    p, findings = _lint_file(engine, tmp_path, "clean.py",
+                             "def f():\n    return 1\n")
+    assert findings == []
+    assert cli_main(["lint", str(p)]) == 0
+
+
+def test_json_schema_stable(tmp_path, capsys):
+    p = tmp_path / "bad_r1.py"
+    p.write_text(textwrap.dedent(GOLDEN["R1"]))
+    rc = run_lint([str(p)], as_json=True, out=io.StringIO())
+    assert rc == 1
+    buf = io.StringIO()
+    run_lint([str(p)], as_json=True, out=buf)
+    doc = json.loads(buf.getvalue())
+    assert sorted(doc) == ["counts", "findings", "strict", "suppressed",
+                           "version"]
+    assert doc["version"] == 1
+    assert doc["counts"] == {"R1": 1}
+    assert doc["suppressed"] == 0
+    (f,) = doc["findings"]
+    assert sorted(f) == ["hint", "justification", "line", "message", "path",
+                         "rule", "severity", "suppressed"]
+    assert f["rule"] == "R1" and f["line"] == 2
+
+
+# ---------------------------------------------------------- rule precision
+def test_r1_non_experiment_config_annotation_exempt(engine, tmp_path):
+    src = """\
+        class RingConfig:
+            pass
+
+        class Ring:
+            def __init__(self, cfg: RingConfig):
+                self.cfg = cfg
+
+            def use(self):
+                cfg = self.cfg
+                return cfg.not_a_knob + self.cfg.also_not_a_knob
+
+        def free(cfg: RingConfig):
+            return cfg.whatever
+        """
+    _, findings = _lint_file(engine, tmp_path, "ring.py", src)
+    assert findings == [], [f.render() for f in findings]
+
+
+def test_r1_getattr_literal_checked(engine, tmp_path):
+    src = """\
+        def f(cfg):
+            a = getattr(cfg, "fnn_hidden_dim", 10)   # declared: ok
+            b = getattr(cfg, "not_a_real_knob", 0)   # undeclared: fires
+            return a + b
+        """
+    _, findings = _lint_file(engine, tmp_path, "ga.py", src)
+    assert [(f.rule, f.line) for f in findings] == [("R1", 3)]
+
+
+def test_r2_outside_region_not_flagged(engine, tmp_path):
+    src = """\
+        def cold(x):
+            return x.item()
+        """
+    _, findings = _lint_file(engine, tmp_path, "cold.py", src)
+    assert findings == []
+
+
+def test_r2_unbalanced_markers_flagged(engine, tmp_path):
+    src = """\
+        def f(x):
+            # lint: hot-path-begin
+            return x
+        """
+    _, findings = _lint_file(engine, tmp_path, "unbal.py", src)
+    assert [f.rule for f in findings] == ["R2"]
+    assert "never closed" in findings[0].message
+
+
+def test_r3_rlock_emit_is_safe(engine, tmp_path):
+    # the PR 9 FIX: emit under the monitor's own RLock is the documented
+    # safe pattern and must not fire
+    src = GOLDEN["R3"].replace("threading.Lock()", "threading.RLock()")
+    _, findings = _lint_file(engine, tmp_path, "good_monitor.py", src)
+    assert findings == [], [f.render() for f in findings]
+
+
+def test_r4_seeded_constructors_allowed(engine, tmp_path):
+    src = """\
+        import numpy as np
+        import random
+
+        def setup(seed):
+            a = np.random.default_rng(seed)
+            b = np.random.RandomState(seed)
+            c = random.Random(seed)
+            return a, b, c
+        """
+    _, findings = _lint_file(engine, tmp_path, "seeded.py", src)
+    assert findings == []
+
+
+def test_r4_only_applies_to_seeded_modules_in_package(engine):
+    # obs/ is telemetry, outside the seeded-replay module set: its
+    # time.time() wall stamps must not fire R4
+    findings = engine.run([os.path.join(PKG, "obs", "events.py")])
+    assert [f for f in findings if f.rule == "R4"] == []
+
+
+def test_r5_matching_signature_clean(engine, tmp_path):
+    src = """\
+        from functools import partial
+        import jax
+
+        @partial(jax.jit, static_argnums=0, static_argnames=("steps",))
+        def body(self, x, steps):
+            return x
+        """
+    _, findings = _lint_file(engine, tmp_path, "goodjit.py", src)
+    assert findings == []
+
+
+def test_r5_donated_read_after_dispatch(engine, tmp_path):
+    src = """\
+        import jax
+
+        def drive(params, other):
+            step = jax.jit(body, donate_argnums=(0,))
+            new = step(params, other)
+            return params
+        """
+    _, findings = _lint_file(engine, tmp_path, "donate.py", src)
+    assert [(f.rule, f.line) for f in findings] == [("R5", 6)]
+    src_ok = src.replace("return params", "return new")
+    _, findings = _lint_file(engine, tmp_path, "donate_ok.py", src_ok)
+    assert findings == []
+
+
+def test_r6_adapter_maps_problems_to_findings(monkeypatch):
+    monkeypatch.setattr(
+        events_schema, "check",
+        lambda strict=False: [
+            "emitted kind 'zzz' not in EVENT_KINDS "
+            "(feddrift_tpu/comm/pubsub.py:42)",
+            "kind 'dead' in EVENT_KINDS but undocumented in "
+            "docs/OBSERVABILITY.md",
+        ])
+    out = events_schema.rule_r6()
+    assert [(f.rule, f.path, f.line) for f in out] == [
+        ("R6", "feddrift_tpu/comm/pubsub.py", 42),
+        ("R6", os.path.join("feddrift_tpu", "obs", "events.py"), 1),
+    ]
+
+
+# ---------------------------------------------------------------- tier-1
+def test_merged_tree_is_lint_clean():
+    """THE dogfood gate: zero unsuppressed findings over the package, and
+    every suppression carries a justification."""
+    engine = LintEngine()
+    findings = engine.run([PKG], strict=True)
+    active = [f for f in findings if not f.suppressed]
+    assert active == [], "\n".join(f.render() for f in active)
+    for f in findings:
+        assert f.justification, f"suppression without justification: " \
+                                f"{f.render()}"
+
+
+def test_findings_to_json_counts_exclude_suppressed():
+    fs = [Finding("R1", "error", "a.py", 1, "m"),
+          Finding("R2", "error", "a.py", 2, "m", suppressed=True,
+                  justification="why")]
+    doc = json.loads(findings_to_json(fs))
+    assert doc["counts"] == {"R1": 1}
+    assert doc["suppressed"] == 1

@@ -1,0 +1,171 @@
+"""Runtime lock-order recorder tests, including the PR 9 fixture: an
+AlertMonitor-shaped tap that emits under its own non-reentrant Lock. The
+real EventBus swallows tap exceptions (a failing tap must never take the
+run down), so the detector's evidence is the recorder state — the
+violation list and the self-edge that makes the acquisition graph cyclic
+— not a propagated exception."""
+
+import threading
+
+import pytest
+
+from feddrift_tpu.analysis.lockorder import (
+    LockOrderRecorder,
+    LockOrderViolation,
+)
+from feddrift_tpu.obs.events import EventBus
+
+
+@pytest.fixture()
+def rec():
+    r = LockOrderRecorder()
+    r.install()
+    try:
+        yield r
+    finally:
+        r.uninstall()
+
+
+def test_repo_created_locks_are_instrumented(rec):
+    lk = threading.Lock()
+    assert rec.locks_created == 1
+    with lk:
+        pass
+    assert rec.violations == []
+    assert rec.find_cycle() is None
+    rec.check()     # acyclic: no-op
+
+
+def test_consistent_order_is_acyclic(rec):
+    a, b = threading.Lock(), threading.Lock()
+    for _ in range(3):
+        with a:
+            with b:
+                pass
+    assert len(rec.edges) == 1
+    assert rec.find_cycle() is None
+    rec.check()
+
+
+def test_order_inversion_is_a_cycle(rec):
+    a, b = threading.Lock(), threading.Lock()
+    with a:
+        with b:
+            pass
+    with b:
+        with a:
+            pass
+    cyc = rec.find_cycle()
+    assert cyc is not None and cyc[0] == cyc[-1]
+    with pytest.raises(LockOrderViolation, match="cycle"):
+        rec.check()
+
+
+def test_self_reacquire_raises_instead_of_hanging(rec):
+    lk = threading.Lock()
+    with lk:
+        with pytest.raises(LockOrderViolation, match="self-deadlock"):
+            lk.acquire()
+    assert rec.violations
+
+
+def test_rlock_reentry_is_fine(rec):
+    lk = threading.RLock()
+    with lk:
+        with lk:
+            pass
+    assert rec.violations == []
+    assert rec.find_cycle() is None
+
+
+class BadMonitor:
+    """The PR 9 re-entrancy class, verbatim in shape: a bus tap that holds
+    its own non-reentrant Lock while emitting. Taps run synchronously on
+    the emitting thread, so the nested emit re-enters observe() and
+    re-acquires the held lock."""
+
+    def __init__(self, bus):
+        self._lock = threading.Lock()   # the bug: Lock, not RLock
+        self.bus = bus
+        self.seen = 0
+
+    def attach(self):
+        self.bus.add_tap(self.observe)
+
+    def observe(self, recd):
+        with self._lock:
+            self.seen += 1
+            if self.seen == 1:
+                # re-entrant emit while holding _lock — PR 9's deadlock
+                self.bus.emit("alert_raised", source="bad_monitor")
+
+
+def test_pr9_fixture_detected(rec, tmp_path):
+    bus = EventBus(path=str(tmp_path / "events.jsonl"))
+    mon = BadMonitor(bus)
+    mon.attach()
+    # Without the recorder this call would hang forever. With it, the
+    # instrumented lock raises inside the tap; the bus swallows the
+    # exception (taps must never kill the run), and the evidence lands in
+    # the recorder.
+    bus.emit("alert_raised", source="test")
+    assert any("self-deadlock" in v for v in rec.violations), rec.violations
+    cyc = rec.find_cycle()
+    assert cyc is not None and cyc[0] == cyc[-1]
+    with pytest.raises(LockOrderViolation, match="self-deadlock"):
+        rec.check()
+    bus.close()
+
+
+def test_pr9_fix_rlock_monitor_is_clean(rec, tmp_path):
+    bus = EventBus(path=str(tmp_path / "events.jsonl"))
+    mon = BadMonitor(bus)
+    mon._lock = threading.RLock()       # the PR 9 fix
+    mon.attach()
+    bus.emit("alert_raised", source="test")
+    assert mon.seen == 2                # re-entered, completed both times
+    assert rec.violations == []
+    rec.check()
+    bus.close()
+
+
+def test_cross_thread_inversion_detected(rec):
+    """Two threads taking two locks in opposite orders never deadlock in
+    this run (barrier-free, sequential), but the graph records the latent
+    hazard."""
+    a, b = threading.Lock(), threading.Lock()
+
+    def forward():
+        with a:
+            with b:
+                pass
+
+    def backward():
+        with b:
+            with a:
+                pass
+
+    t1 = threading.Thread(target=forward)
+    t1.start()
+    t1.join()
+    t2 = threading.Thread(target=backward)
+    t2.start()
+    t2.join()
+    assert rec.find_cycle() is not None
+
+
+def test_uninstall_restores_factories():
+    orig_lock, orig_rlock = threading.Lock, threading.RLock
+    r = LockOrderRecorder()
+    r.install()
+    assert threading.Lock is not orig_lock
+    r.uninstall()
+    assert threading.Lock is orig_lock
+    assert threading.RLock is orig_rlock
+
+
+def test_summary_renders(rec):
+    with threading.Lock():
+        pass
+    s = rec.summary()
+    assert "locks instrumented" in s and "violations" in s
