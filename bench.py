@@ -581,95 +581,198 @@ def _megastep_cfg(smoke: bool, K: int):
         cost_model="lowered")     # exact-HBM capture not worth the compiles here
 
 
-def _measure_megastep(cfg, backend: str) -> dict:
-    """Like _measure, but driven through the runner's megastep loop
-    (run_iteration never fuses blocks). Warm-up is the first block (first
-    two iterations when K=1, matching _measure); the timed steady state is
-    every remaining block, so the instruments snapshot counts steady-state
-    retraces — the megastep program must show ZERO."""
+def _megastep_pop_cfg(smoke: bool, K: int):
+    """Composed megastep geometry: 10^4 registered population (10^3 under
+    --smoke), 10-client cohorts with 2 overprovision slots, a 3-edge
+    hierarchy closing every round with trimmed-mean, plus straggler/churn
+    chaos — the ISSUE-13 acceptance config. Device shapes stay cohort-
+    sized; only the host-side plan (registry draw, cohort gather, mask
+    stacking) sees the population, which is exactly the overhead the
+    K-deep block is meant to amortize.
+
+    Short rounds (comm_round=3) on purpose: the megastep amortizes the
+    PER-ITERATION host round-trip (dispatch, opt-state init, phase
+    syncs, eval fetches), so the sweep runs the cross-silo-style
+    few-local-rounds regime where that round-trip dominates — at long
+    R the in-program training compute swamps both paths equally and
+    the axis measures nothing. Many short iterations (48 full / 16
+    smoke, both divisible by every swept K) keep the steady-state
+    sample large without a tail-sized second program."""
+    return _canonical_cfg(
+        smoke, concept_drift_algo="oblivious", concept_drift_algo_arg="",
+        concept_num=1, megastep_k=K,
+        population_size=1000 if smoke else 10000,
+        cohort_size=10, cohort_overprovision=2,
+        straggler_prob=0.1, churn_leave_prob=0.01, churn_join_prob=0.02,
+        hierarchy_edges=3, edge_robust_agg="trimmed_mean",
+        train_iterations=16 if smoke else 48, comm_round=3,
+        sample_num=50, batch_size=50,
+        cost_model="lowered")
+
+
+def _drive_megastep(exp, t: int) -> int:
+    """Advance one block through the runner's greedy fusion loop
+    (run_iteration never fuses; run_megastep fuses the granted span)."""
+    span = exp._megastep_span(t)
+    if span > 1:
+        return t + exp.run_megastep(t, span)
+    exp.run_iteration(t)
+    return t + 1
+
+
+def _measure_megastep_sweep(cfgs, backend: str) -> list:
+    """Measure all K points of one megastep variant INTERLEAVED.
+
+    The K sweep's headline number is a RATIO (K>1 rounds/s over the same
+    variant's K=1), so the two measurements must see the same host: on a
+    small shared box, minutes of load drift between sequentially-measured
+    points swings either side of the ratio by 30% — more than the effect
+    under test. Countermeasures, in order of leverage:
+
+      - interleave: every experiment is constructed and warmed up front,
+        then the steady state advances round-robin in equal-iteration
+        turns (max swept K per turn), so a load burst hits every K point
+        instead of whichever one was running;
+      - MIN per-iteration wall over turns, not total elapsed: steady
+        turns are identical work and scheduler noise is strictly
+        additive, so the fastest turn is the tightest upper bound on
+        the true cost (same paired-min reasoning as perf_gate's ops
+        stage; the total stays in wall_s).
+
+    Warm-up is each experiment's first block (first two iterations when
+    K=1, matching _measure); the instruments registry resets after ALL
+    warm-ups, so the shared snapshot counts steady-state retraces across
+    the sweep — every row must show ZERO, and a nonzero count correctly
+    poisons the whole variant."""
     from feddrift_tpu import obs
     from feddrift_tpu.obs import costmodel
     from feddrift_tpu.simulation.runner import Experiment
 
     costmodel.clear()
-    exp = Experiment(cfg)
-    K = cfg.megastep_k
-
-    def drive(t: int) -> int:
-        span = exp._megastep_span(t)
-        if span > 1:
-            return t + exp.run_megastep(t, span)
-        exp.run_iteration(t)
-        return t + 1
-
-    t = 0
-    while t < max(K, 2):                       # warm-up: first block
-        t = drive(t)
+    exps = [Experiment(c) for c in cfgs]
+    ts = []
+    for exp, c in zip(exps, cfgs):
+        t = 0
+        while t < max(c.megastep_k, 2):        # warm-up: first block
+            t = _drive_megastep(exp, t)
+        ts.append(t)
     obs.registry().reset()
     costmodel.refresh_gauges()
-    start_t = t
-    breakdowns = []
-    t0 = time.time()
-    while t < cfg.train_iterations:
-        t = drive(t)
-        if exp.last_round_breakdown is not None:
-            breakdowns.append(exp.last_round_breakdown)
-    jax.block_until_ready(exp.pool.params)
-    elapsed = time.time() - t0
-    rounds = cfg.comm_round * (cfg.train_iterations - start_t)
-    hofs = [b["host_overhead_frac"] for b in breakdowns]
+    starts = list(ts)
+    chunk = max(c.megastep_k for c in cfgs)
+    walls = [[] for _ in exps]                 # per-turn (iters, seconds)
+    hofs = [[] for _ in exps]
+    elapsed = [0.0 for _ in exps]
+    while any(t < c.train_iterations for t, c in zip(ts, cfgs)):
+        for i, (exp, c) in enumerate(zip(exps, cfgs)):
+            target = min(ts[i] + chunk, c.train_iterations)
+            if ts[i] >= target:
+                continue
+            n0 = ts[i]
+            b0 = time.perf_counter()
+            while ts[i] < target:
+                ts[i] = _drive_megastep(exp, ts[i])
+                if exp.last_round_breakdown is not None:
+                    hofs[i].append(
+                        exp.last_round_breakdown["host_overhead_frac"])
+            jax.block_until_ready(exp.pool.params)
+            dt = time.perf_counter() - b0
+            walls[i].append((ts[i] - n0, dt))
+            elapsed[i] += dt
     instruments = obs.registry().snapshot()
-    return {
-        "value": round(rounds / elapsed, 3),
-        "unit": "rounds/s",
-        "wall_s": round(elapsed, 2),
-        "rounds": rounds,
-        "final_test_acc": round(float(exp.logger.last("Test/Acc")), 4),
-        "host_overhead_frac": (round(sum(hofs) / len(hofs), 6)
-                               if hofs else None),
-        "round_wall_p99_s": (_round_wall_quantiles(instruments)
-                             or {}).get("0.99"),
-        "instruments": instruments,
-    }
+    out = []
+    for i, (exp, c) in enumerate(zip(exps, cfgs)):
+        per_iter = sorted(w / max(n, 1) for n, w in walls[i])
+        best = per_iter[0] if per_iter else None
+        rounds = c.comm_round * (c.train_iterations - starts[i])
+        rps = (c.comm_round / best) if best \
+            else rounds / max(elapsed[i], 1e-9)
+        out.append({
+            "value": round(rps, 3),
+            "unit": "rounds/s",
+            "wall_s": round(elapsed[i], 2),
+            "rounds": rounds,
+            "final_test_acc": round(float(exp.logger.last("Test/Acc")), 4),
+            "host_overhead_frac": (round(sum(hofs[i]) / len(hofs[i]), 6)
+                                   if hofs[i] else None),
+            "round_wall_p99_s": (_round_wall_quantiles(instruments)
+                                 or {}).get("0.99"),
+            "instruments": instruments,
+        })
+    return out
+
+
+def _measure_megastep(cfg, backend: str) -> dict:
+    """Single-config megastep measurement (the sweep of one)."""
+    return _measure_megastep_sweep([cfg], backend)[0]
 
 
 def _megastep_bench(backend: str, smoke: bool) -> list:
     """rounds/s + host-overhead fraction + steady-state recompiles vs the
-    fused-iterations-per-dispatch factor K (K=1 is the PR-9 fused path).
+    fused-iterations-per-dispatch factor K, over TWO variants:
+
+    - ``dense`` (K in 1,2,4,8): the PR-10 canonical all-clients-resident
+      geometry — K=1 is the historical fused-iteration path;
+    - ``pop_hier`` (K in 1,4): the ISSUE-13 composed geometry — 10^4
+      population cohorts + 3-edge trimmed-mean hierarchy + chaos, where
+      every previously-gating feature now rides the outer scan.
 
     The MEGASTEP artifact the `regress` gate checks: per-K throughput must
     hold within the rounds tolerance, steady-state recompiles must stay
-    ZERO across K, and K>1 must keep host_overhead_frac strictly below
-    K=1's — the whole point of fusing away the per-iteration host
-    round-trip."""
+    ZERO across K and both variants, K>1 must keep host_overhead_frac
+    strictly below its own variant's K=1, and the composed pop_hier K>1
+    must clear an ABSOLUTE >= 2x speedup over its own K=1 — the
+    acceptance bar for fusing the feature matrix, not just the dense
+    fast path.
+
+    pop_hier holds an absolute RATIO floor on a 1-core shared host, so
+    its sweep runs 3 times and the rep with the MEDIAN K-max/K-1 ratio
+    is reported whole (pairing preserved: both sides of the ratio come
+    from the same interleaved rep). The zero-recompile gate stays
+    absolute across ALL reps — a recompile in a discarded rep still
+    poisons the row."""
     from feddrift_tpu.obs.regress import _compile_counts
 
     out = []
-    k1_rps = None
-    for K in (1, 2, 4, 8):
-        cfg = _megastep_cfg(smoke, K)
+    sweeps = [("dense", _megastep_cfg, (1, 2, 4, 8), 1),
+              ("pop_hier", _megastep_pop_cfg, (1, 4), 3)]
+    for variant, mk_cfg, ks, reps in sweeps:
         try:
-            r = _measure_megastep(cfg, backend)
-        except Exception as e:    # jax errors share no useful base
-            r = {"error": f"{type(e).__name__}: {str(e)[:300]}"}
-        _, recompiles = _compile_counts(r)
-        entry = {
-            "megastep_k": K,
-            "rounds_per_sec": r.get("value"),
-            "final_test_acc": r.get("final_test_acc"),
-            "wall_s": r.get("wall_s"),
-            "host_overhead_frac": r.get("host_overhead_frac"),
-            "steady_recompiles": recompiles,
-            **({"error": r["error"]} if "error" in r else {}),
-        }
-        if K == 1:
-            k1_rps = entry["rounds_per_sec"]
-        entry["speedup_vs_k1"] = (
-            round(entry["rounds_per_sec"] / k1_rps, 3)
-            if k1_rps and entry["rounds_per_sec"] else None)
-        out.append(entry)
-        print(json.dumps({"partial": f"megastep@{K}", **entry}),
-              file=sys.stderr)
+            rep_results = [
+                _measure_megastep_sweep([mk_cfg(smoke, K) for K in ks],
+                                        backend)
+                for _ in range(reps)]
+        except Exception as e:        # jax errors share no useful base
+            rep_results = [[{"error": f"{type(e).__name__}: {str(e)[:300]}"}
+                            for _ in ks]]
+        def _ratio(rr):
+            v0, vn = rr[0].get("value"), rr[-1].get("value")
+            return (vn / v0) if v0 and vn else 0.0
+        rep_results.sort(key=_ratio)
+        results = rep_results[len(rep_results) // 2]
+        k1_rps = None
+        for i, (K, r) in enumerate(zip(ks, results)):
+            recompiles = max(_compile_counts(rr[i])[1]
+                             for rr in rep_results)
+            entry = {
+                "variant": variant,
+                "megastep_k": K,
+                "rounds_per_sec": r.get("value"),
+                "final_test_acc": r.get("final_test_acc"),
+                "wall_s": r.get("wall_s"),
+                "host_overhead_frac": r.get("host_overhead_frac"),
+                "steady_recompiles": recompiles,
+                **({"error": r["error"]} if "error" in r else {}),
+            }
+            if K == 1:
+                k1_rps = entry["rounds_per_sec"]
+            entry["speedup_vs_k1"] = (
+                round(entry["rounds_per_sec"] / k1_rps, 3)
+                if k1_rps and entry["rounds_per_sec"] else None)
+            out.append(entry)
+            print(json.dumps({"partial": f"megastep@{variant}:{K}",
+                              **entry}),
+                  file=sys.stderr)
     return out
 
 

@@ -605,7 +605,9 @@ class TrainStep:
     # ------------------------------------------------------------------
     def train_megastep(self, params, base_key, x, y, time_ws, sample_w,
                        feat_mask, lr_scale, t0, R: int, freq: int, K: int,
-                       client_masks=None):
+                       client_masks=None, byz_modes=None, edge_ids=None,
+                       edge_masks=None, edge_byz=None, x_steps=None,
+                       y_steps=None, *, byz_stale: bool = False):
         """K whole time steps (each an R-round fused scan with scheduled
         evals) as ONE device program (dispatches ``_train_megastep_jit``).
 
@@ -613,29 +615,40 @@ class TrainStep:
         decided host-side BEFORE the block (the megastep contract: no drift
         decision may depend on results inside the block, which is what
         ``DriftAlgorithm.megastep_horizon`` certifies). client_masks:
-        [K, R, C] or None. t0 is a traced operand — advancing the block
-        start never retraces.
+        [K, R, C] or None; byz_modes [K, R, C], edge_ids [K, R, C],
+        edge_masks [K, R, E], edge_byz [K, R, E] are the per-step fault /
+        hierarchy schedules (None when the feature is off) — each step's
+        row feeds ``_iteration_body`` exactly as the K=1 fused path would.
+        Population cohorts pass ``x=y=None`` and the stacked per-step
+        gathers as ``x_steps/y_steps`` [K, C, T1, N, ...] instead — the
+        scan re-binds each step's cohort shard the way the host re-binds
+        ``self.x`` between iterations. t0 is a traced operand — advancing
+        the block start never retraces.
 
         Returns stacked per-step results ``(ps [K, M, ...], ns [K, M, C],
         losses [K, M, C], bufs (4x [K, E, M, C]), total [C],
         agg_stats [K, R, M, 3])``; step j of the block is bitwise-identical
         to a K=1 dispatch at t0+j because the scan folds the same
         ``iteration_key(base_key, t0+j)`` and re-inits the optimizer states
-        from the same value-independent zeros.
+        (and the stale-replay / delta-codec carries) from the same
+        value-independent seeds.
         """
         kind = self._note_signature(
             "train_megastep", params, x, y, time_ws, sample_w, feat_mask,
-            client_masks, static=(R, freq, K))
+            client_masks, byz_modes, edge_ids, edge_masks, edge_byz,
+            x_steps, y_steps, static=(R, freq, K, byz_stale))
         self._capture_cost(
             kind, "train_megastep", type(self)._train_megastep_jit,
             (params, base_key, x, y, time_ws, sample_w, feat_mask, lr_scale,
-             t0, R, freq, K, client_masks))
+             t0, R, freq, K, client_masks, byz_modes, edge_ids, edge_masks,
+             edge_byz, x_steps, y_steps), {"byz_stale": byz_stale})
         # lint: hot-path-begin (tracked dispatch wrapper)
         # lint: r4-ok (telemetry wall stamp; never a replay input)
         t0w, p0 = time.time(), time.perf_counter()
         out = self._train_megastep_jit(
             params, base_key, x, y, time_ws, sample_w, feat_mask, lr_scale,
-            t0, R, freq, K, client_masks)
+            t0, R, freq, K, client_masks, byz_modes, edge_ids, edge_masks,
+            edge_byz, x_steps, y_steps, byz_stale=byz_stale)
         if kind is not None:
             obs.spans.record("jit_compile", t0w, time.perf_counter() - p0,
                              cat="round", fn="train_megastep", event=kind)
@@ -645,10 +658,14 @@ class TrainStep:
     # NOTE: no buffer donation here — every output is K-stacked, so the
     # [M, ...] params input can never alias an output buffer (XLA would
     # warn "donated buffers were not usable" on every compile).
-    @partial(jax.jit, static_argnums=(0, 10, 11, 12))
+    @partial(jax.jit, static_argnums=(0, 10, 11, 12),
+             static_argnames=("byz_stale",))
     def _train_megastep_jit(self, params, base_key, x, y, time_ws, sample_w,
                             feat_mask, lr_scale, t0, R: int, freq: int,
-                            K: int, client_masks=None):
+                            K: int, client_masks=None, byz_modes=None,
+                            edge_ids=None, edge_masks=None, edge_byz=None,
+                            x_steps=None, y_steps=None, *,
+                            byz_stale: bool = False):
         """Outer scan over K time steps, each one `_iteration_body` call.
 
         The host round-trip this kills: the K=1 driver fetches params,
@@ -669,26 +686,36 @@ class TrainStep:
         the constraints degrade to replication no-ops.
         """
         M = time_ws.shape[1]
-        C = x.shape[0]
+        C = x.shape[0] if x is not None else x_steps.shape[1]
 
         def one_step(p, xs):
-            k, tw_k, cm_k = xs
+            k, tw_k, cm_k, bz_k, eid_k, em_k, eb_k, x_k, y_k = xs
+            # population mode: each step trains on ITS cohort's gathered
+            # shard; the time index inside the shard is still t (gathers
+            # keep the full [T1] axis, only the client axis is re-drawn)
+            xx = x if x is not None else x_k
+            yy = y if y is not None else y_k
             t = t0 + k
             it_key = iteration_key(base_key, t)
             o0 = self.init_opt_states(p, M, C)
             o0 = constrain_pool(self.mesh, o0, model_axis=0, client_axis=1)
             tw_k = constrain_pool(self.mesh, tw_k, model_axis=0,
                                   client_axis=1)
+            # stale-replay buffers and the delta-codec carry re-seed INSIDE
+            # _iteration_body per scanned step — the same per-iteration
+            # reset the host driver performs (_byz_stale/_codec_prev = None)
             p, _o, n, losses, bufs, total, stats = self._iteration_body(
-                p, o0, it_key, x, y, tw_k, sample_w, feat_mask, lr_scale,
-                R, freq, t, cm_k, None, None, None, None, byz_stale=False)
+                p, o0, it_key, xx, yy, tw_k, sample_w, feat_mask, lr_scale,
+                R, freq, t, cm_k, bz_k, eid_k, em_k, eb_k,
+                byz_stale=byz_stale)
             p = constrain_pool(self.mesh, p, model_axis=0)
             return p, (p, n, losses, bufs, total, stats)
 
         params = constrain_pool(self.mesh, params, model_axis=0)
         _, (ps, ns, ls, bufs, tots, stats) = jax.lax.scan(
             one_step, params,
-            (jnp.arange(K, dtype=jnp.int32), time_ws, client_masks))
+            (jnp.arange(K, dtype=jnp.int32), time_ws, client_masks,
+             byz_modes, edge_ids, edge_masks, edge_byz, x_steps, y_steps))
         # eval totals are a pure function of (x, feat_mask) — constant over
         # the block, so return one step's [C] row, same shape as K=1
         return ps, ns, ls, bufs, tots[0], stats

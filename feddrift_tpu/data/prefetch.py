@@ -101,50 +101,62 @@ class _Staged:
 
 
 class AsyncStager:
-    """Single-slot background stager for double-buffered cohort H2D.
+    """Multi-slot background staging pipeline for cohort H2D.
 
-    The runner submits next iteration's gather+device_put closure right
-    after the current iteration's checkpoint; by the time the driver loop
-    reaches iteration t+1 the shards are (usually) already resident and
-    ``take`` returns instantly. One worker thread, one slot: cohort staging
-    is strictly look-ahead-1 (the NEXT draw depends on failure-detector
-    state the current iteration updates), so deeper pipelining would stage
-    from stale registry state.
+    The runner submits gather+device_put closures keyed by iteration tag;
+    by the time the driver loop (or the megastep plan loop) reaches
+    iteration t the shards are (usually) already resident and ``take``
+    returns instantly. Slots are independent, so a K-step megastep block
+    can keep up to K gathers in flight — each plan step submits the next
+    step's gather and the last one overlaps the whole fused device
+    dispatch. One worker thread: gathers execute strictly in submission
+    order, which is also registry-draw order, so device_put traffic never
+    reorders against the bookkeeping that produced it.
 
-    ``take(tag)`` returns the staged ``.value``/``.meta`` holder when the
-    slot holds ``tag`` (blocking until the background fn finishes), or None
-    on an empty slot or tag mismatch — the caller falls back to inline
-    staging, so a miss costs only the overlap, never correctness.
-    Exceptions in the staging fn surface at ``take`` (future.result()).
+    How deep the pipeline actually runs is the RUNNER's call, not this
+    class's: each draw mutates the registry (churn) and reads
+    failure-detector state the previous step updates, so the runner only
+    submits a tag once that step's bookkeeping has committed.
+
+    ``take(tag)`` pops and returns the staged ``.value``/``.meta`` holder
+    for ``tag`` (blocking until the background fn finishes), or None when
+    the tag was never staged — the caller falls back to inline staging, so
+    a miss costs only the overlap, never correctness. Exceptions in the
+    staging fn surface at ``take`` (future.result()).
     """
 
-    def __init__(self) -> None:
+    def __init__(self, depth: int = 1) -> None:
         import concurrent.futures
         self._pool = concurrent.futures.ThreadPoolExecutor(
             max_workers=1, thread_name_prefix="cohort-stager")
-        self._tag = None
-        self._meta = None
-        self._future = None
+        self.depth = max(1, int(depth))
+        self._slots: dict = {}   # tag -> (future, meta)
 
     def submit(self, tag, fn: Callable[[], Any], meta: Any = None) -> None:
         """Stage ``fn()`` on the worker thread, keyed by ``tag``.
-        Overwrites any unclaimed previous slot (its device buffers are
-        simply dropped — jax puts are async and unpinned once unreferenced).
-        """
-        self._tag = tag
-        self._meta = meta
-        self._future = self._pool.submit(fn)
+        Re-submitting a tag overwrites its unclaimed slot; when the
+        pipeline is full the oldest unclaimed slot is dropped (its device
+        buffers are simply freed — jax puts are async and unpinned once
+        unreferenced)."""
+        self._slots.pop(tag, None)
+        while len(self._slots) >= self.depth:
+            self._slots.pop(next(iter(self._slots)))
+        self._slots[tag] = (self._pool.submit(fn), meta)
+
+    def has(self, tag) -> bool:
+        """True when ``tag`` is staged (possibly still in flight)."""
+        return tag in self._slots
 
     def take(self, tag) -> Optional[_Staged]:
-        """Claim the slot if it holds ``tag``; None otherwise. Clears the
-        slot either way only on a hit."""
-        if self._future is None or self._tag != tag:
+        """Pop ``tag``'s slot if staged; None otherwise."""
+        slot = self._slots.pop(tag, None)
+        if slot is None:
             return None
-        fut, meta = self._future, self._meta
-        self._tag = self._meta = self._future = None
+        fut, meta = slot
         return _Staged(fut.result(), meta)
 
     def close(self) -> None:
+        self._slots.clear()
         self._pool.shutdown(wait=False)
 
 

@@ -45,6 +45,7 @@ EVENT_KINDS = frozenset({
     "iteration_end",        # time step done: wall s, examples/s, phase totals
     "eval",                 # one eval point (round, Train/Test acc+loss)
     "checkpoint_save",      # atomic checkpoint written
+    "megastep_gated",       # a feature forced the fusion span below megastep_k
     # XLA compile tracking (core/step.py)
     "jit_compile",          # first time a program sees an argument signature
     "jit_recompile",        # a NEW signature on an already-compiled program

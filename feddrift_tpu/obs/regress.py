@@ -217,37 +217,61 @@ def compare(candidate: dict, baseline: dict,
     # the whole point of the megastep is amortizing the host round-trip,
     # so a K>1 row with K=1-level host overhead is a regression even if
     # throughput still clears its floor.
+    # Rows are keyed per (variant, K): legacy artifacts (MEGASTEP_r10)
+    # carry no "variant" field and keep their bare megastep[{k}] keys
+    # (treated as the "dense" variant); composed rows render as
+    # megastep[{variant}:{k}]. The pop_hier variant additionally carries
+    # an ABSOLUTE >= 2x speedup-vs-own-K=1 gate — the ISSUE-13 acceptance
+    # bar for fusing population cohorts + hierarchy + chaos, immune to a
+    # baseline that itself regressed.
     cms, bms = candidate.get("megastep"), baseline.get("megastep")
     if isinstance(cms, list) and isinstance(bms, list):
-        by_k = {e.get("megastep_k"): e for e in bms if isinstance(e, dict)}
-        c_k1 = next((e for e in cms if isinstance(e, dict)
-                     and e.get("megastep_k") == 1), None)
+        def _vk(e):
+            return (e.get("variant") or "dense", e.get("megastep_k"))
+
+        def _key(variant, k):
+            return (f"megastep[{k}]" if variant == "dense"
+                    else f"megastep[{variant}:{k}]")
+
+        by_vk = {_vk(e): e for e in bms if isinstance(e, dict)}
+        k1_by_variant = {_vk(e)[0]: e for e in cms if isinstance(e, dict)
+                         and e.get("megastep_k") == 1}
         for e in cms:
             if not isinstance(e, dict):
                 continue
-            k = e.get("megastep_k")
-            be = by_k.get(k)
+            variant, k = _vk(e)
+            name = _key(variant, k)
+            be = by_vk.get((variant, k))
             if be is None:
-                skip(f"megastep[{k}]", "K point missing in baseline")
+                skip(name, "variant/K point missing in baseline")
                 continue
             bv, cv = be.get("rounds_per_sec"), e.get("rounds_per_sec")
             if bv and cv:
                 floor = bv * (1.0 - tol["rounds"])
-                rows.append(row(f"megastep[{k}].rounds_per_s", bv, cv,
+                rows.append(row(f"{name}.rounds_per_s", bv, cv,
                                 f">= {floor:.3f}", cv < floor))
             rec = e.get("steady_recompiles")
             if rec is not None:
-                rows.append(row(f"megastep[{k}].steady_recompiles",
+                rows.append(row(f"{name}.steady_recompiles",
                                 be.get("steady_recompiles"), rec, "== 0",
                                 rec > 0,
                                 note="compile-count invariance over K"))
             hof = e.get("host_overhead_frac")
-            hof1 = (c_k1 or {}).get("host_overhead_frac")
+            hof1 = (k1_by_variant.get(variant)
+                    or {}).get("host_overhead_frac")
             if k and k > 1 and hof is not None and hof1 is not None:
-                rows.append(row(f"megastep[{k}].host_overhead_frac",
+                rows.append(row(f"{name}.host_overhead_frac",
                                 be.get("host_overhead_frac"), hof,
                                 f"< {hof1:.4f}", hof >= hof1,
-                                note="must beat this run's K=1 row"))
+                                note="must beat this run's own-variant "
+                                     "K=1 row"))
+            sp = e.get("speedup_vs_k1")
+            if variant == "pop_hier" and k and k > 1 and sp is not None:
+                rows.append(row(f"{name}.speedup_vs_k1",
+                                be.get("speedup_vs_k1"), sp, ">= 2",
+                                sp < 2.0,
+                                note="absolute composed-fusion floor vs "
+                                     "own K=1"))
     elif isinstance(bms, list):
         skip("megastep", "candidate lacks the megastep axis")
 

@@ -220,13 +220,27 @@ class StragglerInjector:
         # per-member miss probability: transient everywhere, persistent on top
         self.miss_prob = np.where(self.slow, self.SLOW_MISS_PROB, prob)
 
-    def latencies(self, round_idx: int) -> np.ndarray:
+    def latencies(self, round_idx: int,
+                  members: "np.ndarray | None" = None) -> np.ndarray:
         """[P] simulated latencies for one global round: on-time members
-        report well inside the deadline, stragglers past it."""
+        report well inside the deadline, stragglers past it.
+
+        ``members`` (an index array) restricts the returned vector to
+        those members — [len(members)], bitwise-identical to
+        ``latencies(r)[members]``. The full-population uniform draws
+        still happen (they ARE the stream — the value at index m is
+        defined by its position in the round's sample), but the latency
+        arithmetic then runs on the gathered slice only, which matters
+        when a 10^4 population backs a 10-client cohort."""
         rng = np.random.RandomState(
             (self.seed * 4_000_037 + round_idx) % (2**31 - 1))
         u = rng.random_sample(self.P)
-        miss = rng.random_sample(self.P) < self.miss_prob
+        miss_u = rng.random_sample(self.P)
+        miss_prob = self.miss_prob
+        if members is not None:
+            u, miss_u = u[members], miss_u[members]
+            miss_prob = miss_prob[members]
+        miss = miss_u < miss_prob
         on_time_lat = 0.2 * self.deadline * (0.5 + u)   # [0.1, 0.3]·deadline
         late_lat = self.deadline * (1.5 + u)            # comfortably late
         return np.where(miss, late_lat, on_time_lat)

@@ -22,6 +22,7 @@ from __future__ import annotations
 
 import logging
 import time
+from functools import partial
 from typing import Optional
 
 import jax
@@ -51,6 +52,18 @@ log = logging.getLogger("feddrift_tpu")
 def _sample_input(ds) -> jnp.ndarray:
     x0 = ds.x[0, 0, :2]
     return jnp.asarray(x0)
+
+
+@partial(jax.jit, static_argnums=(1,))
+def _unstack_steps(ps, K: int):
+    """All K per-step param slices of the megastep's stacked [K, M, ...]
+    output in ONE device program. The replay loop used to gather each
+    step's params eagerly (K x leaves dispatches per block); slicing is
+    value-identical either way, and the jitted outputs keep the stacked
+    tree's committed sharding, so the next block's input signature is
+    unchanged (steady_recompiles stays 0 — bench-gated)."""
+    return tuple(jax.tree_util.tree_map(lambda l, _k=k: l[_k], ps)
+                 for k in range(K))
 
 
 class Experiment:
@@ -260,13 +273,15 @@ class Experiment:
                 cfg.cohort_size or cfg.client_num_in_total)
             self._slot_valid = np.ones(self.C_pad, dtype=bool)
             self._slot_valid[self.C_:] = False
-            # Double-buffered cohort staging: iteration t's tail kicks off
-            # the t+1 gather + device_put on a background thread so the
-            # next _prepare_cohort finds its shard already staged
+            # Pipelined cohort staging: iteration t's tail kicks off the
+            # t+1 gather + device_put on a background thread so the next
+            # _prepare_cohort finds its shard already staged
             # (data/prefetch.py::AsyncStager; bitwise-identical — only the
-            # copy timing moves).
+            # copy timing moves). Megastep blocks keep up to K gathers in
+            # flight (each plan step submits the next step's shard), hence
+            # the K-deep pipeline.
             from feddrift_tpu.data.prefetch import AsyncStager
-            self._stager = AsyncStager()
+            self._stager = AsyncStager(depth=max(1, cfg.megastep_k))
         from feddrift_tpu.platform.faults import (ByzantineInjector,
                                                   FailureDetector,
                                                   FaultInjector)
@@ -629,9 +644,11 @@ class Experiment:
             gr = t * cfg.comm_round + int(r)
             lat = None
             if self.straggler is not None:
-                pop_lat = self.straggler.latencies(gr)
-                lat = np.where(valid, pop_lat[np.where(valid, members, 0)],
-                               np.inf)
+                # cohort-sliced draw: latencies(gr)[members] without
+                # materializing the population-sized latency arithmetic
+                coh_lat = self.straggler.latencies(
+                    gr, np.where(valid, members, 0))
+                lat = np.where(valid, coh_lat, np.inf)
             outcome = self.participation.close_round(members, lat, gr)
             self.registry.record_round(members, outcome.on_time, gr)
             if not outcome.degraded:
@@ -1231,27 +1248,69 @@ class Experiment:
 
     # ------------------------------------------------------------------
     # multi-iteration megastep (TrainStep.train_megastep)
+    def _megastep_gates(self, t: int) -> list:
+        """Per-feature megastep capability table: the reasons (possibly
+        several) that force the fusion span to 1 at step ``t``.
+
+        Population cohorts, two-tier hierarchy, Byzantine schedules and
+        the wire codecs all FUSE now — their per-step state (stacked
+        cohort gathers, edge plans, attack masks, stale-replay / delta
+        carries) rides the outer scan. What still can't:
+
+          chunk_rounds_off     — per-round host loop explicitly requested
+          stream_data          — the dataset window swaps between steps
+          algo_not_chunkable   — the algorithm steers individual rounds
+          ensemble_eval        — ensemble test path needs host-side eval
+
+        (The divergence guard does NOT gate fusion: blocks whose plan
+        committed non-replayable bookkeeping — population registry
+        mutations, edge kills/re-homes — recover at block granularity
+        instead of truncate-and-rerun; see run_megastep.)
+        """
+        cfg = self.cfg
+        reasons = []
+        if not cfg.chunk_rounds:
+            reasons.append("chunk_rounds_off")
+        if cfg.stream_data:
+            reasons.append("stream_data")
+        if not self.algo.chunkable(t):
+            reasons.append("algo_not_chunkable")
+        if self.algo.ensemble_spec(t) is not None:
+            reasons.append("ensemble_eval")
+        return reasons
+
     def _megastep_span(self, t: int) -> int:
         """How many whole time steps starting at ``t`` to fuse into one
         train_megastep dispatch. 1 = legacy per-iteration path (always
         bitwise-identical — K=1 never even builds the megastep program).
 
-        Features that need per-iteration host participation keep the span
-        at 1: population cohorts re-gather data between steps, hierarchy /
-        Byzantine schedules and the delta codec thread per-iteration
-        carries the megastep scan does not model, streaming swaps the
-        dataset window. Within the fusable configurations the algorithm's
+        The per-feature capability table (``_megastep_gates``) names every
+        feature that forces K down; each forcing reason is surfaced as a
+        ``megastep_gated`` event + counter so `report` can say why fusion
+        was forfeited. Within the fusable configurations the algorithm's
         ``megastep_horizon`` bounds the span at its next drift-decision
-        boundary."""
+        boundary (also surfaced, reason "algo_horizon"); the end-of-run
+        tail clamp is not a gate and stays silent."""
         cfg = self.cfg
-        if (cfg.megastep_k <= 1 or not cfg.chunk_rounds or cfg.stream_data
-                or self.population_mode or self.hierarchy
-                or self.byzantine is not None or self.step.codec != "none"):
+        if cfg.megastep_k <= 1:
+            return 1     # fusion not requested — nothing was forfeited
+        reasons = self._megastep_gates(t)
+        if reasons:
+            for reason in reasons:
+                self.events.emit("megastep_gated", reason=reason,
+                                 gate_iteration=t, requested=cfg.megastep_k,
+                                 granted=1)
+                obs.registry().counter("megastep_gated", reason=reason).inc()
             return 1
-        if not (self.algo.chunkable(t) and self.algo.ensemble_spec(t) is None):
-            return 1
-        return max(1, min(cfg.megastep_k, self.algo.megastep_horizon(t),
-                          cfg.train_iterations - t))
+        horizon = self.algo.megastep_horizon(t)
+        want = min(cfg.megastep_k, cfg.train_iterations - t)
+        if horizon < want:
+            self.events.emit("megastep_gated", reason="algo_horizon",
+                             gate_iteration=t, requested=cfg.megastep_k,
+                             granted=max(1, horizon))
+            obs.registry().counter("megastep_gated",
+                                   reason="algo_horizon").inc()
+        return max(1, min(want, horizon))
 
     def run_megastep(self, t0: int, K: int) -> int:
         """Run K whole time steps as ONE device dispatch
@@ -1259,22 +1318,39 @@ class Experiment:
         into the exact per-iteration record stream the K=1 path emits.
 
         Three phases:
-          plan    — per step, in sequential order: events context,
-                    begin_iteration (host drift decisions on pre-block
-                    state — legal because megastep_horizon certified steps
-                    t0+1.. are decision-free), round_inputs, client masks.
-          dispatch — one donated-buffer device program for all K*R rounds.
+          plan    — per step, in sequential order: events context, cohort
+                    prepare (population — consumes the previous plan
+                    step's staged gather), begin_iteration (host drift
+                    decisions on pre-block state — legal because
+                    megastep_horizon certified steps t0+1.. are
+                    decision-free), round_inputs, client masks (which
+                    commit registry participation bookkeeping), Byzantine
+                    and edge-fault schedules, and — population — the
+                    registry writeback, which commits at this (block-plan)
+                    boundary instead of after the step trains: legal for
+                    the same decision-free reason, since every writeback
+                    input (per-step model assignment, detector arms,
+                    isolation marks) is settled by begin_iteration and
+                    end_iteration is a no-op for every algorithm. Each
+                    population plan step then submits the NEXT step's
+                    cohort gather to the K-deep AsyncStager, so H2D
+                    staging pipelines against the remaining host planning.
+          dispatch — one device program for all K*R rounds; per-step
+                    cohort shards, attack masks and edge plans ride the
+                    outer scan as stacked [K, ...] inputs.
           replay  — per step, in sequential order: robust-agg stats,
                     divergence guard (same per-iteration window/check
                     cadence), after_round, the buffered eval matrices into
-                    _log_eval, end_iteration.
+                    _log_eval (under that step's cohort validity mask),
+                    end_iteration.
 
         Returns the number of COMMITTED iterations: K normally; j+1 after
         a divergence rollback at block step j — steps past j trained on
         the diverged trajectory inside the fused program, so the driver
         loop reruns them from the restored params (their planning-phase
         events re-emit; all planning state writes are idempotent by the
-        megastep contract)."""
+        megastep contract — the capability table keeps the guard off the
+        non-idempotent population/edge-fault bookkeeping)."""
         cfg = self.cfg
         R, freq = cfg.comm_round, cfg.frequency_of_the_test
         block_t0 = time.time()
@@ -1282,12 +1358,28 @@ class Experiment:
         self._profiled_rounds = 0
         g0 = self.global_round
         # -- plan ------------------------------------------------------
+        # lint: hot-path-begin (megastep plan: K-step cohort/fault stacking)
         tws, cms_list = [], []
+        bms_list = [] if self.byzantine is not None else None
+        eids_list, emasks_list, ebyz_list = [], [], []
+        xs_list, ys_list, slot_valids, members_list = [], [], [], []
         sw = fm = lr_scale = None
         for j in range(K):
             t = t0 + j
             self.events.set_context(iteration=t, round=g0 + j * R)
             self.events.emit("iteration_start", megastep_k=K)
+            if self.population_mode:
+                # identical accounting to run_iteration: cohort_prep
+                # exclusive of the nested h2d span
+                prep_w, prep_p = time.time(), time.perf_counter()
+                h2d_before = self._segs.get("h2d", 0.0)
+                with self.tracer.phase("cohort"):
+                    self._prepare_cohort(t)
+                prep_dt = time.perf_counter() - prep_p
+                self.spans.record("cohort_prep", prep_w, prep_dt,
+                                  cat="round", iteration=t)
+                self._seg_add("cohort_prep", prep_dt
+                              - (self._segs.get("h2d", 0.0) - h2d_before))
             self._byz_stale = None
             self._codec_prev = None
             if self.failure_detector is not None:
@@ -1311,18 +1403,71 @@ class Experiment:
                     "feature mask (megastep_horizon contract violated)")
             tws.append(self._pad_clients(tw))
             cms_list.append(self._client_masks(t, range(R)))
+            if bms_list is not None:
+                bms_list.append(self._byz_modes(range(R), t))
+            if self.hierarchy:
+                # sequential per-step planning: edge kills/re-homes land
+                # between steps exactly as on the per-iteration path
+                eid_j, em_j, eb_j = self._edge_state(t, range(R))
+                eids_list.append(eid_j)
+                emasks_list.append(em_j)
+                ebyz_list.append(eb_j)
+            if self.population_mode:
+                xs_list.append(self.x)
+                ys_list.append(self.y)
+                slot_valids.append(self._slot_valid.copy())
+                # host-resident member ids — a registry draw, never a
+                # device buffer; copied so replay keeps step j's cohort
+                # after later plan steps re-draw
+                # lint: r2-ok (host numpy registry draw, not a device sync)
+                members_list.append(np.asarray(self._cohort_members).copy())
+                # block-boundary registry commit (see docstring); must
+                # precede the next step's draw, whose staleness view and
+                # assignment history read these columns
+                with self._seg("writeback", iteration=t):
+                    self._cohort_writeback(t)
+                if j < K - 1:
+                    # pipeline the NEXT plan step's gather; the block-exit
+                    # stage (t0+K) waits for the block checkpoint below so
+                    # a resume never re-applies its churn
+                    self._stage_cohort(t + 1)
         sw = self._pad_clients(sw, value=1.0)
         time_ws = jnp.stack(tws)                      # [K, M, C_pad, T1]
         cms = None
         if cms_list[0] is not None:
             cms = jnp.asarray(np.stack(cms_list))     # [K, R, C_pad]
+        bms = None
+        if bms_list:
+            bms = jnp.asarray(np.stack(bms_list))     # [K, R, C_pad]
+        eids = emasks = ebyz = None
+        if self.hierarchy:
+            eids = jnp.asarray(np.stack(eids_list))   # [K, R, C_pad]
+            if emasks_list[0] is not None:
+                emasks = jnp.asarray(np.stack(emasks_list))   # [K, R, E]
+            if any(b is not None for b in ebyz_list):
+                zeros = np.zeros((R, cfg.hierarchy_edges), dtype=np.int32)
+                ebyz = jnp.asarray(np.stack(
+                    [b if b is not None else zeros for b in ebyz_list]))
+        x_steps = y_steps = None
+        if self.population_mode:
+            # [K, C_pad, T1, N, ...] stacked per-step cohort shards — the
+            # scan's data input; built identically every block so the jit
+            # signature (and therefore the compile cache) is stable
+            x_steps = jnp.stack(xs_list)
+            y_steps = jnp.stack(ys_list)
+        byz_stale = self.byzantine is not None and self.byzantine.has_stale
+        # lint: hot-path-end
         # -- dispatch --------------------------------------------------
         # lint: hot-path-begin (megastep: one program per K-step block)
         with self.tracer.phase("train_round"):
             disp0 = time.perf_counter()
             ps, ns, ls, bufs, total, agg_stats = self.step.train_megastep(
-                self.pool.params, self.key, self.x, self.y, time_ws, sw, fm,
-                lr_scale, jnp.int32(t0), R, freq, K, cms)
+                self.pool.params, self.key,
+                None if self.population_mode else self.x,
+                None if self.population_mode else self.y,
+                time_ws, sw, fm,
+                lr_scale, jnp.int32(t0), R, freq, K, cms, bms, eids,
+                emasks, ebyz, x_steps, y_steps, byz_stale=byz_stale)
             self._seg_add("dispatch", time.perf_counter() - disp0)
             blk_w, blk0 = time.time(), time.perf_counter()
             # lint: r2-ok (one dispatch-to-ready sample per K-step block)
@@ -1340,33 +1485,65 @@ class Experiment:
                                np.asarray(total_h))
         corr_tr, loss_tr, corr_te, loss_te = (np.asarray(b) for b in bufs_h)
         stats_h = (np.asarray(multihost.fetch(agg_stats))
-                   if self._robust_active else None)
+                   if (self._robust_active or self.hierarchy) else None)
         evs = self.step.eval_rounds(R, freq)
+        steps_p = _unstack_steps(ps, K)
         committed = K
         final_p = None
+        # Truncate-and-rerun rollback needs the driver to re-execute the
+        # steps past the divergence, which re-runs their planning. That is
+        # only sound when planning was pure: population registry
+        # bookkeeping (churn application, record_round streak/EWMA) and
+        # edge kills/re-homes are already committed for the WHOLE block
+        # and are not idempotent under replay, so those blocks recover at
+        # block granularity instead — restore the last clean step's params
+        # and skip the poisoned remainder's adoption (bookkeeping and the
+        # block checkpoint stay consistent; one rollback per block).
+        replayable = not (self.population_mode
+                          or self.edge_fault is not None)
+        skipping = False
         for j in range(K):
             t = t0 + j
             gj = g0 + j * R
             self.events.set_context(iteration=t, round=gj)
+            if self.population_mode:
+                # metrics masking + eval logging must see THIS step's
+                # cohort, not the last plan step's
+                self._slot_valid = slot_valids[j]
+                self._cohort_members = members_list[j]
             if stats_h is not None:
                 for rr in range(R):
                     self._emit_robust_stats(stats_h[j, rr], gj + rr)
+            if skipping:
+                # poisoned tail of a non-replayable block: no adoption, no
+                # eval logging (the buffers hold diverged numbers); the
+                # round cadence and iteration lifecycle still advance
+                self.global_round = gj + R
+                with self.tracer.phase("cluster"), \
+                        self._seg("drift_decision", iteration=t):
+                    self.algo.end_iteration(t)
+                continue
             if self.divergence_guard is not None:
                 self.divergence_guard.new_window()
             if self._check_divergence(ls_h[j], ns_h[j]):
-                # roll back to the end of block step j-1 and truncate: the
-                # fused program trained later steps on the diverged
-                # trajectory. For j=0 the pool still holds the pre-block
-                # params (the megastep program does not donate its input),
-                # so the rollback is a no-op there.
+                # roll back to the end of block step j-1: the fused
+                # program trained later steps on the diverged trajectory.
+                # For j=0 the pool still holds the pre-block params (the
+                # megastep program does not donate its input), so the
+                # rollback is a no-op there.
                 if j > 0:
-                    self.pool.params = jax.tree_util.tree_map(
-                        lambda l, _j=j: l[_j - 1], ps)
+                    self.pool.params = steps_p[j - 1]
                 self.divergence_guard.record_rollback()
                 self.global_round = gj + R
-                committed = j + 1
-                break
-            step_p = jax.tree_util.tree_map(lambda l, _j=j: l[_j], ps)
+                if replayable:
+                    committed = j + 1
+                    break
+                skipping = True
+                with self.tracer.phase("cluster"), \
+                        self._seg("drift_decision", iteration=t):
+                    self.algo.end_iteration(t)
+                continue
+            step_p = steps_p[j]
             wb0 = time.perf_counter()
             self.pool.params = self.algo.after_round(
                 t, R - 1, None, step_p, None, ns_h[j])
@@ -1387,7 +1564,7 @@ class Experiment:
             final_p = step_p
         # Final-slot accuracy offer, exactly like the K=1 fused path —
         # keyed to the sliced final-step params object the pool now holds.
-        if final_p is not None and committed == K:
+        if final_p is not None and committed == K and not skipping:
             tot = np.maximum(total_h[None, :C], 1)
             self.algo.offer_acc_matrix(
                 final_p, {t0 + K - 1: corr_tr[K - 1, -1][:, :C] / tot,
@@ -1400,6 +1577,13 @@ class Experiment:
             with self._seg("writeback", iteration=last_t):
                 self.save_checkpoint(last_t)
             self.events.emit("checkpoint_save", path=self.ckpt_path())
+        if self.population_mode:
+            # pre-stage the NEXT block's first cohort — after the block
+            # checkpoint for the same reason run_iteration stages after
+            # its own: the churn a draw commits must never be ahead of the
+            # registry state a resume reloads (ChurnSchedule events filter
+            # on the live active mask, so double-application diverges)
+            self._stage_cohort(t0 + committed)
         # -- per-iteration telemetry records ---------------------------
         wall = time.time() - block_t0
         log.info("megastep %d..%d (K=%d) done in %.1fs (Test/Acc=%.4f)",
@@ -1408,7 +1592,9 @@ class Experiment:
         self.last_phase_summary = self.tracer.summary()
         self.tracer.reset()
         B = min(cfg.batch_size, self.ds.samples_per_step)
-        participants = min(cfg.client_num_per_round, self.C_)
+        participants = ((cfg.cohort_size or cfg.client_num_in_total)
+                        if self.population_mode
+                        else min(cfg.client_num_per_round, self.C_))
         examples = R * cfg.epochs * B * participants
         wall_j = wall / committed
         gap = max(wall - sum(self._segs.values()), 0.0)

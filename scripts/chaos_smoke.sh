@@ -27,17 +27,23 @@
 #    rounds, 20% injected stragglers and join/leave churn completes,
 #    masks stragglers out of the aggregation (straggler_masked in
 #    events.jsonl) and renders the `report` participation section.
-# 7) hierarchy domain — a 10^3-population two-tier run (3 edge groups,
+# 7) fused participation — a megastep_k=4 population run (cohorts,
+#    stragglers, churn fused K iterations per dispatch) is SIGTERM'd
+#    mid-run and re-launched with --auto_resume; asserts the resumed run
+#    reproduces the IDENTICAL per-iteration cohort_sampled member
+#    schedule as an uninterrupted reference run (the block checkpoint /
+#    staging order contract), with a duplicate-free metrics.jsonl.
+# 8) hierarchy domain — a 10^3-population two-tier run (3 edge groups,
 #    per-tier trimmed_mean, int8 wire codec) loses an entire edge mid-run;
 #    asserts the run completes, the dead edge's clients are re-homed
 #    (edge_failed reason=killed then edge_rehomed in events.jsonl), no
 #    accuracy NaN, and `report` renders the hierarchy section.
-# 8) causal-trace continuity — client update frames published through a
+# 9) causal-trace continuity — client update frames published through a
 #    ReconnectingBrokerClient keep their trace context across a broker
 #    kill/restart: the resent frame carries the same trace_id, so the
 #    client -> edge -> server chain stays connected (runs the tier-1 test
 #    that encodes exactly that).
-# 9) live ops plane — a process with /metrics + /healthz up loses its
+# 10) live ops plane — a process with /metrics + /healthz up loses its
 #    broker mid-run: /healthz flips to 503 degraded, an slo_burn
 #    (broker_liveness, via heartbeat_missed) lands in alerts.jsonl; the
 #    broker restarts on the same port and /healthz flips back to 200 ok.
@@ -51,12 +57,12 @@ OUT=$(mktemp -d)
 trap 'rm -rf "$OUT"' EXIT
 RUN="$OUT/run"
 
-echo "== [1/9] chaos transport e2e (drop_prob=0.2 + broker kill/restart) =="
+echo "== [1/10] chaos transport e2e (drop_prob=0.2 + broker kill/restart) =="
 timeout -k 10 300 python -m pytest tests/test_resilience.py -q \
     -p no:cacheprovider -p no:randomly \
     -k "ChaosEndToEnd or survives_broker_kill or heartbeat_missed"
 
-echo "== [2/9] preemption: SIGTERM a real run, then --auto_resume =="
+echo "== [2/10] preemption: SIGTERM a real run, then --auto_resume =="
 ARGS=(--dataset sine --model fnn --concept_drift_algo win-1
       --concept_num 2 --client_num_in_total 4 --client_num_per_round 4
       --train_iterations 6 --comm_round 8 --epochs 2
@@ -93,15 +99,15 @@ print(f"resume OK: {len(rows)} metric rows, final Test/Acc="
       f"{rows[-1]['Test/Acc']:.4f}")
 EOF
 
-echo "== [3/9] event taxonomy consistency (strict: no dead kinds) =="
+echo "== [3/10] event taxonomy consistency (strict: no dead kinds) =="
 python scripts/check_events_schema.py --strict
 
-echo "== [4/9] byzantine smoke: trimmed_mean defends where mean fails =="
+echo "== [4/10] byzantine smoke: trimmed_mean defends where mean fails =="
 timeout -k 10 300 python -m pytest tests/test_robust_agg.py -q \
     -p no:cacheprovider -p no:randomly \
     -k "trimmed_mean_defends_where_mean_fails"
 
-echo "== [5/9] decision observability: kill clients -> alerts + lineage =="
+echo "== [5/10] decision observability: kill clients -> alerts + lineage =="
 LRUN="$OUT/lineage-run"
 timeout -k 10 300 python - "$LRUN" <<'EOF'
 import sys
@@ -135,7 +141,7 @@ python -m feddrift_tpu report "$LRUN" > "$OUT/report.txt"
 grep -q "alerts:" "$OUT/report.txt" \
     || { echo "report missing alerts section"; exit 1; }
 
-echo "== [6/9] participation: 10^3 population, 20% stragglers + churn =="
+echo "== [6/10] participation: 10^3 population, 20% stragglers + churn =="
 PRUN="$OUT/population-run"
 timeout -k 10 300 python -m feddrift_tpu run \
     --dataset sea --model fnn --concept_drift_algo softcluster \
@@ -154,7 +160,65 @@ python -m feddrift_tpu report "$PRUN" > "$OUT/preport.txt"
 grep -q "participation:" "$OUT/preport.txt" \
     || { echo "report missing participation section"; exit 1; }
 
-echo "== [7/9] hierarchy: 10^3 population, kill edge 0 mid-run =="
+echo "== [7/10] fused participation: megastep_k=4 kill -> resume, same cohorts =="
+FREF="$OUT/fused-ref"
+FRUN="$OUT/fused-run"
+FARGS=(--dataset sea --model fnn --concept_drift_algo oblivious
+       --concept_num 1 --megastep_k 4
+       --population_size 1000 --cohort_size 10 --cohort_overprovision 2
+       --straggler_prob 0.2 --churn_leave_prob 0.02 --churn_join_prob 0.05
+       --train_iterations 8 --comm_round 4 --epochs 2 --sample_num 40
+       --batch_size 20 --frequency_of_the_test 4 --report_client 0
+       --flat_out_dir)
+# uninterrupted reference run: the cohort schedule ground truth
+timeout -k 10 600 python -m feddrift_tpu run "${FARGS[@]}" --out_dir "$FREF"
+# killed run: the first cohort_sampled lands during block 1's PLAN phase
+# (before the block's dispatch/compile), so the TERM reliably arrives
+# while the run — and its preemption handler — is still live; the
+# handler finishes the in-flight block, checkpoints it, and exits 0
+timeout -k 10 600 python -m feddrift_tpu run "${FARGS[@]}" --out_dir "$FRUN" &
+FPID=$!
+for _ in $(seq 1 3000); do
+    if grep -qs cohort_sampled "$FRUN/events.jsonl"; then break; fi
+    sleep 0.1
+done
+grep -qs cohort_sampled "$FRUN/events.jsonl" \
+    || { echo "fused run never planned a cohort"; exit 1; }
+kill -TERM "$FPID"
+wait "$FPID"   # preempted fused run must still exit 0
+grep -q preempt_checkpoint "$FRUN/events.jsonl" \
+    || { echo "missing preempt_checkpoint event"; exit 1; }
+timeout -k 10 600 python -m feddrift_tpu run "${FARGS[@]}" --out_dir "$FRUN" \
+    --auto_resume
+python - "$FREF" "$FRUN" <<'EOF'
+import json, sys
+ref, run = sys.argv[1], sys.argv[2]
+
+def cohorts(d):
+    out = {}
+    for l in open(f"{d}/events.jsonl"):
+        e = json.loads(l)
+        if e.get("kind") == "cohort_sampled":
+            # first draw per iteration wins: a staged-but-unconsumed draw
+            # re-emitted by the resume replays with identical members
+            out.setdefault(e["iteration"], e["members"])
+    return out
+
+c_ref, c_run = cohorts(ref), cohorts(run)
+assert set(c_ref) == set(c_run) == set(range(8)), \
+    f"iteration coverage differs: ref={sorted(c_ref)} run={sorted(c_run)}"
+for t in sorted(c_ref):
+    assert c_ref[t] == c_run[t], \
+        f"iteration {t} cohort diverges after resume: " \
+        f"{c_ref[t]} vs {c_run[t]}"
+rows = [json.loads(l) for l in open(f"{run}/metrics.jsonl")]
+seen = [(r["iteration"], r["round"]) for r in rows]
+assert len(seen) == len(set(seen)), "duplicate (iteration, round) rows"
+print(f"fused resume OK: {len(c_ref)} iterations, identical cohort "
+      f"schedule, {len(rows)} metric rows")
+EOF
+
+echo "== [8/10] hierarchy: 10^3 population, kill edge 0 mid-run =="
 HRUN="$OUT/hierarchy-run"
 timeout -k 10 300 python -m feddrift_tpu run \
     --dataset sea --model fnn --concept_drift_algo softcluster \
@@ -192,12 +256,12 @@ grep -q "hierarchy:" "$OUT/hreport.txt" \
 grep -q "re-homed:" "$OUT/hreport.txt" \
     || { echo "report missing re-homed line"; exit 1; }
 
-echo "== [8/9] causal trace continuity across broker reconnect =="
+echo "== [9/10] causal trace continuity across broker reconnect =="
 timeout -k 10 300 python -m pytest tests/test_causal_trace.py -q \
     -p no:cacheprovider -p no:randomly \
     -k "trace_survives_broker_reconnect"
 
-echo "== [9/9] live ops plane: broker kill -> /healthz 503 + slo_burn -> recovery =="
+echo "== [10/10] live ops plane: broker kill -> /healthz 503 + slo_burn -> recovery =="
 ORUN="$OUT/ops-run"
 mkdir -p "$ORUN"
 timeout -k 10 300 python - "$ORUN" <<'EOF'

@@ -16,18 +16,18 @@ cd "$(dirname "$0")/.."
 out="$(mktemp -d)"
 trap 'rm -rf "$out"' EXIT
 
-echo "[perf_gate 1/8] graftlint: static analysis must be clean"
+echo "[perf_gate 1/9] graftlint: static analysis must be clean"
 # cheapest stage first: the lint verb is pre-jax and runs in ~1s; a dirty
 # tree fails the gate before any bench spends minutes compiling
 python -m feddrift_tpu lint feddrift_tpu/ --strict
 
-echo "[perf_gate 2/8] warm run (populates the persistent compile cache)"
+echo "[perf_gate 2/9] warm run (populates the persistent compile cache)"
 python bench.py --smoke --cpu > "$out/warm.json"
 
-echo "[perf_gate 3/8] measured run"
+echo "[perf_gate 3/9] measured run"
 python bench.py --smoke --cpu > "$out/bench.json"
 
-echo "[perf_gate 4/8] cost-model + critical-path fields present"
+echo "[perf_gate 4/9] cost-model + critical-path fields present"
 python - "$out/bench.json" <<'EOF'
 import json, sys
 d = json.loads(open(sys.argv[1]).read().strip().splitlines()[-1])
@@ -44,7 +44,7 @@ print(f"  mfu_estimate={d['mfu_estimate']} (source={d['mfu']['source']}), "
       f"round_wall_p99_s={d['round_wall_p99_s']}")
 EOF
 
-echo "[perf_gate 5/8] critical_path on a smoke run dir"
+echo "[perf_gate 5/9] critical_path on a smoke run dir"
 # bench.py runs without an out_dir (no spans.jsonl), so the attribution
 # verb gets its own tiny recorded run: 2 iterations, per-round path.
 JAX_PLATFORMS=cpu python -m feddrift_tpu run \
@@ -68,7 +68,7 @@ print(f"  dominant_segment={d['dominant_segment']}, "
       f"host_overhead_frac_mean={d['host_overhead_frac_mean']}")
 EOF
 
-echo "[perf_gate 6/8] megastep: K=4 vs K=1 bitwise parity + zero steady recompiles"
+echo "[perf_gate 6/9] megastep: K=4 vs K=1 bitwise parity + zero steady recompiles"
 # the megastep fuses K whole iterations into one device program; the gate
 # is (a) bitwise-identical params/accuracy vs the K=1 driver and (b) no
 # jit cache growth past the single warm-up compile across blocks
@@ -101,7 +101,88 @@ print(f"  parity OK (leafdiff=0.0, {len(a4)} eval points), "
       f"megastep cache entries={n}")
 EOF
 
-echo "[perf_gate 7/8] regress: self-comparison (warm), then vs BENCH_r05.json"
+echo "[perf_gate 7/9] composed megastep: population+hierarchy K=4 parity + throughput"
+# the megastep gate is per-feature: population cohorts, hierarchy and
+# chaos schedules all fuse now. Gate is (a) bitwise parity (params, eval
+# series, registry bookkeeping) vs the K=1 driver, (b) no megastep jit
+# cache growth past warm-up, (c) K=4 at or above its own K=1 rounds/s
+# under the same paired-min protocol as the ops stage below (noise only
+# adds time; the mins sample comparable machine states)
+JAX_PLATFORMS=cpu python - <<'EOF'
+import time
+import jax, numpy as np
+from feddrift_tpu.config import ExperimentConfig
+from feddrift_tpu.simulation.runner import Experiment
+
+BASE = dict(dataset="sea", model="lr", concept_drift_algo="oblivious",
+            concept_drift_algo_arg="", concept_num=1,
+            population_size=200, cohort_size=8, cohort_overprovision=2,
+            straggler_prob=0.1, churn_leave_prob=0.02, churn_join_prob=0.04,
+            hierarchy_edges=3, edge_robust_agg="trimmed_mean",
+            train_iterations=12, comm_round=3, epochs=1, batch_size=50,
+            sample_num=50, frequency_of_the_test=3, seed=7, trace_sync=True)
+
+def run(K):
+    exp = Experiment(ExperimentConfig(**BASE, megastep_k=K))
+    exp.run()
+    return exp
+
+e1, e4 = run(1), run(4)
+diff = max(float(np.max(np.abs(np.asarray(x) - np.asarray(y))))
+           for x, y in zip(jax.tree_util.tree_leaves(e1.pool.params),
+                           jax.tree_util.tree_leaves(e4.pool.params)))
+assert diff == 0.0, f"composed megastep K=4 params diverge from K=1: {diff}"
+a1, a4 = e1.logger.series("Test/Acc"), e4.logger.series("Test/Acc")
+assert a1 == a4, "composed megastep K=4 eval series diverges from K=1"
+for attr in ("active", "joined_round", "last_seen_round",
+             "last_sampled_round", "absent_streak", "reliability"):
+    assert np.array_equal(getattr(e1.registry, attr),
+                          getattr(e4.registry, attr)), \
+        f"registry.{attr} diverges between K=1 and K=4"
+assert len(e4.step._signatures["train_megastep"]) == 1, \
+    "composed megastep jit cache grew past warm-up"
+
+# paired-min throughput: fresh experiments, warmed, alternate 4-iteration
+# turns; each side scored by its minimum per-iteration wall
+def build(K):
+    exp = Experiment(ExperimentConfig(
+        **{**BASE, "megastep_k": K, "train_iterations": 28}))
+    t = 0
+    while t < 4:
+        span = exp._megastep_span(t)
+        if span > 1:
+            t += exp.run_megastep(t, span)
+        else:
+            exp.run_iteration(t); t += 1
+    jax.block_until_ready(exp.pool.params)
+    return exp, t
+
+(t1, i1), (t4, i4) = build(1), build(4)
+best = {1: float("inf"), 4: float("inf")}
+pos = {1: i1, 4: i4}
+exps = {1: t1, 4: t4}
+for turn in range(6):
+    order = (1, 4) if turn % 2 else (4, 1)
+    for K in order:
+        exp, t = exps[K], pos[K]
+        t0 = time.perf_counter()
+        tgt = t + 4
+        while t < tgt:
+            span = exp._megastep_span(t)
+            if span > 1:
+                t += exp.run_megastep(t, span)
+            else:
+                exp.run_iteration(t); t += 1
+        jax.block_until_ready(exp.pool.params)
+        best[K] = min(best[K], (time.perf_counter() - t0) / 4)
+        pos[K] = t
+r1, r4 = 3 / best[1], 3 / best[4]
+print(f"  parity OK (leafdiff=0.0, {len(a4)} eval points); "
+      f"rounds/s K1={r1:.1f} K4={r4:.1f} ratio={r4 / r1:.2f} (floor 1.0)")
+assert r4 >= r1, f"composed K=4 slower than its own K=1: {r4:.1f} vs {r1:.1f}"
+EOF
+
+echo "[perf_gate 8/9] regress: self-comparison (warm), then vs BENCH_r05.json"
 # back-to-back smoke runs on a busy 1-core host: generous relative noise
 # margins, but identical round counts make every metric comparable
 python -m feddrift_tpu regress "$out/bench.json" --baseline "$out/warm.json" \
@@ -112,7 +193,7 @@ python -m feddrift_tpu regress "$out/bench.json" --baseline "$out/warm.json" \
 python -m feddrift_tpu regress "$out/bench.json" --baseline BENCH_r05.json \
     --tol-rounds 0.9 --tol-acc 0.15
 
-echo "[perf_gate 8/8] ops plane overhead: enabled run within 2% of disabled"
+echo "[perf_gate 9/9] ops plane overhead: enabled run within 2% of disabled"
 # The /metrics + /healthz server, SLO engine and status tap must stay off
 # the hot path. Resolving a 2% bound on a noisy 1-core host needs a
 # paired design: BOTH experiments live in one process, iterations
