@@ -566,6 +566,90 @@ def _hierarchy_bench(smoke: bool) -> list:
     return out
 
 
+def _serve_bench(smoke: bool) -> list:
+    """Serving read-path axis (ISSUE 14): requests/s + latency quantiles
+    across micro-batch buckets over the canonical SEA-4 pool geometry.
+
+    One row per max bucket size. bucket=1 is the unbatched per-request
+    path (every dispatch answers one request); larger buckets coalesce the
+    same closed-loop traffic through the one routed forward program. The
+    SERVE artifact the `regress` gate checks: requests/s floor and p99
+    ceiling per bucket, batched >= 3x unbatched, and ZERO steady-state
+    recompiles under mixed-cluster traffic (the bucket ladder is compiled
+    at warmup; the P2P traffic mix must never mint a new signature)."""
+    import numpy as np
+    import jax.numpy as jnp
+
+    from feddrift_tpu import obs
+    from feddrift_tpu.core.pool import ModelPool
+    from feddrift_tpu.data.registry import make_dataset
+    from feddrift_tpu.models import create_model
+    from feddrift_tpu.platform.serving import (SERVE_BUCKETS,
+                                               InferenceEngine,
+                                               RoutingTable,
+                                               TrafficGenerator)
+
+    cfg = _canonical_cfg(True, train_iterations=1, comm_round=1)
+    ds = make_dataset(cfg)
+    module = create_model(cfg.model, ds, cfg)
+    sample = jnp.asarray(ds.x[0, 0, :2])
+    # identical=False: every cluster model answers differently, so routing
+    # mistakes would be visible, not silently masked by identical params
+    pool = ModelPool.create(module, sample, cfg.num_models,
+                            seed=cfg.seed + 42, identical=False)
+    population = 64
+    rng = np.random.RandomState(14)
+    routing = RoutingTable.from_assignment(
+        rng.randint(0, cfg.num_models, size=population))
+    requests = 600 if smoke else 3000
+    concurrency = 32
+
+    def _serve_recompiles() -> int:
+        snap = obs.registry().snapshot()
+        return sum(int(v) for k, v in snap.items()
+                   if k.startswith('jit_recompiles{fn="serve_forward'))
+
+    out = []
+    base_rps = None
+    for max_bucket in (1, 4, 8, 16, 32):
+        buckets = tuple(b for b in SERVE_BUCKETS if b <= max_bucket)
+        eng = InferenceEngine(pool, routing, buckets=buckets).start()
+        try:
+            eng.warmup()
+            tg = TrafficGenerator(eng, clients=range(population), seed=14,
+                                  concurrency=concurrency)
+            tg.run(max(requests // 10, 50))    # closed-loop warm (threads,
+            rec0 = _serve_recompiles()         # queues, branch caches)
+            stats = tg.run(requests)
+            recompiles = _serve_recompiles() - rec0
+        finally:
+            eng.close()
+        row = {
+            "bucket": max_bucket,
+            "mode": "unbatched" if max_bucket == 1 else "batched",
+            "requests": stats["requests"],
+            "completed": stats["completed"],
+            "errors": stats["errors"],
+            "concurrency": concurrency,
+            "requests_per_s": stats["requests_per_s"],
+            "p50_ms": stats.get("p50_ms"),
+            "p95_ms": stats.get("p95_ms"),
+            "p99_ms": stats.get("p99_ms"),
+            "steady_recompiles": int(recompiles),
+        }
+        if max_bucket == 1:
+            base_rps = stats["requests_per_s"]
+            row["speedup_vs_unbatched"] = 1.0
+        else:
+            row["speedup_vs_unbatched"] = (
+                round(stats["requests_per_s"] / base_rps, 2)
+                if base_rps else None)
+        out.append(row)
+        print(json.dumps({"partial": f"serve@{max_bucket}", **row}),
+              file=sys.stderr)
+    return out
+
+
 def _megastep_cfg(smoke: bool, K: int):
     """Megastep K-sweep config: the canonical SEA geometry under the
     drift-OBLIVIOUS single model, which certifies an unbounded
@@ -900,6 +984,12 @@ def main() -> None:
         # overhead strictly below K=1)
         "megastep": (_megastep_bench(backend, smoke)
                      if "--megastep" in sys.argv else None),
+        # serving read-path axis (opt-in: closed-loop inference over the
+        # model pool across micro-batch buckets); committed as
+        # SERVE_r1*.json and gated by `regress` (requests/s floor, p99
+        # ceiling, batched >= 3x unbatched, zero steady recompiles)
+        "serve": (_serve_bench(smoke)
+                  if "--serve" in sys.argv else None),
     }
     print(json.dumps(out))
     if conv is not None and "error" in conv:

@@ -182,6 +182,42 @@ def main(argv: list[str] | None = None) -> int:
     fl_p.add_argument("--min-lanes", type=int, default=0)
     fl_p.add_argument("--json", action="store_true")
 
+    srv_p = sub.add_parser(
+        "serve",
+        help="cluster-routed inference over a finished run: load the "
+             "checkpointed model pool + client registry, warm the "
+             "micro-batching engine, drive seeded closed-loop traffic, "
+             "print throughput/latency stats JSON "
+             "(platform/serving.py; docs/SERVING.md)")
+    srv_p.add_argument("run_dir", help="run directory holding ckpt/")
+    srv_p.add_argument("--requests", type=int, default=500,
+                       help="closed-loop requests to drive (default "
+                            "%(default)s)")
+    srv_p.add_argument("--concurrency", type=int, default=8,
+                       help="closed-loop worker threads (default "
+                            "%(default)s)")
+    srv_p.add_argument("--seed", type=int, default=0,
+                       help="traffic-generator seed (default %(default)s)")
+    srv_p.add_argument("--buckets", type=str, default="1,2,4,8,16,32",
+                       help="comma-separated admission batch buckets; each "
+                            "is compiled once in warm-up (default "
+                            "%(default)s)")
+    srv_p.add_argument("--max_wait_ms", type=float, default=2.0,
+                       help="admission-queue coalescing window (default "
+                            "%(default)s ms)")
+    srv_p.add_argument("--broker", type=str, default=None,
+                       help="host:port of a live broker — subscribe the "
+                            "cluster-event topic for hot-swaps under "
+                            "drift, with auto-reconnect")
+    srv_p.add_argument("--topic", type=str, default=None,
+                       help="broker topic carrying cluster events "
+                            "(default: serve/cluster)")
+    srv_p.add_argument("--ops_port", type=int, default=None,
+                       help="also expose /metrics + /healthz on this port "
+                            "(0 = ephemeral)")
+    srv_p.add_argument("--platform", type=str, default="",
+                       help="force a JAX platform (e.g. 'cpu')")
+
     li_p = sub.add_parser(
         "lint",
         help="graftlint: static-analysis pass over the package "
@@ -199,7 +235,7 @@ def main(argv: list[str] | None = None) -> int:
     # --log_level is also accepted after the subcommand for convenience
     # (SUPPRESS default: an absent post-subcommand flag must not clobber a
     # pre-subcommand one — both write the same namespace attribute)
-    for p in (run_p, res_p, rep_p, reg_p, lin_p, cp_p, fl_p, li_p):
+    for p in (run_p, res_p, rep_p, reg_p, lin_p, cp_p, fl_p, srv_p, li_p):
         p.add_argument("--log_level", type=str, default=argparse.SUPPRESS,
                        help=argparse.SUPPRESS)
 
@@ -265,6 +301,45 @@ def main(argv: list[str] | None = None) -> int:
     from feddrift_tpu.utils.cache import enable_compile_cache
     enable_compile_cache()
     _maybe_init_multihost(args)
+
+    if args.cmd == "serve":
+        from feddrift_tpu.platform import serving
+        buckets = tuple(int(b) for b in args.buckets.split(",") if b.strip())
+        engine = serving.load_engine(args.run_dir, buckets=buckets,
+                                     max_wait_s=args.max_wait_ms / 1e3)
+        ops = None
+        if args.ops_port is not None:
+            from feddrift_tpu.obs import live
+            ops = live.OpsServer(port=args.ops_port).start()
+        broker = None
+        if args.broker:
+            host, _, port = args.broker.rpartition(":")
+            from feddrift_tpu.comm.netbroker import NetworkBrokerClient
+            from feddrift_tpu.resilience import (ReconnectingBrokerClient,
+                                                 RetryPolicy)
+            broker = ReconnectingBrokerClient(
+                lambda: NetworkBrokerClient(host or "127.0.0.1", int(port)),
+                retry=RetryPolicy(base_delay=0.05, max_delay=0.25,
+                                  max_attempts=400, deadline_s=120.0),
+                heartbeat_interval=0.1, heartbeat_timeout=0.4,
+                client_id="serve-cli")
+            engine.attach_broker(
+                broker, topic=args.topic or serving.CLUSTER_TOPIC)
+        engine.start()
+        engine.warmup()
+        try:
+            gen = serving.TrafficGenerator(
+                engine, list(range(engine.population)), seed=args.seed,
+                concurrency=args.concurrency)
+            stats = gen.run(args.requests)
+            print(json.dumps({**stats, **engine.stats()}, indent=2))
+        finally:
+            engine.close()
+            if broker is not None:
+                broker.close()
+            if ops is not None:
+                ops.close()
+        return 0
 
     if args.cmd == "list":
         from feddrift_tpu.algorithms import available_algorithms

@@ -862,3 +862,63 @@ class TrainStep:
                 return confusion_matrix(self.apply_fn(p_m, xin), yc, K)
             return jax.vmap(per_client)(x, y)
         return jax.vmap(one)(params, feat_mask)
+
+
+# ----------------------------------------------------------------------
+@dataclass(eq=False)
+class ForwardStep:
+    """Forward-only serving program over the [M, ...] model pool.
+
+    The read-path counterpart of TrainStep: ONE compiled program answers a
+    whole micro-batch of inference requests that may target DIFFERENT
+    cluster models. Inputs are a padded request batch ``x [B, ...]`` plus a
+    per-row model index ``model_idx [B]``; the program gathers each row's
+    param slice out of the pool and vmaps the module apply, so a
+    mixed-cluster batch costs one dispatch instead of B.
+
+    Shares TrainStep's compile-count detector: B is expected to come from a
+    small static bucket set (platform/serving.py), so after warm-up every
+    steady-state dispatch hits an already-seen signature —
+    ``jit_recompiles{fn=serve_forward}`` staying at 0 is the SERVE bench /
+    regress gate.
+    """
+
+    apply_fn: Callable          # (params, x) -> logits
+    # Optional 2-D (models, clients) mesh: the pool's [M] axis is annotated
+    # with constrain_pool so GSPMD keeps the PR 10 layout; None / 1-device
+    # meshes leave the program untouched (no committed-sharding recompile).
+    mesh: object = field(default=None, repr=False)
+    cost_capture: str = "lowered"
+    _signatures: dict = field(default_factory=dict, repr=False)
+
+    # the detector + cost harvest are TrainStep's, verbatim: one
+    # implementation, one event vocabulary (jit_compile/jit_recompile)
+    _note_signature = TrainStep._note_signature
+    _capture_cost = TrainStep._capture_cost
+
+    def forward(self, params, x, model_idx):
+        """Tracked dispatch: logits [B, K] for x [B, ...] routed by
+        model_idx [B] into params [M, ...].
+
+        Each bucket size is tracked as its OWN program
+        (``serve_forward_b<B>``): warming N buckets is N jit_compiles and
+        zero jit_recompiles, so any nonzero ``jit_recompiles{fn=
+        serve_forward_b*}`` is a genuine steady-state anomaly (a new
+        dtype/sharding/committed-ness), not bucket-ladder noise.
+        """
+        fn = f"serve_forward_b{x.shape[0]}"
+        kind = self._note_signature(fn, params, x, model_idx)
+        self._capture_cost(kind, fn, type(self)._forward_jit,
+                           (params, x, model_idx))
+        return self._forward_jit(params, x, model_idx)
+
+    @partial(jax.jit, static_argnums=0)
+    def _forward_jit(self, params, x, model_idx):
+        params = constrain_pool(self.mesh, params, model_axis=0)
+        rows = jax.tree_util.tree_map(lambda p: p[model_idx], params)
+
+        def one(p_r, x_r):
+            # [1, ...] -> [1, K]: same batched apply the eval programs use,
+            # so a B=1 bucket is bitwise-identical to a direct pool.apply
+            return self.apply_fn(p_r, x_r[None])[0]
+        return jax.vmap(one)(rows, x)

@@ -108,6 +108,9 @@ EVENT_KINDS = frozenset({
     # live ops plane (obs/live.py)
     "ops_snapshot",         # periodic per-process metric+health snapshot
     "slo_burn",             # SLO error-budget burn-rate rule fired
+    # serving read path (platform/serving.py)
+    "request_served",       # one inference request answered (routing + latency)
+    "pool_swapped",         # engine published a new pool/routing generation
 })
 
 RING_SIZE = 4096

@@ -303,6 +303,65 @@ def compare(candidate: dict, baseline: dict,
                                 note="compression floor vs uncompressed"))
     elif isinstance(bh, list):
         skip("hierarchy", "candidate lacks the hierarchy axis")
+
+    # serving read-path axis (bench.py --serve; SERVE artifacts): one row
+    # per (mode, max-bucket) point from the closed-loop traffic generator.
+    # requests/s under the throughput tolerance, request p99 under the
+    # tail-latency tolerance, steady-state recompiles as an ABSOLUTE zero
+    # gate (buckets are compiled in warm-up; mixed-cluster traffic must
+    # never mint a new XLA program), plus an ABSOLUTE >= 3x floor on the
+    # best batched speedup-vs-unbatched — micro-batching that stops paying
+    # for itself is a regression even if the baseline also regressed.
+    # Rows are keyed serve[{mode}:b{bucket}] so an unbatched bucket=1 row
+    # and a batched row never collide across variants.
+    csv_, bsv = candidate.get("serve"), baseline.get("serve")
+    if isinstance(csv_, list) and isinstance(bsv, list):
+        def _mb(e):
+            return (e.get("mode") or "batched", e.get("bucket"))
+
+        by_mb = {_mb(e): e for e in bsv if isinstance(e, dict)}
+        best_speedup = None
+        for e in csv_:
+            if not isinstance(e, dict):
+                continue
+            mode, bucket = _mb(e)
+            name = f"serve[{mode}:b{bucket}]"
+            sp = e.get("speedup_vs_unbatched")
+            if mode == "batched" and sp is not None:
+                best_speedup = sp if best_speedup is None \
+                    else max(best_speedup, sp)
+            be = by_mb.get((mode, bucket))
+            if be is None:
+                skip(name, "mode/bucket point missing in baseline")
+                continue
+            bv, cv = be.get("requests_per_s"), e.get("requests_per_s")
+            if bv and cv:
+                floor = bv * (1.0 - tol["rounds"])
+                rows.append(row(f"{name}.requests_per_s", bv, cv,
+                                f">= {floor:.1f}", cv < floor))
+            bp, cp = be.get("p99_ms"), e.get("p99_ms")
+            if bp and cp:
+                ceil = bp * (1.0 + tol["p99"])
+                rows.append(row(f"{name}.p99_ms", bp, cp,
+                                f"<= {ceil:.3f}", cp > ceil))
+            rec = e.get("steady_recompiles")
+            if rec is not None:
+                rows.append(row(f"{name}.steady_recompiles",
+                                be.get("steady_recompiles"), rec, "== 0",
+                                rec > 0,
+                                note="program invariance under "
+                                     "mixed-cluster traffic"))
+        if best_speedup is not None:
+            bbest = [e.get("speedup_vs_unbatched") for e in bsv
+                     if isinstance(e, dict)
+                     and e.get("speedup_vs_unbatched") is not None]
+            rows.append(row("serve.best_speedup_vs_unbatched",
+                            max(bbest) if bbest else None, best_speedup,
+                            ">= 3", best_speedup < 3.0,
+                            note="absolute micro-batching floor vs "
+                                 "this run's own unbatched row"))
+    elif isinstance(bsv, list):
+        skip("serve", "candidate lacks the serve axis")
     return rows
 
 

@@ -126,3 +126,25 @@ def constrain_pool(mesh: Mesh | None, tree, model_axis: int = 0,
         return jax.lax.with_sharding_constraint(
             leaf, NamedSharding(mesh, spec))
     return jax.tree_util.tree_map(one, tree)
+
+
+def place_pool(mesh: Mesh | None, tree, model_axis: int = 0):
+    """Host-side committed placement of a model-pool stack.
+
+    ``constrain_pool`` is the traceable in-program annotation; this is its
+    ``device_put`` counterpart for pool snapshots built OUTSIDE jit — the
+    serving engine places every hot-swapped generation with it before
+    publishing, so readers never trigger a lazy transfer mid-request. Same
+    degradation rule: ``mesh=None`` or a mesh where no named axis actually
+    splits returns the tree unchanged (committing to a 1-device
+    NamedSharding would flip the ``committed`` bit and retrace the serve
+    program against its warm-up signature).
+    """
+    if mesh is None or not any(_axis_size(mesh, n) > 1
+                               for n in ("models", "clients")):
+        return tree
+
+    def one(leaf):
+        spec = pool_spec(mesh, np.shape(leaf), model_axis)
+        return jax.device_put(leaf, NamedSharding(mesh, spec))
+    return jax.tree_util.tree_map(one, tree)

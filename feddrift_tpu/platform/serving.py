@@ -1,30 +1,93 @@
-"""Model-serving executor: HTTP control plane for external (mobile/edge)
-clients.
+"""Cluster-routed online inference over the model pool.
 
-Interface-level re-design of the reference's mobile backend
-(fedml_mobile/server/executor/app.py — a Flask app that registers devices,
-hands out the current global model, and accepts trained uploads). Flask is
-not assumed; the stdlib http.server is enough for the executor's tiny JSON
-API, and the aggregation path reuses the same weighted-average semantics as
-the in-process framework.
+Two serving surfaces live here:
 
-Endpoints (all JSON):
-  POST /api/register           -> {"device_id": int}
-  GET  /api/get_model          -> {"round": int, "params": {leaf: list}}
-  POST /api/upload_model       body {"device_id", "num_samples",
-                                     "params": {leaf: list}}
-       -> {"accepted": true, "round": int}; when all registered devices
-       have uploaded, the server aggregates and advances the round.
+1. The legacy round-lockstep executor (``ServingState``/``ServingExecutor``)
+   — an interface-level re-design of the reference's mobile backend
+   (fedml_mobile/server/executor/app.py: register devices, hand out the
+   current global model, accept trained uploads, aggregate when everyone
+   reported). Kept verbatim at the API level; its two serial bottlenecks
+   (full-param re-encode per GET, aggregation under the request lock) are
+   fixed below.
+
+2. The read path (``InferenceEngine`` + friends) — ROADMAP item 2's
+   "millions of users" side. A trained run's artifacts (checkpoint +
+   ``ClientRegistry``) already materialize the E-step of the EM view of
+   clustered FL (arXiv:2111.10192): every client's cluster assignment.
+   Serving is therefore a ROUTED read over the ``[M, ...]`` model pool:
+
+   - each request carries a client id; the routing table maps it to its
+     cluster model;
+   - concurrent requests for DIFFERENT models are coalesced by a
+     micro-batching admission queue into ONE compiled forward program
+     (core/step.py::ForwardStep): requests are gathered into a padded
+     ``[B, ...]`` batch plus a per-row model-index vector, and the pool is
+     gathered per row inside the program — one dispatch per micro-batch
+     instead of one per request;
+   - B is drawn from a small static bucket set, so after ``warmup()``
+     steady-state traffic never recompiles (the PR 1 signature detector
+     gates this: ``jit_recompiles{fn=serve_forward}`` must stay 0);
+   - the pool is placed on the PR 10 2-D ``(models, clients)`` mesh via
+     ``place_pool``/``constrain_pool`` when one is given.
+
+   Models hot-swap under live drift: generations are double-buffered —
+   a swap builds the complete next ``(params, routing)`` snapshot, blocks
+   until it is materialized on device, then publishes it with one atomic
+   reference assignment. A dispatcher reads the generation reference ONCE
+   per micro-batch, so no request ever observes torn params or a
+   routing/params version skew. ``attach_broker`` subscribes to the NDJSON
+   broker's cluster topic and folds a running trainer's ``cluster_assign``
+   / ``cluster_merge`` / ``cluster_split`` events into swaps, re-homing
+   clients onto the surviving lineage (merge: merged -> base; split:
+   moved clients -> child slot seeded from the parent's params).
+
+Instrumentation: per-request trace contexts (obs/spans.py) land in
+``trace.json``, latencies feed the ``request_latency_seconds_q`` P² sketch
+exported on the ops plane ``/metrics``, and the bus gains two kinds —
+``request_served`` per answered request and ``pool_swapped`` per published
+generation. ``bench.py --serve`` drives the seeded closed-loop
+``TrafficGenerator`` across buckets and commits SERVE_r*.json artifacts the
+regress SERVE axis gates.
 """
 
 from __future__ import annotations
 
 import json
+import logging
+import queue as queue_mod
 import threading
+import time
+from collections import deque
+from dataclasses import dataclass
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 import numpy as np
 
+log = logging.getLogger("feddrift_tpu")
+
+# broker topic the trainer-side relay publishes cluster-structure events
+# on and serving engines subscribe to
+CLUSTER_TOPIC = "serve/cluster"
+
+# default admission-queue bucket set: padded micro-batch sizes the forward
+# program is compiled for during warmup (power-of-two ladder keeps padding
+# waste <= 2x while covering single-request lulls and deep backlogs)
+SERVE_BUCKETS = (1, 2, 4, 8, 16, 32)
+
+
+class UnknownClientError(ValueError):
+    """The request's client id is outside the registry population or has
+    no (surviving) cluster assignment to route to."""
+
+
+class MalformedRequestError(ValueError):
+    """The request body cannot be turned into one example of the model's
+    input geometry."""
+
+
+# ======================================================================
+# Legacy round-lockstep executor (reference mobile backend)
+# ======================================================================
 
 class ServingState:
     """Round state: registered devices, current params, pending uploads."""
@@ -36,6 +99,11 @@ class ServingState:
         self.round = 0
         self.next_device = 0
         self.uploads: dict[int, tuple[dict[str, np.ndarray], float]] = {}
+        # get_model body cache: the ``.tolist()`` re-encode of the full
+        # param dict is O(model) work that used to run per request UNDER
+        # the lock; params only change on round advance, so encode once
+        # and invalidate on swap.
+        self._encoded: dict[str, list] | None = None
 
     def register(self) -> int:
         with self.lock:
@@ -45,33 +113,47 @@ class ServingState:
 
     def get_model(self):
         with self.lock:
-            return self.round, {k: v.tolist() for k, v in self.params.items()}
+            if self._encoded is None:
+                self._encoded = {k: v.tolist()
+                                 for k, v in self.params.items()}
+            return self.round, self._encoded
 
     def upload(self, device_id: int, num_samples: float,
                params: dict[str, list]) -> int:
+        # decode outside the lock: per-upload array conversion is the
+        # expensive half of admission and needs no shared state
+        arrays = {k: np.asarray(v, np.float32) for k, v in params.items()}
+        weight = float(num_samples)
         with self.lock:
             if not (0 <= device_id < self.next_device):
                 raise ValueError(f"unregistered device_id {device_id}")
-            if set(params) != set(self.params):
+            if set(arrays) != set(self.params):
                 raise ValueError(
-                    f"param keys {sorted(params)} != expected "
+                    f"param keys {sorted(arrays)} != expected "
                     f"{sorted(self.params)}")
-            self.uploads[device_id] = (
-                {k: np.asarray(v, np.float32) for k, v in params.items()},
-                float(num_samples))
-            if len(self.uploads) >= self.next_device and self.next_device > 0:
-                total = sum(n for _, n in self.uploads.values())
-                if total <= 0:
-                    # un-wedge: drop the round's uploads and report the error
-                    self.uploads = {}
-                    raise ValueError("all uploads reported num_samples <= 0; "
-                                     "round discarded")
-                agg = {k: np.zeros_like(v) for k, v in self.params.items()}
-                for p, n in self.uploads.values():
-                    for k in agg:
-                        agg[k] += p[k] * (n / total)
+            self.uploads[device_id] = (arrays, weight)
+            if len(self.uploads) < self.next_device or self.next_device == 0:
+                return self.round
+            # round complete: TAKE the upload set under the lock, so
+            # exactly one thread owns the aggregation ...
+            pending, self.uploads = self.uploads, {}
+            round_taken = self.round
+            total = sum(n for _, n in pending.values())
+            if total <= 0:
+                # un-wedge: drop the round's uploads and report the error
+                raise ValueError("all uploads reported num_samples <= 0; "
+                                 "round discarded")
+        # ... and the weighted average itself runs OUTSIDE the lock:
+        # concurrent get_model/register/upload calls proceed while the
+        # O(devices x model) reduction grinds.
+        agg = {k: np.zeros_like(v) for k, v in self.params.items()}
+        for p, n in pending.values():
+            for k in agg:
+                agg[k] += p[k] * (n / total)
+        with self.lock:
+            if self.round == round_taken:   # lost only to a concurrent reset
                 self.params = agg
-                self.uploads = {}
+                self._encoded = None        # round advanced: body cache stale
                 self.round += 1
             return self.round
 
@@ -112,7 +194,7 @@ def _make_handler(state: ServingState):
                 except KeyError as e:
                     self._json(400, {"error": f"missing field {e}"})
                     return
-                except ValueError as e:
+                except (TypeError, ValueError) as e:
                     self._json(400, {"error": str(e)})
                     return
                 self._json(200, {"accepted": True, "round": rnd})
@@ -148,3 +230,602 @@ class ServingExecutor:
         if self._thread is not None:
             self._thread.join(timeout=10)
             self._thread = None
+
+
+# ======================================================================
+# Cluster-routed read path
+# ======================================================================
+
+class RoutingTable:
+    """Dense client -> model map: the serving-side E-step.
+
+    ``table[c]`` is client c's cluster model, -1 = unroutable (never
+    assigned, or its model was deleted). Built from a trained run's
+    ``ClientRegistry`` — live ``cluster`` column first, falling back to
+    the LAST known ``assign_hist`` entry for members whose live assignment
+    was cleared — or from an explicit per-client assignment vector.
+    """
+
+    def __init__(self, table) -> None:
+        self.table = np.asarray(table, dtype=np.int64).copy()
+        if self.table.ndim != 1:
+            raise ValueError(f"routing table must be 1-D, "
+                             f"got shape {self.table.shape}")
+
+    @classmethod
+    def from_registry(cls, reg) -> "RoutingTable":
+        table = np.asarray(reg.cluster, dtype=np.int64).copy()
+        unknown = table < 0
+        if unknown.any():
+            hist = np.asarray(reg.assign_hist)
+            known = hist >= 0
+            has_any = known.any(axis=1)
+            # index of the last non-negative entry per row
+            last = hist.shape[1] - 1 - np.argmax(known[:, ::-1], axis=1)
+            fallback = np.where(
+                has_any, hist[np.arange(hist.shape[0]), last], -1)
+            table[unknown] = fallback[unknown]
+        return cls(table)
+
+    @classmethod
+    def from_assignment(cls, assignment) -> "RoutingTable":
+        return cls(assignment)
+
+    @property
+    def population(self) -> int:
+        return int(self.table.shape[0])
+
+    def route(self, client: int) -> int:
+        c = int(client)
+        if not 0 <= c < self.table.shape[0]:
+            raise UnknownClientError(
+                f"client {c} outside population [0, {self.table.shape[0]})")
+        m = int(self.table[c])
+        if m < 0:
+            raise UnknownClientError(f"client {c} has no cluster assignment")
+        return m
+
+    def copy(self) -> "RoutingTable":
+        return RoutingTable(self.table)
+
+
+class _Generation:
+    """One immutable published snapshot: params + routing share a version,
+    so a reader holding the reference can never observe a skew."""
+
+    __slots__ = ("version", "params", "routing", "num_models")
+
+    def __init__(self, version: int, params, routing: RoutingTable,
+                 num_models: int) -> None:
+        self.version = version
+        self.params = params
+        self.routing = routing
+        self.num_models = num_models
+
+
+@dataclass
+class ServeResult:
+    """One answered request."""
+    logits: np.ndarray
+    model: int
+    version: int
+
+
+class _Request:
+    __slots__ = ("client", "x", "ctx", "t0", "ts", "done", "result", "error")
+
+    def __init__(self, client: int, x: np.ndarray, ctx: dict) -> None:
+        self.client = client
+        self.x = x
+        self.ctx = ctx
+        self.t0 = time.perf_counter()
+        self.ts = time.time()
+        self.done = threading.Event()
+        self.result: ServeResult | None = None
+        self.error: Exception | None = None
+
+
+class InferenceEngine:
+    """Micro-batching cluster-routed inference over a ``ModelPool``.
+
+    ``submit()`` is thread-safe and blocking (closed-loop callers);
+    requests are coalesced by the dispatcher thread into padded bucket
+    batches through ONE compiled forward program. ``swap()`` /
+    ``apply_cluster_event()`` publish new generations without stalling
+    readers; ``attach_broker`` feeds the latter from a live training job.
+    """
+
+    def __init__(self, pool, routing: RoutingTable, mesh=None,
+                 buckets=SERVE_BUCKETS, max_wait_s: float = 0.002,
+                 cost_capture: str = "off") -> None:
+        from feddrift_tpu.core.step import ForwardStep
+        from feddrift_tpu.parallel.mesh import place_pool
+
+        self.pool = pool
+        self.mesh = mesh
+        self.buckets = tuple(sorted({int(b) for b in buckets}))
+        if not self.buckets or self.buckets[0] < 1:
+            raise ValueError(f"buckets must be positive ints, got {buckets}")
+        self.max_wait_s = float(max_wait_s)
+        self.step = ForwardStep(apply_fn=pool.apply, mesh=mesh,
+                                cost_capture=cost_capture)
+        # pool.example_input is a sample BATCH (runner feeds ds.x[0,0,:2]);
+        # one request carries ONE example: its trailing (per-row) geometry
+        example = np.asarray(pool.example_input)
+        if example.ndim < 1:
+            raise ValueError("pool.example_input must be a sample batch")
+        self._example_shape = example.shape[1:]
+        self._example_dtype = example.dtype
+        self._gen = _Generation(1, place_pool(mesh, pool.params),
+                                routing, pool.num_models)
+        self._queue: deque[_Request] = deque()
+        self._cond = threading.Condition()
+        self._stop = False
+        self._thread: threading.Thread | None = None
+        self._sub_thread: threading.Thread | None = None
+        self._swap_lock = threading.Lock()
+
+        from feddrift_tpu import obs
+        reg = obs.registry()
+        self._lat = reg.quantile_sketch("request_latency_seconds_q")
+        self._served = reg.counter("requests_served")
+        self._batches = reg.counter("serve_batches")
+        reg.gauge("pool_version").set(self._gen.version)
+
+    # -- lifecycle ------------------------------------------------------
+    def start(self) -> "InferenceEngine":
+        if self._thread is None:
+            self._stop = False
+            self._thread = threading.Thread(target=self._dispatch_loop,
+                                            daemon=True,
+                                            name="serve-dispatch")
+            self._thread.start()
+        return self
+
+    def close(self) -> None:
+        with self._cond:
+            self._stop = True
+            self._cond.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+            self._thread = None
+        if self._sub_thread is not None:
+            self._sub_thread.join(timeout=2)
+            self._sub_thread = None
+        # fail whatever the dispatcher left behind
+        while self._queue:
+            r = self._queue.popleft()
+            r.error = RuntimeError("engine closed")
+            r.done.set()
+
+    def warmup(self) -> None:
+        """Compile the forward program for EVERY bucket up front, so the
+        steady-state dispatcher only ever replays known signatures."""
+        import jax
+        import jax.numpy as jnp
+        gen = self._gen
+        for b in self.buckets:
+            x = jnp.zeros((b,) + self._example_shape,
+                          dtype=self._example_dtype)
+            midx = jnp.zeros((b,), dtype=jnp.int32)
+            jax.block_until_ready(self.step.forward(gen.params, x, midx))
+
+    @property
+    def version(self) -> int:
+        return self._gen.version
+
+    @property
+    def population(self) -> int:
+        """Routable client population of the CURRENT generation."""
+        return self._gen.routing.population
+
+    # -- read path ------------------------------------------------------
+    def submit(self, client_id, x, timeout: float = 30.0,
+               trace: dict | None = None) -> ServeResult:
+        """Route + answer one request; blocks until its micro-batch lands.
+
+        Raises ``MalformedRequestError`` on bad inputs,
+        ``UnknownClientError`` on unroutable clients, ``TimeoutError``
+        past ``timeout``.
+        """
+        if self._thread is None:
+            raise RuntimeError("engine not started (call start())")
+        try:
+            client = int(client_id)
+        except (TypeError, ValueError) as e:
+            raise MalformedRequestError(
+                f"client id {client_id!r} is not an integer") from e
+        try:
+            xa = np.asarray(x, dtype=self._example_dtype)
+        except (TypeError, ValueError) as e:
+            raise MalformedRequestError(
+                f"request body is not a {self._example_dtype} array: {e}") \
+                from e
+        if xa.shape != self._example_shape:
+            raise MalformedRequestError(
+                f"example shape {xa.shape} != model input "
+                f"{self._example_shape}")
+        # fast-fail against the current generation; the dispatcher
+        # re-routes against ITS generation, so a concurrent swap between
+        # here and dispatch still yields a consistent answer
+        self._gen.routing.route(client)
+
+        from feddrift_tpu.obs import spans
+        ctx = spans.child_of(trace) if trace else spans.new_trace()
+        req = _Request(client, xa, ctx)
+        with self._cond:
+            self._queue.append(req)
+            self._cond.notify()
+        if not req.done.wait(timeout):
+            raise TimeoutError(f"request for client {client} timed out "
+                               f"after {timeout}s")
+        if req.error is not None:
+            raise req.error
+        return req.result
+
+    def _bucket_for(self, n: int) -> int:
+        for b in self.buckets:
+            if b >= n:
+                return b
+        return self.buckets[-1]
+
+    # The admission/dispatch loop is THE serving hot path: one iteration
+    # per micro-batch at steady state. graftlint R2 patrols it for host
+    # syncs — the single result fetch is the one deliberate exception.
+    # lint: hot-path-begin (serve dispatch loop)
+    def _dispatch_loop(self) -> None:
+        max_b = self.buckets[-1]
+        while True:
+            with self._cond:
+                while not self._queue and not self._stop:
+                    self._cond.wait(0.25)
+                if self._stop and not self._queue:
+                    return
+                batch = [self._queue.popleft()]
+                # micro-batch window: admit until the largest bucket is
+                # full or max_wait_s has passed since the first admit
+                deadline = time.perf_counter() + self.max_wait_s
+                while len(batch) < max_b:
+                    if self._queue:
+                        batch.append(self._queue.popleft())
+                        continue
+                    remaining = deadline - time.perf_counter()
+                    if remaining <= 0 or self._stop:
+                        break
+                    self._cond.wait(remaining)
+            self._serve_batch(batch)
+
+    def _serve_batch(self, batch: list[_Request]) -> None:
+        import jax.numpy as jnp
+        from feddrift_tpu import obs
+        from feddrift_tpu.obs import spans
+
+        gen = self._gen      # ONE reference read: params+routing coherent
+        live: list[_Request] = []
+        routes: list[int] = []
+        for r in batch:
+            try:
+                routes.append(gen.routing.route(r.client))
+                live.append(r)
+            except UnknownClientError as e:
+                # re-homed away between admission and dispatch
+                r.error = e
+                r.done.set()
+        if not live:
+            return
+        b = self._bucket_for(len(live))
+        xb = np.zeros((b,) + self._example_shape,
+                      dtype=self._example_dtype)
+        for i, r in enumerate(live):
+            xb[i] = r.x
+        mb = np.zeros((b,), dtype=np.int32)
+        mb[:len(live)] = routes
+        logits = self.step.forward(gen.params, jnp.asarray(xb),
+                                   jnp.asarray(mb))
+        # lint: r2-ok (one deliberate D2H fetch per micro-batch — results must reach the callers; amortized over up to bucket-size requests)
+        out = np.asarray(logits)
+        done = time.perf_counter()
+        self._batches.inc()
+        self._served.inc(len(live))
+        for i, r in enumerate(live):
+            lat = done - r.t0
+            r.result = ServeResult(logits=out[i], model=int(mb[i]),
+                                   version=gen.version)
+            self._lat.observe(lat)
+            spans.record("serve_request", r.ts, lat, cat="serve",
+                         client=r.client, model=int(mb[i]), batch=b,
+                         version=gen.version, **r.ctx)
+            obs.emit("request_served", client=r.client, model=int(mb[i]),
+                     version=gen.version, batch=b,
+                     latency_ms=round(lat * 1e3, 3))
+            r.done.set()
+    # lint: hot-path-end
+
+    # -- hot swap -------------------------------------------------------
+    def swap(self, params=None, routing: RoutingTable | None = None,
+             reason: str = "manual", **evidence) -> int:
+        """Publish the next generation (double-buffered).
+
+        The snapshot is built COMPLETELY — new params converted, placed on
+        the mesh and materialized on device — before the single atomic
+        reference assignment makes it visible, so a dispatcher that
+        grabbed the old generation keeps a fully consistent view and the
+        next micro-batch gets a fully consistent new one.
+        """
+        import jax
+        import jax.numpy as jnp
+        from feddrift_tpu.parallel.mesh import place_pool
+        from feddrift_tpu import obs
+
+        with self._swap_lock:
+            cur = self._gen
+            new_params = cur.params
+            if params is not None:
+                new_params = place_pool(
+                    self.mesh,
+                    jax.tree_util.tree_map(jnp.asarray, params))
+                jax.block_until_ready(new_params)
+            new_routing = routing if routing is not None else cur.routing
+            gen = _Generation(cur.version + 1, new_params, new_routing,
+                              cur.num_models)
+            self._gen = gen
+        obs.registry().gauge("pool_version").set(gen.version)
+        obs.registry().counter("pool_swaps").inc()
+        obs.emit("pool_swapped", version=gen.version, reason=reason,
+                 models=gen.num_models, **evidence)
+        return gen.version
+
+    def apply_cluster_event(self, rec: dict) -> int | None:
+        """Fold one trainer cluster-structure event into a swap; returns
+        the new version, or None for irrelevant/ignored kinds."""
+        kind = rec.get("kind")
+        if kind == "cluster_assign":
+            # dense per-slot assignment; population mode carries the slot
+            # -> member mapping in ``members``
+            assignment = rec.get("assignment") or []
+            members = rec.get("members")
+            if members is None:
+                members = list(range(len(assignment)))
+            rt = self._gen.routing.copy()
+            for slot, m in zip(members, assignment):
+                c, m = int(slot), int(m)
+                if 0 <= c < rt.population and m >= 0:
+                    rt.table[c] = m
+            return self.swap(routing=rt, reason="cluster_assign")
+        if kind == "cluster_merge":
+            base, merged = int(rec["base"]), int(rec["merged"])
+            rt = self._gen.routing.copy()
+            rt.table[rt.table == merged] = base
+            # surviving lineage: the trainer folded merged's params into
+            # base and reinitialized the merged slot, so re-homed clients
+            # must read base — the routing rewrite IS the param swap
+            return self.swap(routing=rt, reason="cluster_merge",
+                             base=base, merged=merged)
+        if kind == "cluster_split":
+            model, new_model = int(rec["model"]), int(rec["new_model"])
+            moved = [int(c) for c in rec.get("clients_moved", [])]
+            rt = self._gen.routing.copy()
+            if moved:
+                in_range = [c for c in moved if 0 <= c < rt.population]
+                rt.table[np.asarray(in_range, dtype=np.int64)] = new_model
+            # child slot starts from the parent's params (nearest
+            # surviving lineage) until the trainer pushes refined ones
+            params = _copy_pool_slot(self._gen.params, new_model, model)
+            return self.swap(params=params, routing=rt,
+                             reason="cluster_split",
+                             model=model, new_model=new_model)
+        if kind == "cluster_delete":
+            m = int(rec["model"])
+            rt = self._gen.routing.copy()
+            rt.table[rt.table == m] = -1
+            return self.swap(routing=rt, reason="cluster_delete", model=m)
+        if kind == "cluster_create":
+            model = int(rec["model"])
+            rt = self._gen.routing.copy()
+            client = rec.get("client")
+            if client is not None and 0 <= int(client) < rt.population:
+                rt.table[int(client)] = model
+            init_from = rec.get("init_from")
+            params = None
+            if init_from is not None and int(init_from) >= 0:
+                params = _copy_pool_slot(self._gen.params, model,
+                                         int(init_from))
+            return self.swap(params=params, routing=rt,
+                             reason="cluster_create", model=model)
+        return None
+
+    def attach_broker(self, client, topic: str = CLUSTER_TOPIC) -> None:
+        """Consume cluster events from a broker subscription in the
+        background. Pair with ``resilience.ReconnectingBrokerClient`` so a
+        broker outage degrades (healthz reports it) instead of killing the
+        swap feed, and the replayed subscription resumes swaps on
+        reconnect."""
+        q = client.subscribe(topic)
+        self._sub_thread = threading.Thread(
+            target=self._consume_events, args=(q,), daemon=True,
+            name="serve-swap")
+        self._sub_thread.start()
+
+    def _consume_events(self, q: "queue_mod.Queue") -> None:
+        while not self._stop:
+            try:
+                payload = q.get(timeout=0.25)
+            except queue_mod.Empty:
+                continue
+            try:
+                rec = json.loads(payload) \
+                    if isinstance(payload, (str, bytes)) else payload
+                if isinstance(rec, dict):
+                    self.apply_cluster_event(rec)
+            except Exception:   # noqa: BLE001 — one bad event != outage
+                log.warning("serving: dropped malformed cluster event",
+                            exc_info=True)
+
+    # -- diagnostics ----------------------------------------------------
+    def stats(self) -> dict:
+        snap = self._lat.snapshot()
+        return {"served": int(self._served.value),
+                "batches": int(self._batches.value),
+                "version": self._gen.version,
+                "latency": snap}
+
+
+def _copy_pool_slot(params, dst: int, src: int):
+    """New pool pytree with slot ``dst`` := slot ``src`` (host-side;
+    the swap path re-places the result on the mesh)."""
+    import jax
+    import jax.numpy as jnp
+
+    def one(p):
+        p = jnp.asarray(p)
+        return p.at[dst].set(p[src])
+    return jax.tree_util.tree_map(one, params)
+
+
+class ClusterEventRelay:
+    """Training-side bus tap republishing cluster-structure events onto a
+    broker topic, bridging a live trainer to serving engines (the runner
+    emits on the in-process bus only). ``attach()`` on the trainer,
+    ``InferenceEngine.attach_broker`` on the server."""
+
+    KINDS = frozenset({"cluster_assign", "cluster_merge", "cluster_split",
+                       "cluster_create", "cluster_delete"})
+
+    def __init__(self, client, topic: str = CLUSTER_TOPIC) -> None:
+        self.client = client
+        self.topic = topic
+        self._bus = None
+
+    def __call__(self, rec: dict) -> None:
+        if rec.get("kind") not in self.KINDS:
+            return
+        from feddrift_tpu.obs.events import _json_default
+        try:
+            self.client.publish(self.topic,
+                                json.dumps(rec, default=_json_default))
+        except Exception:   # noqa: BLE001 — the trainer never blocks on us
+            pass
+
+    def attach(self, bus=None) -> "ClusterEventRelay":
+        from feddrift_tpu import obs
+        self._bus = bus if bus is not None else obs.get_bus()
+        self._bus.add_tap(self)
+        return self
+
+    def detach(self) -> None:
+        if self._bus is not None:
+            self._bus.remove_tap(self)
+            self._bus = None
+
+
+class TrafficGenerator:
+    """Seeded closed-loop load: N workers each submit back-to-back
+    requests for seeded-random clients with seeded-random examples. Pure
+    function of (seed, clients, num_requests), so bench runs and the CI
+    smoke replay identical traffic."""
+
+    def __init__(self, engine: InferenceEngine, clients, seed: int = 0,
+                 concurrency: int = 8, make_x=None) -> None:
+        self.engine = engine
+        self.clients = [int(c) for c in clients]
+        if not self.clients:
+            raise ValueError("need at least one client to generate traffic")
+        self.seed = int(seed)
+        self.concurrency = max(1, int(concurrency))
+        shape = engine._example_shape
+        dtype = engine._example_dtype
+        if make_x is None:
+            def make_x(rng):
+                return rng.standard_normal(shape).astype(dtype, copy=False)
+        self.make_x = make_x
+
+    def run(self, num_requests: int, timeout: float = 30.0) -> dict:
+        """Drive ``num_requests`` total; returns rate + latency stats."""
+        per = [num_requests // self.concurrency] * self.concurrency
+        for i in range(num_requests % self.concurrency):
+            per[i] += 1
+        lats: list[list[float]] = [[] for _ in range(self.concurrency)]
+        errors = [0] * self.concurrency
+
+        def worker(w: int) -> None:
+            rng = np.random.RandomState(
+                (self.seed * 1_000_003 + w * 7_919 + 1) % (2**31 - 1))
+            for _ in range(per[w]):
+                c = self.clients[rng.randint(len(self.clients))]
+                x = self.make_x(rng)
+                t0 = time.perf_counter()
+                try:
+                    self.engine.submit(c, x, timeout=timeout)
+                except Exception:   # noqa: BLE001 — keep the loop closed
+                    errors[w] += 1
+                    continue
+                lats[w].append(time.perf_counter() - t0)
+
+        threads = [threading.Thread(target=worker, args=(w,), daemon=True)
+                   for w in range(self.concurrency)]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        wall = time.perf_counter() - t0
+        flat = np.asarray([v for ws in lats for v in ws], dtype=np.float64)
+        ok = int(flat.size)
+        out = {"requests": int(num_requests), "completed": ok,
+               "errors": int(sum(errors)),
+               "duration_s": round(wall, 4),
+               "requests_per_s": round(ok / wall, 2) if wall > 0 else 0.0,
+               "concurrency": self.concurrency}
+        if ok:
+            for q, name in ((50, "p50_ms"), (95, "p95_ms"), (99, "p99_ms")):
+                out[name] = round(float(np.percentile(flat, q)) * 1e3, 3)
+        return out
+
+
+def load_engine(run_dir: str, mesh=None, buckets=SERVE_BUCKETS,
+                max_wait_s: float = 0.002) -> InferenceEngine:
+    """Reconstruct a servable engine from a finished run directory.
+
+    Reads ``<run_dir>/ckpt`` (MANIFEST carries the full config), rebuilds
+    the dataset geometry + module + pool template, loads the checkpointed
+    pool params, and derives the routing table from the checkpointed
+    ``ClientRegistry`` when one was saved (population mode) or from the
+    algorithm's dense per-slot assignment otherwise.
+    """
+    import os
+
+    from feddrift_tpu.config import ExperimentConfig
+    from feddrift_tpu.core.pool import ModelPool
+    from feddrift_tpu.data.registry import make_dataset
+    from feddrift_tpu.models import create_model
+    from feddrift_tpu.platform.registry import ClientRegistry
+    from feddrift_tpu.utils.checkpoint import load_checkpoint
+
+    ckpt_dir = os.path.join(run_dir, "ckpt")
+    with open(os.path.join(ckpt_dir, "MANIFEST.json")) as f:
+        cfg = ExperimentConfig.from_json(json.dumps(json.load(f)["config"]))
+    ds = make_dataset(cfg)
+    module = create_model(cfg.model, ds, cfg)
+    import jax.numpy as jnp
+    sample = jnp.asarray(ds.x[0, 0, :2])
+    pool = ModelPool.create(module, sample, cfg.num_models,
+                            seed=cfg.seed + 42)
+    ckpt = load_checkpoint(ckpt_dir, pool.params)
+    pool.params = ckpt["pool_params"]
+
+    algo_state = ckpt.get("algo_state") or {}
+    reg_state = algo_state.get("__registry__")
+    if reg_state is not None:
+        reg = ClientRegistry(len(np.asarray(reg_state["cluster"])),
+                             np.asarray(reg_state["assign_hist"]).shape[1])
+        reg.load_state_dict(reg_state)
+        routing = RoutingTable.from_registry(reg)
+    else:
+        # dense mode: the cluster algorithms keep a per-slot assignment
+        # vector in their state; FedAvg-style states have none -> model 0
+        assign = algo_state.get("assignment")
+        if assign is None:
+            assign = np.zeros(cfg.device_clients, dtype=np.int64)
+        routing = RoutingTable.from_assignment(np.asarray(assign))
+    return InferenceEngine(pool, routing, mesh=mesh, buckets=buckets,
+                           max_wait_s=max_wait_s)

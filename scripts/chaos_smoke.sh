@@ -47,6 +47,12 @@
 #    broker mid-run: /healthz flips to 503 degraded, an slo_burn
 #    (broker_liveness, via heartbeat_missed) lands in alerts.jsonl; the
 #    broker restarts on the same port and /healthz flips back to 200 ok.
+# 11) serving domain — the cluster-routed inference engine loses its
+#    swap-feed broker under live closed-loop traffic: requests keep
+#    answering on the last published generation (zero errors), /healthz
+#    reflects the degradation, and after a broker restart on the same
+#    port the replayed subscription resumes hot-swaps (a cluster event
+#    published post-recovery re-routes live requests).
 #
 # Usage: scripts/chaos_smoke.sh            (~2-3 min on one CPU core)
 set -euo pipefail
@@ -57,12 +63,12 @@ OUT=$(mktemp -d)
 trap 'rm -rf "$OUT"' EXIT
 RUN="$OUT/run"
 
-echo "== [1/10] chaos transport e2e (drop_prob=0.2 + broker kill/restart) =="
+echo "== [1/11] chaos transport e2e (drop_prob=0.2 + broker kill/restart) =="
 timeout -k 10 300 python -m pytest tests/test_resilience.py -q \
     -p no:cacheprovider -p no:randomly \
     -k "ChaosEndToEnd or survives_broker_kill or heartbeat_missed"
 
-echo "== [2/10] preemption: SIGTERM a real run, then --auto_resume =="
+echo "== [2/11] preemption: SIGTERM a real run, then --auto_resume =="
 ARGS=(--dataset sine --model fnn --concept_drift_algo win-1
       --concept_num 2 --client_num_in_total 4 --client_num_per_round 4
       --train_iterations 6 --comm_round 8 --epochs 2
@@ -99,15 +105,15 @@ print(f"resume OK: {len(rows)} metric rows, final Test/Acc="
       f"{rows[-1]['Test/Acc']:.4f}")
 EOF
 
-echo "== [3/10] event taxonomy consistency (strict: no dead kinds) =="
+echo "== [3/11] event taxonomy consistency (strict: no dead kinds) =="
 python scripts/check_events_schema.py --strict
 
-echo "== [4/10] byzantine smoke: trimmed_mean defends where mean fails =="
+echo "== [4/11] byzantine smoke: trimmed_mean defends where mean fails =="
 timeout -k 10 300 python -m pytest tests/test_robust_agg.py -q \
     -p no:cacheprovider -p no:randomly \
     -k "trimmed_mean_defends_where_mean_fails"
 
-echo "== [5/10] decision observability: kill clients -> alerts + lineage =="
+echo "== [5/11] decision observability: kill clients -> alerts + lineage =="
 LRUN="$OUT/lineage-run"
 timeout -k 10 300 python - "$LRUN" <<'EOF'
 import sys
@@ -141,7 +147,7 @@ python -m feddrift_tpu report "$LRUN" > "$OUT/report.txt"
 grep -q "alerts:" "$OUT/report.txt" \
     || { echo "report missing alerts section"; exit 1; }
 
-echo "== [6/10] participation: 10^3 population, 20% stragglers + churn =="
+echo "== [6/11] participation: 10^3 population, 20% stragglers + churn =="
 PRUN="$OUT/population-run"
 timeout -k 10 300 python -m feddrift_tpu run \
     --dataset sea --model fnn --concept_drift_algo softcluster \
@@ -160,7 +166,7 @@ python -m feddrift_tpu report "$PRUN" > "$OUT/preport.txt"
 grep -q "participation:" "$OUT/preport.txt" \
     || { echo "report missing participation section"; exit 1; }
 
-echo "== [7/10] fused participation: megastep_k=4 kill -> resume, same cohorts =="
+echo "== [7/11] fused participation: megastep_k=4 kill -> resume, same cohorts =="
 FREF="$OUT/fused-ref"
 FRUN="$OUT/fused-run"
 FARGS=(--dataset sea --model fnn --concept_drift_algo oblivious
@@ -218,7 +224,7 @@ print(f"fused resume OK: {len(c_ref)} iterations, identical cohort "
       f"schedule, {len(rows)} metric rows")
 EOF
 
-echo "== [8/10] hierarchy: 10^3 population, kill edge 0 mid-run =="
+echo "== [8/11] hierarchy: 10^3 population, kill edge 0 mid-run =="
 HRUN="$OUT/hierarchy-run"
 timeout -k 10 300 python -m feddrift_tpu run \
     --dataset sea --model fnn --concept_drift_algo softcluster \
@@ -256,12 +262,12 @@ grep -q "hierarchy:" "$OUT/hreport.txt" \
 grep -q "re-homed:" "$OUT/hreport.txt" \
     || { echo "report missing re-homed line"; exit 1; }
 
-echo "== [9/10] causal trace continuity across broker reconnect =="
+echo "== [9/11] causal trace continuity across broker reconnect =="
 timeout -k 10 300 python -m pytest tests/test_causal_trace.py -q \
     -p no:cacheprovider -p no:randomly \
     -k "trace_survives_broker_reconnect"
 
-echo "== [10/10] live ops plane: broker kill -> /healthz 503 + slo_burn -> recovery =="
+echo "== [10/11] live ops plane: broker kill -> /healthz 503 + slo_burn -> recovery =="
 ORUN="$OUT/ops-run"
 mkdir -p "$ORUN"
 timeout -k 10 300 python - "$ORUN" <<'EOF'
@@ -327,6 +333,130 @@ assert doc["status"] == "ok", doc
 print(f"  recovery OK: /healthz {code} {doc['status']}, "
       f"reconnects={doc['broker']['reconnects']}")
 client.close(); srv.close(); broker2.close()
+EOF
+
+echo "== [11/11] serving: broker kill mid-traffic -> degrade, swaps resume =="
+SRUN="$OUT/serve-run"
+mkdir -p "$SRUN"
+timeout -k 10 300 python - "$SRUN" <<'EOF'
+import json, os, sys, threading, time, urllib.error, urllib.request
+import numpy as np
+import jax.numpy as jnp
+from feddrift_tpu import obs
+from feddrift_tpu.comm.netbroker import NetworkBroker, NetworkBrokerClient
+from feddrift_tpu.config import ExperimentConfig
+from feddrift_tpu.core.pool import ModelPool
+from feddrift_tpu.data.registry import make_dataset
+from feddrift_tpu.models import create_model
+from feddrift_tpu.obs import live
+from feddrift_tpu.platform.serving import (CLUSTER_TOPIC, InferenceEngine,
+                                           RoutingTable)
+from feddrift_tpu.resilience.reconnect import ReconnectingBrokerClient
+from feddrift_tpu.resilience.retry import RetryPolicy
+
+out = sys.argv[1]
+obs.configure(os.path.join(out, "events.jsonl"))
+
+cfg = ExperimentConfig(dataset="sea", train_iterations=2, sample_num=16)
+ds = make_dataset(cfg)
+pool = ModelPool.create(create_model("fnn", ds, cfg),
+                        jnp.asarray(ds.x[0, 0, :2]), 2, seed=7,
+                        identical=False)
+engine = InferenceEngine(pool, RoutingTable([0] * 8),
+                         buckets=(1, 2, 4)).start()
+engine.warmup()
+
+broker = NetworkBroker()
+host, port = broker.host, broker.port
+client = ReconnectingBrokerClient(
+    lambda: NetworkBrokerClient(host, port, timeout=2.0),
+    retry=RetryPolicy(base_delay=0.05, max_delay=0.25, max_attempts=400,
+                      deadline_s=120.0),
+    heartbeat_interval=0.1, heartbeat_timeout=0.4)
+engine.attach_broker(client)
+srv = live.OpsServer(port=0).start()
+
+def healthz():
+    try:
+        with urllib.request.urlopen(srv.url + "/healthz", timeout=2) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+def wait_for(pred, what, timeout_s=30.0):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if pred():
+            return
+        time.sleep(0.1)
+    raise AssertionError(f"timed out waiting for {what}")
+
+# closed-loop traffic for the WHOLE scenario: any request failing while
+# the broker is down (or during recovery) fails the stage
+stop = threading.Event()
+served, errors = [0], [0]
+def pump(w):
+    rng = np.random.RandomState(w)
+    while not stop.is_set():
+        try:
+            engine.submit(int(rng.randint(8)),
+                          rng.standard_normal(3).astype(np.float32))
+            served[0] += 1
+        except Exception:
+            errors[0] += 1
+pumps = [threading.Thread(target=pump, args=(w,), daemon=True)
+         for w in range(4)]
+for t in pumps:
+    t.start()
+
+# a live broker event hot-swaps the routing under the running traffic.
+# publish-retry (idempotent assign) — the pub rides a different socket
+# than the sub frame, so a single publish can race the subscription
+pub = NetworkBrokerClient(host, port, timeout=2.0)
+deadline = time.monotonic() + 30.0
+while engine.version < 2 and time.monotonic() < deadline:
+    pub.publish(CLUSTER_TOPIC, json.dumps(
+        {"kind": "cluster_assign", "assignment": [1] * 8}))
+    time.sleep(0.2)
+assert engine.version >= 2, "hot-swap from live broker event never landed"
+assert engine.submit(0, np.zeros(3, np.float32)).model == 1
+
+before = served[0]
+broker.close()                                   # swap feed dies mid-traffic
+wait_for(lambda: healthz()[0] == 503
+         and "broker" in healthz()[1]["degraded"],
+         "/healthz to flip 503 degraded(broker)")
+# graceful degradation: the read path keeps answering on the last
+# published generation while the swap feed is gone
+wait_for(lambda: served[0] >= before + 200,
+         "requests to keep serving broker-less")
+assert engine.submit(3, np.zeros(3, np.float32)).model == 1
+print(f"  degraded OK: {served[0] - before}+ requests served broker-less")
+
+broker2 = NetworkBroker(host=host, port=port)    # restart, same address
+wait_for(lambda: healthz()[0] == 200,
+         "/healthz to recover to 200 ok", timeout_s=60.0)
+# swaps resume through the replayed subscription; publish-retry until the
+# event lands (idempotent merge) so the check never races the resubscribe
+pub2 = NetworkBrokerClient(host, port, timeout=2.0)
+v = engine.version
+deadline = time.monotonic() + 60.0
+while engine.version <= v and time.monotonic() < deadline:
+    pub2.publish(CLUSTER_TOPIC, json.dumps(
+        {"kind": "cluster_merge", "base": 0, "merged": 1}))
+    time.sleep(0.2)
+assert engine.version > v, "swap feed never resumed after reconnect"
+wait_for(lambda: engine.submit(5, np.zeros(3, np.float32)).model == 0,
+         "post-recovery event to re-route live requests")
+
+stop.set()
+for t in pumps:
+    t.join(timeout=5)
+assert errors[0] == 0, f"{errors[0]} requests failed during the outage"
+stats = engine.stats()
+engine.close(); client.close(); srv.close(); broker2.close()
+print(f"  recovery OK: {stats['served']} served total, 0 errors, "
+      f"pool version {stats['version']}")
 EOF
 
 echo "chaos_smoke: ALL OK"

@@ -16,18 +16,18 @@ cd "$(dirname "$0")/.."
 out="$(mktemp -d)"
 trap 'rm -rf "$out"' EXIT
 
-echo "[perf_gate 1/9] graftlint: static analysis must be clean"
+echo "[perf_gate 1/10] graftlint: static analysis must be clean"
 # cheapest stage first: the lint verb is pre-jax and runs in ~1s; a dirty
 # tree fails the gate before any bench spends minutes compiling
 python -m feddrift_tpu lint feddrift_tpu/ --strict
 
-echo "[perf_gate 2/9] warm run (populates the persistent compile cache)"
+echo "[perf_gate 2/10] warm run (populates the persistent compile cache)"
 python bench.py --smoke --cpu > "$out/warm.json"
 
-echo "[perf_gate 3/9] measured run"
+echo "[perf_gate 3/10] measured run"
 python bench.py --smoke --cpu > "$out/bench.json"
 
-echo "[perf_gate 4/9] cost-model + critical-path fields present"
+echo "[perf_gate 4/10] cost-model + critical-path fields present"
 python - "$out/bench.json" <<'EOF'
 import json, sys
 d = json.loads(open(sys.argv[1]).read().strip().splitlines()[-1])
@@ -44,7 +44,7 @@ print(f"  mfu_estimate={d['mfu_estimate']} (source={d['mfu']['source']}), "
       f"round_wall_p99_s={d['round_wall_p99_s']}")
 EOF
 
-echo "[perf_gate 5/9] critical_path on a smoke run dir"
+echo "[perf_gate 5/10] critical_path on a smoke run dir"
 # bench.py runs without an out_dir (no spans.jsonl), so the attribution
 # verb gets its own tiny recorded run: 2 iterations, per-round path.
 JAX_PLATFORMS=cpu python -m feddrift_tpu run \
@@ -68,7 +68,7 @@ print(f"  dominant_segment={d['dominant_segment']}, "
       f"host_overhead_frac_mean={d['host_overhead_frac_mean']}")
 EOF
 
-echo "[perf_gate 6/9] megastep: K=4 vs K=1 bitwise parity + zero steady recompiles"
+echo "[perf_gate 6/10] megastep: K=4 vs K=1 bitwise parity + zero steady recompiles"
 # the megastep fuses K whole iterations into one device program; the gate
 # is (a) bitwise-identical params/accuracy vs the K=1 driver and (b) no
 # jit cache growth past the single warm-up compile across blocks
@@ -101,7 +101,7 @@ print(f"  parity OK (leafdiff=0.0, {len(a4)} eval points), "
       f"megastep cache entries={n}")
 EOF
 
-echo "[perf_gate 7/9] composed megastep: population+hierarchy K=4 parity + throughput"
+echo "[perf_gate 7/10] composed megastep: population+hierarchy K=4 parity + throughput"
 # the megastep gate is per-feature: population cohorts, hierarchy and
 # chaos schedules all fuse now. Gate is (a) bitwise parity (params, eval
 # series, registry bookkeeping) vs the K=1 driver, (b) no megastep jit
@@ -182,7 +182,63 @@ print(f"  parity OK (leafdiff=0.0, {len(a4)} eval points); "
 assert r4 >= r1, f"composed K=4 slower than its own K=1: {r4:.1f} vs {r1:.1f}"
 EOF
 
-echo "[perf_gate 8/9] regress: self-comparison (warm), then vs BENCH_r05.json"
+echo "[perf_gate 8/10] serving: batched >= 3x unbatched rps, zero steady recompiles"
+# The cluster-routed read path (platform/serving.py): warm every bucket,
+# drive a seeded closed loop twice — unbatched (bucket set {1}) and
+# batched — and hold (a) an absolute unbatched requests/s floor (sanity:
+# the engine is actually serving), (b) the micro-batching payoff at the
+# ISSUE-14 acceptance bar (>= 3x), and (c) ZERO steady-state recompiles
+# under mixed-cluster traffic (warm-up compiles one program per bucket;
+# anything after it is an anomaly, not noise).
+JAX_PLATFORMS=cpu python - <<'EOF'
+import numpy as np
+import jax.numpy as jnp
+from feddrift_tpu import obs
+from feddrift_tpu.config import ExperimentConfig
+from feddrift_tpu.core.pool import ModelPool
+from feddrift_tpu.data.registry import make_dataset
+from feddrift_tpu.models import create_model
+from feddrift_tpu.platform.serving import (InferenceEngine, RoutingTable,
+                                           TrafficGenerator)
+
+cfg = ExperimentConfig(dataset="sea", train_iterations=2, sample_num=16)
+ds = make_dataset(cfg)
+mod = create_model("fnn", ds, cfg)
+pool = ModelPool.create(mod, jnp.asarray(ds.x[0, 0, :2]), 4, seed=7,
+                        identical=False)
+routing = np.random.RandomState(14).randint(0, 4, 64)
+
+def recompiles():
+    return sum(v for k, v in obs.registry().snapshot().items()
+               if k.startswith('jit_recompiles{fn="serve_forward'))
+
+def measure(buckets):
+    eng = InferenceEngine(pool, RoutingTable(routing),
+                          buckets=buckets).start()
+    eng.warmup()
+    gen = TrafficGenerator(eng, list(range(64)), seed=0, concurrency=32)
+    gen.run(100)                                   # warm closed loop
+    r0 = recompiles()
+    stats = gen.run(600)
+    steady = recompiles() - r0
+    eng.close()
+    return stats, steady
+
+un, un_rec = measure((1,))
+ba, ba_rec = measure((1, 2, 4, 8, 16, 32))
+ratio = ba["requests_per_s"] / un["requests_per_s"]
+print(f"  unbatched={un['requests_per_s']:.0f} rps (p99 {un['p99_ms']:.2f} ms), "
+      f"batched={ba['requests_per_s']:.0f} rps (p99 {ba['p99_ms']:.2f} ms), "
+      f"ratio={ratio:.2f} (floor 3.0)")
+assert un["errors"] == 0 and ba["errors"] == 0, (un, ba)
+assert un_rec == 0 and ba_rec == 0, \
+    f"steady-state recompiles: unbatched={un_rec} batched={ba_rec}"
+assert un["requests_per_s"] >= 200, \
+    f"unbatched floor: {un['requests_per_s']:.0f} rps < 200"
+assert ratio >= 3.0, f"micro-batching payoff collapsed: {ratio:.2f}x"
+EOF
+
+echo "[perf_gate 9/10] regress: self-comparison (warm), then vs BENCH_r05.json"
 # back-to-back smoke runs on a busy 1-core host: generous relative noise
 # margins, but identical round counts make every metric comparable
 python -m feddrift_tpu regress "$out/bench.json" --baseline "$out/warm.json" \
@@ -193,7 +249,7 @@ python -m feddrift_tpu regress "$out/bench.json" --baseline "$out/warm.json" \
 python -m feddrift_tpu regress "$out/bench.json" --baseline BENCH_r05.json \
     --tol-rounds 0.9 --tol-acc 0.15
 
-echo "[perf_gate 9/9] ops plane overhead: enabled run within 2% of disabled"
+echo "[perf_gate 10/10] ops plane overhead: enabled run within 2% of disabled"
 # The /metrics + /healthz server, SLO engine and status tap must stay off
 # the hot path. Resolving a 2% bound on a noisy 1-core host needs a
 # paired design: BOTH experiments live in one process, iterations
