@@ -393,8 +393,13 @@ def _measure(cfg, backend: str) -> dict:
     # "cost_analysis"; analytic fallback otherwise), peak from the
     # datasheet on TPU and a measured matmul microbenchmark elsewhere —
     # a real utilization number instead of the historical null.
-    effective_dtype = (cfg.compute_dtype if backend.startswith("tpu")
-                       else "float32")   # bf16 is TPU-only (runner._make_apply)
+    # Policy-resolved compute dtype: "auto" keeps the historical rule
+    # (cfg.compute_dtype on TPU, f32 elsewhere); an explicit precision
+    # preset pins it on every backend (core/precision.py).
+    from feddrift_tpu.core.precision import resolve_precision
+    effective_dtype = resolve_precision(
+        cfg, backend="tpu" if backend.startswith("tpu") else "cpu"
+    ).compute_dtype
     flops_round, flops_source = costmodel.round_flops(exp)
     peak, peak_source = costmodel.peak_flops(backend, effective_dtype)
     mfu = round(flops_round * rps / peak, 6)
@@ -860,6 +865,116 @@ def _megastep_bench(backend: str, smoke: bool) -> list:
     return out
 
 
+def _precision_cfg(smoke: bool, policy: str):
+    """Compute-bound real-workload preset for the precision axis:
+    resnet8 on FMoW-shaped synthetic satellite images (data/fmow.py,
+    32x32x3) — the first runnable bench preset pairing the two; the
+    canonical fnn is ~21k params, so its precision deltas are noise by
+    construction. Drift-oblivious single model: the axis measures the
+    round program's dtype economics, not cluster dynamics. Geometry is
+    sized so one local step per (client, round) keeps the CPU-emulated
+    bf16 sweep affordable while the conv tower still dominates bytes."""
+    return _canonical_cfg(
+        smoke, dataset="fmow", model="resnet8",
+        concept_drift_algo="oblivious", concept_drift_algo_arg="",
+        concept_num=1, change_points="A", precision=policy,
+        client_num_in_total=4, client_num_per_round=4,
+        epochs=1, batch_size=32, sample_num=32,
+        train_iterations=4, comm_round=3 if smoke else 10,
+        frequency_of_the_test=3 if smoke else 10,
+        cost_model="compiled")    # exact per-program HBM is the point here
+
+
+def _precision_bench(backend: str, smoke: bool) -> list:
+    """End-to-end precision-policy axis (ISSUE 15): the f32 / bf16_mixed /
+    bf16_pure presets over the compute-bound resnet8-on-FMoW preset.
+
+    The PRECISION artifact the `regress` gate checks: rounds/s floor per
+    policy, every reduced-precision row's accuracy within
+    --tol-precision-acc of the same artifact's OWN f32 row, ZERO
+    steady-state recompiles (a policy is one jit signature per program,
+    compiled in warm-up), and ABSOLUTE ceilings on the bf16_mixed ratios
+    — program_bytes_accessed <= 0.60x and wire bytes/round <= 0.55x of
+    the paired f32 row. On CPU the bf16 arithmetic is emulated, so
+    rounds/s is NOT the portable signal; the bytes ratios are (XLA's
+    accounting of the same programs), and the MXU-rate prediction lives
+    in TPU_BOTTLENECK.md as a falsifiability row.
+
+    Wire bytes go through the real frame encoder at each policy's wire
+    dtype ("none" codec on purpose: the codec axis is COMM's; this axis
+    isolates the dtype width, headers included)."""
+    import jax.numpy as jnp
+    import ml_dtypes
+    import numpy as np
+
+    from feddrift_tpu.comm.compress import encode_frame
+    from feddrift_tpu.core.precision import PRESETS
+    from feddrift_tpu.data.registry import make_dataset
+    from feddrift_tpu.models import create_model
+    from feddrift_tpu.obs.regress import _compile_counts
+
+    cfg0 = _precision_cfg(smoke, "f32")
+    ds = make_dataset(cfg0)
+    module = create_model(cfg0.model, ds, cfg0)
+    leaves = jax.tree_util.tree_leaves(
+        module.init(jax.random.PRNGKey(0),
+                    jnp.asarray(ds.x[0, 0, :2]))["params"])
+    wire_np = {"float32": np.float32, "bfloat16": ml_dtypes.bfloat16}
+
+    def wire_bytes_per_round(policy: str) -> int:
+        dt = wire_np[PRESETS[policy].wire_dtype]
+        one_update = sum(
+            len(json.dumps(encode_frame(np.asarray(l).astype(dt), "none",
+                                        name=f"p{i}")))
+            for i, l in enumerate(leaves))
+        return one_update * cfg0.client_num_per_round
+
+    out = []
+    f32_row = None
+    for policy in ("f32", "bf16_mixed", "bf16_pure"):
+        cfg = _precision_cfg(smoke, policy)
+        r = _measure_with_retry(cfg, backend)
+        _, recompiles = _compile_counts(r)
+        costs = r.get("program_costs") or {}
+        # Pre-optimization accounting: buffers at the widths the program
+        # declares. The optimized-HLO bytes_accessed is backend-specialized
+        # — XLA:CPU emulates bf16 math in f32 with convert traffic, which
+        # would report a bf16 program as COSTLIER than f32 (measured 1.25x
+        # on this preset) purely as an emulation artifact.
+        bytes_accessed = sum(c.get("lowered_bytes_accessed")
+                             or c.get("bytes_accessed") or 0
+                             for c in costs.values()) or None
+        pol = PRESETS[policy]
+        entry = {
+            "variant": "resnet",
+            "policy": policy,
+            "param_dtype": pol.param_dtype,
+            "agg_dtype": pol.agg_dtype,
+            "wire_dtype": pol.wire_dtype,
+            "rounds_per_sec": r.get("value"),
+            "final_test_acc": r.get("final_test_acc"),
+            "wall_s": r.get("wall_s"),
+            "steady_recompiles": recompiles,
+            "program_bytes_accessed": bytes_accessed,
+            "peak_hbm_bytes": r.get("hbm_peak_bytes"),
+            "wire_bytes_per_round": wire_bytes_per_round(policy),
+            **({"error": r["error"]} if "error" in r else {}),
+        }
+        if policy == "f32":
+            f32_row = entry
+        elif f32_row is not None:
+            def _ratio(key):
+                a, b = entry.get(key), f32_row.get(key)
+                return round(a / b, 4) if a and b else None
+            entry["bytes_accessed_ratio"] = _ratio("program_bytes_accessed")
+            entry["peak_hbm_ratio"] = _ratio("peak_hbm_bytes")
+            entry["wire_bytes_ratio"] = _ratio("wire_bytes_per_round")
+        out.append(entry)
+        print(json.dumps({"partial": f"precision@{policy}", **entry}),
+              file=sys.stderr)
+    return out
+
+
 def _conv_cfg(smoke: bool, **overrides):
     base = dict(
         dataset="cifar10", model="resnet8",
@@ -984,6 +1099,13 @@ def main() -> None:
         # overhead strictly below K=1)
         "megastep": (_megastep_bench(backend, smoke)
                      if "--megastep" in sys.argv else None),
+        # end-to-end precision-policy axis (opt-in: paired f32 /
+        # bf16_mixed / bf16_pure sweep on the resnet8-on-FMoW preset);
+        # committed as PRECISION_r1*.json and gated by `regress`
+        # (rounds/s floor, accuracy vs own f32 row, zero steady
+        # recompiles, bytes_accessed <= 0.60x and wire <= 0.55x absolute)
+        "precision": (_precision_bench(backend, smoke)
+                      if "--precision" in sys.argv else None),
         # serving read-path axis (opt-in: closed-loop inference over the
         # model pool across micro-batch buckets); committed as
         # SERVE_r1*.json and gated by `regress` (requests/s floor, p99

@@ -620,10 +620,89 @@ def rule_r5(ctx: FileContext) -> List[Finding]:
     return findings
 
 
+# --------------------------------------------------------------------------
+# R7: dtype-narrowing hygiene — bare ``np.asarray(x, np.float32)`` /
+# ``.astype(np.float32)`` in modules that handle pool/update tensors. With
+# an end-to-end precision policy (core/precision.py) the pool's dtype is a
+# CONTRACT: a hardwired f32 coercion silently upcasts a bf16 pool (undoing
+# the policy's HBM/wire savings and flipping jit signatures -> bucket
+# retraces) or narrows a policy-typed tensor outside the documented
+# boundaries. Legitimate boundaries (f32 master accumulators, quantizer
+# arithmetic, JSON-decode normalization) carry ``# lint: r7-ok (reason)``
+# suppressions via the standard machinery.
+# --------------------------------------------------------------------------
+
+#: repo-relative prefixes whose modules carry policy-typed pool/update
+#: tensors (report/export and data-generation modules are out of scope:
+#: their f32 is by contract, not a leak)
+R7_MODULE_PREFIXES = (
+    "feddrift_tpu/comm/compress.py",
+    "feddrift_tpu/core/pool.py",
+    "feddrift_tpu/core/step.py",
+    "feddrift_tpu/parallel/mesh.py",
+    "feddrift_tpu/platform/hierarchical.py",
+    "feddrift_tpu/platform/serving.py",
+    "feddrift_tpu/utils/checkpoint.py",
+)
+
+_R7_ARRAY_BASES = ("np", "numpy", "jnp", "jax.numpy")
+_R7_F32_SRCS = frozenset(f"{b}.float32" for b in _R7_ARRAY_BASES)
+
+
+def _r7_applies(ctx: FileContext) -> bool:
+    if not ctx.in_package:
+        return True     # golden fixtures / arbitrary paths: all rules run
+    rel = ctx.rel_in_repo
+    return any(rel.startswith(p) if p.endswith("/") else rel == p
+               for p in R7_MODULE_PREFIXES)
+
+
+def _r7_is_f32(node: Optional[ast.AST]) -> bool:
+    if node is None:
+        return False
+    if isinstance(node, ast.Constant):
+        return node.value == "float32"
+    return _unparse(node) in _R7_F32_SRCS
+
+
+def rule_r7(ctx: FileContext) -> List[Finding]:
+    if not _r7_applies(ctx):
+        return []
+    findings: List[Finding] = []
+
+    def flag(node: ast.AST, what: str) -> None:
+        findings.append(Finding(
+            rule="R7", severity="error", path=ctx.path, line=node.lineno,
+            message=f"'{what}' hardwires float32 on a pool/update tensor — "
+                    "silently upcasts a bf16 pool (HBM/wire savings lost, "
+                    "jit signature flips) or narrows a policy-typed value",
+            hint="preserve the incoming dtype (np.asarray(x) / "
+                 "x.astype(expected.dtype)), cast at the PrecisionPolicy "
+                 "boundary, or suppress with '# lint: r7-ok (reason)' at a "
+                 "documented report/export/accumulator boundary"))
+
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        if not isinstance(f, ast.Attribute):
+            continue
+        if f.attr == "asarray" and _unparse(f.value) in _R7_ARRAY_BASES:
+            dt = node.args[1] if len(node.args) >= 2 else next(
+                (kw.value for kw in node.keywords if kw.arg == "dtype"),
+                None)
+            if _r7_is_f32(dt):
+                flag(node, _unparse(f) + "(..., float32)")
+        elif f.attr == "astype" and node.args and _r7_is_f32(node.args[0]):
+            flag(node, _unparse(f) + "(float32)")
+    return findings
+
+
 FILE_RULES = {
     "R1": rule_r1,
     "R2": rule_r2,
     "R3": rule_r3,
     "R4": rule_r4,
     "R5": rule_r5,
+    "R7": rule_r7,
 }

@@ -161,6 +161,7 @@ def main(argv: list[str] | None = None) -> int:
     reg_p.add_argument("--tol-compiles", type=float, default=None)
     reg_p.add_argument("--tol-host-overhead", type=float, default=None)
     reg_p.add_argument("--tol-p99", type=float, default=None)
+    reg_p.add_argument("--tol-precision-acc", type=float, default=None)
     reg_p.add_argument("--json", action="store_true")
 
     cp_p = sub.add_parser(
@@ -267,7 +268,7 @@ def main(argv: list[str] | None = None) -> int:
         from feddrift_tpu.obs.regress import main as regress_main
         argv_r = [args.candidate, "--baseline", args.baseline]
         for flag in ("tol_rounds", "tol_wall", "tol_acc", "tol_compiles",
-                     "tol_host_overhead", "tol_p99"):
+                     "tol_host_overhead", "tol_p99", "tol_precision_acc"):
             v = getattr(args, flag)
             if v is not None:
                 argv_r += [f"--{flag.replace('_', '-')}", str(v)]

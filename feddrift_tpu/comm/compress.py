@@ -1,7 +1,9 @@
 """Verified wire compression for update frames (client->edge, edge->server).
 
-Three codecs over float32 update arrays, each a standard FL
-communication-efficiency lever (arXiv:2405.20431 §compression):
+Three codecs over float32/bfloat16 update arrays (the frame records the
+actual dtype — a bf16 frame is half the raw bytes before any codec runs;
+see ``_WIRE_DTYPES``), each a standard FL communication-efficiency lever
+(arXiv:2405.20431 §compression):
 
 - ``int8``  — per-array affine quantization to 255 levels (~4x),
 - ``topk``  — magnitude top-k sparsification, index+value pairs,
@@ -40,6 +42,7 @@ import queue
 import time
 from typing import Any, Optional
 
+import ml_dtypes  # numpy bfloat16 (ships with jax; no device runtime)
 import numpy as np
 
 from feddrift_tpu import obs
@@ -47,6 +50,23 @@ from feddrift_tpu import obs
 WIRE_CODECS = ("none", "int8", "topk", "delta")
 _LEVELS = 255.0          # int8 affine levels (shared with simulate_codec)
 _SENT_CAP = 256          # frames retained for uncompressed nack re-send
+
+# Frame dtypes the wire speaks (precision policy wire_dtype tier): a bf16
+# frame's raw payload is 2 bytes/element before any codec runs. Every
+# other input dtype (f64 host arrays, ints) normalizes to float32 at the
+# encode boundary — the one place a widening/narrowing cast is the wire's
+# documented job.
+_WIRE_DTYPES = {"float32": np.dtype(np.float32),
+                "bfloat16": np.dtype(ml_dtypes.bfloat16)}
+
+
+def _wire_normalize(arr) -> np.ndarray:
+    """The encode-side dtype boundary: wire-speakable dtypes pass through
+    untouched; anything else becomes float32."""
+    arr = np.asarray(arr)
+    if str(arr.dtype) in _WIRE_DTYPES:
+        return arr
+    return arr.astype(np.float32)  # lint: r7-ok (documented wire boundary)
 
 
 class CorruptFrameError(Exception):
@@ -76,7 +96,10 @@ def _digest(frame: dict) -> str:
 
 def _quant(arr: np.ndarray) -> dict:
     """255-level affine quantization of a whole array; degenerate
-    (constant) arrays quantize to all-zero codes with scale 0."""
+    (constant) arrays quantize to all-zero codes with scale 0. The affine
+    arithmetic always runs in float32 regardless of the frame dtype —
+    quantizing FROM bf16 must not also quantize the quantizer."""
+    arr = arr.astype(np.float32)  # lint: r7-ok (f32 quantizer arithmetic)
     lo = float(arr.min()) if arr.size else 0.0
     hi = float(arr.max()) if arr.size else 0.0
     scale = (hi - lo) / _LEVELS
@@ -87,21 +110,30 @@ def _quant(arr: np.ndarray) -> dict:
     return {"lo": lo, "scale": scale, "data": _b64(q.tobytes())}
 
 
-def _dequant(p: dict, shape: tuple[int, ...]) -> np.ndarray:
+def _dequant(p: dict, shape: tuple[int, ...],
+             dtype: np.dtype = np.dtype(np.float32)) -> np.ndarray:
     q = np.frombuffer(_unb64(p["data"]), np.uint8)
     if q.size != int(np.prod(shape, dtype=np.int64)):
         raise CorruptFrameError("int8 payload length mismatch")
-    return (float(p["lo"])
-            + q.reshape(shape).astype(np.float32) * float(p["scale"]))
+    out = (float(p["lo"])
+           + q.reshape(shape).astype(np.float32)  # lint: r7-ok (f32 dequant arithmetic)
+           * float(p["scale"]))
+    return out if out.dtype == dtype else out.astype(dtype)
 
 
 def encode_frame(arr: np.ndarray, codec: str, *, name: str = "update",
                  fid: int = 0, topk_frac: float = 0.4,
                  prev: Optional[np.ndarray] = None) -> dict:
-    """Encode one float32 array as a JSON-able, digest-carrying frame."""
+    """Encode one array as a JSON-able, digest-carrying frame.
+
+    The frame records the ACTUAL array dtype (float32 or bfloat16 —
+    ``_WIRE_DTYPES``; everything else normalizes to float32 first), and
+    decode reconstructs at that dtype: a bf16 ``none`` frame is half the
+    raw bytes, and the int8/delta quantizers quantize FROM bf16 without a
+    silent round-trip through f32 storage."""
     if codec not in WIRE_CODECS:
         raise ValueError(f"unknown codec {codec!r}")
-    arr = np.asarray(arr, np.float32)
+    arr = _wire_normalize(arr)
     if codec == "none":
         p: dict[str, Any] = {"data": _b64(arr.tobytes())}
     elif codec == "int8":
@@ -127,13 +159,16 @@ def encode_frame(arr: np.ndarray, codec: str, *, name: str = "update",
             p = {"k": int(k), "iw": iw, "idx": _b64(idx.tobytes()),
                  "vals": _quant(flat[idx])}
     else:                                          # delta
-        base = np.zeros_like(arr) if prev is None else np.asarray(prev,
-                                                                  np.float32)
+        # the diff is computed in f32 whatever the frame dtype (the delta
+        # chain's reconstruction error must not compound through bf16)
+        base = np.zeros(arr.shape, np.float32) if prev is None \
+            else np.asarray(prev).astype(np.float32)  # lint: r7-ok (f32 delta arithmetic)
         if base.shape != arr.shape:
             raise ValueError("delta prev shape mismatch")
-        p = _quant(arr - base)
+        p = _quant(arr.astype(np.float32) - base)  # lint: r7-ok (f32 delta arithmetic)
     frame = {"v": 1, "codec": codec, "name": str(name), "fid": int(fid),
-             "shape": [int(s) for s in arr.shape], "dtype": "float32", "p": p}
+             "shape": [int(s) for s in arr.shape], "dtype": str(arr.dtype),
+             "p": p}
     frame["digest"] = _digest(frame)
     return frame
 
@@ -146,19 +181,26 @@ def decode_frame(frame: dict, *,
     try:
         codec = frame["codec"]
         shape = tuple(int(s) for s in frame["shape"])
+        dtype_name = str(frame["dtype"])
         p = frame["p"]
         claimed = frame["digest"]
     except (KeyError, TypeError) as e:
         raise CorruptFrameError(f"malformed frame: {e}") from e
     if _digest(frame) != claimed:
         raise CorruptFrameError("digest mismatch (bit flip or truncation)")
+    # the declared dtype is digest-covered, so an unknown value here is
+    # sender disagreement, not tampering — still refuse to reinterpret
+    # bytes at a guessed width
+    if dtype_name not in _WIRE_DTYPES:
+        raise CorruptFrameError(f"unsupported frame dtype {dtype_name!r}")
+    dt = _WIRE_DTYPES[dtype_name]
     if codec == "none":
-        raw = np.frombuffer(_unb64(p["data"]), np.float32)
+        raw = np.frombuffer(_unb64(p["data"]), dt)
         if raw.size != int(np.prod(shape, dtype=np.int64)):
             raise CorruptFrameError("raw payload length mismatch")
         return raw.reshape(shape).copy()
     if codec == "int8":
-        return _dequant(p, shape)
+        return _dequant(p, shape, dt)
     if codec == "topk":
         iw = int(p.get("iw", 4))
         if iw not in (0, 2, 4):
@@ -175,15 +217,16 @@ def decode_frame(frame: dict, *,
         vals = _dequant(p["vals"], (k,))
         if idx.size != k or (idx.size and int(idx.max()) >= n_flat):
             raise CorruptFrameError("topk payload inconsistent")
-        out = np.zeros(n_flat, np.float32)
+        out = np.zeros(n_flat, dt)
         out[idx] = vals
         return out.reshape(shape)
     if codec == "delta":
         base = np.zeros(shape, np.float32) if prev is None \
-            else np.asarray(prev, np.float32)
+            else np.asarray(prev).astype(np.float32)  # lint: r7-ok (f32 delta arithmetic)
         if base.shape != shape:
             raise CorruptFrameError("delta prev shape mismatch")
-        return base + _dequant(p, shape)
+        out = base + _dequant(p, shape)
+        return out if out.dtype == dt else out.astype(dt)
     raise CorruptFrameError(f"unknown codec {codec!r}")
 
 
@@ -260,7 +303,7 @@ class UpdateSender:
         recording is armed, so every update is followable by default in
         an instrumented run.
         """
-        arr = np.asarray(arr, np.float32)
+        arr = _wire_normalize(arr)
         self._fid += 1
         fid = self._fid
         tctx = None
@@ -400,16 +443,22 @@ def simulate_codec(diffs, codec: str, topk_frac: float = 0.4, prev=None):
         return diffs, None
 
     def _qdq(d):
-        # per (m, c) slice affine quantization over the param axes
+        # per (m, c) slice affine quantization over the param axes. The
+        # affine arithmetic runs in f32 whatever the stack dtype (the
+        # device-side mirror of the wire _quant contract: int8 quantizes
+        # FROM bf16 without bf16 rounding inside the quantizer), and the
+        # result is cast back to the input dtype — a same-dtype identity
+        # on f32 stacks, so the f32 program is unchanged bit for bit.
         axes = tuple(range(2, d.ndim))
         if not axes:
             return d                              # scalar per client slice
-        lo = d.min(axis=axes, keepdims=True)
-        hi = d.max(axis=axes, keepdims=True)
+        d32 = d.astype(jnp.float32)  # lint: r7-ok (f32 quantizer arithmetic, cast back below)
+        lo = d32.min(axis=axes, keepdims=True)
+        hi = d32.max(axis=axes, keepdims=True)
         scale = (hi - lo) / _LEVELS
         safe = jnp.where(scale > 0, scale, 1.0)
-        q = jnp.clip(jnp.round((d - lo) / safe), 0.0, _LEVELS)
-        return jnp.where(scale > 0, lo + q * safe, d)
+        q = jnp.clip(jnp.round((d32 - lo) / safe), 0.0, _LEVELS)
+        return jnp.where(scale > 0, lo + q * safe, d32).astype(d.dtype)
 
     if codec == "int8":
         return jax.tree_util.tree_map(_qdq, diffs), None
@@ -418,11 +467,11 @@ def simulate_codec(diffs, codec: str, topk_frac: float = 0.4, prev=None):
         def _sparsify(d):
             if d.ndim <= 2:
                 return d
-            flat = d.reshape(d.shape[:2] + (-1,))
+            flat = d.reshape(d.shape[:2] + (-1,)).astype(jnp.float32)  # lint: r7-ok (f32 threshold arithmetic, cast back below)
             thr = jnp.quantile(jnp.abs(flat), 1.0 - topk_frac, axis=-1,
                                keepdims=True)
             kept = jnp.where(jnp.abs(flat) >= thr, flat, 0.0)
-            return kept.reshape(d.shape)
+            return kept.reshape(d.shape).astype(d.dtype)
         return jax.tree_util.tree_map(_sparsify, diffs), None
 
     if codec == "delta":

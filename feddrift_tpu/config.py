@@ -99,6 +99,12 @@ class ExperimentConfig:
     seed: int = 0                      # reference --dummy_arg (main_fedavg.py:292-298)
     dtype: str = "float32"             # param dtype; compute can be bfloat16
     compute_dtype: str = "bfloat16"    # bf16 matmuls/convs on TPU (runner._make_apply)
+    # End-to-end precision policy (core/precision.py; docs/PERFORMANCE.md
+    # "Precision policy"): "auto" keeps the historical dtype/compute_dtype
+    # behavior (bf16 apply-boundary on TPU only); "f32" / "bf16_mixed" /
+    # "bf16_pure" select a preset on every backend — bf16 storage halves
+    # resident HBM, streamed bytes and wire frames (CPU runs it emulated).
+    precision: str = "auto"
     remat: bool = False                # jax.checkpoint the forward (HBM <-> FLOPs)
 
     # --- TPU execution ---------------------------------------------------
@@ -396,6 +402,11 @@ class ExperimentConfig:
             raise ValueError(f"unknown compress_codec {self.compress_codec!r}")
         if not 0.0 < self.compress_topk_frac <= 1.0:
             raise ValueError("compress_topk_frac must be in (0, 1]")
+        if self.precision not in ("auto", "f32", "bf16_mixed", "bf16_pure"):
+            raise ValueError(f"unknown precision {self.precision!r}")
+        for name in ("dtype", "compute_dtype"):
+            if getattr(self, name) not in ("float32", "bfloat16"):
+                raise ValueError(f"{name} must be float32 or bfloat16")
 
     # ------------------------------------------------------------------
     @property

@@ -25,6 +25,8 @@ from typing import Any
 import jax
 import jax.numpy as jnp
 
+from feddrift_tpu.core.precision import cast_floating
+
 
 @dataclass
 class ModelPool:
@@ -37,15 +39,24 @@ class ModelPool:
     # ------------------------------------------------------------------
     @classmethod
     def create(cls, module, sample_input, num_models: int, seed: int = 42,
-               identical: bool = True) -> "ModelPool":
+               identical: bool = True,
+               param_dtype: str | None = None) -> "ModelPool":
         """Initialise the pool.
 
         ``identical=True`` matches the reference start-up: every model is
         ``reinitialize``d with the same fixed seed (main_fedavg.py:324-329 +
         model/utils.py:20), so all M slots hold the same params.
+
+        ``param_dtype`` (precision policy, core/precision.py): store the
+        pool — and the deterministic-reinit target, which ``reinit_slot``
+        writes back into slots — at this dtype. Flax initialises at f32;
+        the cast here is the one storage boundary, so a bf16 pool is bf16
+        from its very first leaf (None = keep the module's init dtype).
         """
         base_key = jax.random.PRNGKey(seed)
         init_params = module.init(base_key, sample_input)["params"]
+        if param_dtype is not None:
+            init_params = cast_floating(init_params, param_dtype)
         if identical:
             params = jax.tree_util.tree_map(
                 lambda p: jnp.broadcast_to(p[None], (num_models, *p.shape)).copy(),
@@ -54,6 +65,8 @@ class ModelPool:
             keys = jax.random.split(base_key, num_models)
             params = jax.vmap(
                 lambda k: module.init(k, sample_input)["params"])(keys)
+            if param_dtype is not None:
+                params = cast_floating(params, param_dtype)
         return cls(module=module, params=params, init_params=init_params,
                    num_models=num_models, example_input=sample_input)
 
@@ -75,6 +88,11 @@ class ModelPool:
     def distinct_reinit_slot(self, m: int, seed: int) -> None:
         """Fresh random params (IFCA symmetry breaking, AggregatorSoftCluster.py:66-69)."""
         new = self.module.init(jax.random.PRNGKey(seed), self.example_input)["params"]
+        # flax inits at f32; match the pool's stored dtype leaf-by-leaf so
+        # a policy-typed pool never mixes dtypes across slots
+        new = jax.tree_util.tree_map(
+            lambda n, pool: n.astype(pool.dtype) if n.dtype != pool.dtype
+            else n, new, self.params)
         self.set_slot(m, new)
 
     def copy_slot(self, dst: int, src: int) -> None:

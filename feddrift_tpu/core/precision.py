@@ -1,0 +1,169 @@
+"""End-to-end precision policy: which dtype each tier of the round runs at.
+
+The round program touches five distinct tiers of numerics, and "use bf16"
+means something different at each one:
+
+- **param**: the [M, ...] pool and the [M, C, ...] optimizer moments —
+  the resident HBM and the bytes every round streams;
+- **compute**: the matmul/conv operand dtype at the apply boundary (the
+  MXU rate lever on TPU; emulated and slow on CPU — documented in
+  docs/PERFORMANCE.md rather than hard-gated here);
+- **agg**: the accumulation dtype of the masked weighted mean and every
+  robust aggregator. Kept float32 in the mixed preset on purpose: the
+  trimmed-mean / Krum defenses ORDER client updates, and a half-width
+  accumulate can reorder near-ties — the guides' "accumulate in f32,
+  store in bf16" recipe applied to federated aggregation;
+- **eval**: the [E, M, C] loss buffers carried through the fused /
+  megastep scans (correct-counts stay int32 regardless);
+- **wire**: the dtype update frames are encoded from on the broker path
+  (comm/compress.py) — half-width frames before any codec even runs.
+
+``PrecisionPolicy`` is frozen (hashable) so it can ride ``TrainStep`` as
+a static jit argument: switching policies is a *different program*, not a
+retrace of the same one. The ``f32`` preset is engineered to be a literal
+no-op — every cast site guards on dtype inequality, so the emitted XLA
+is bit-for-bit the historical program (the megastep parity tests gate
+this).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+
+#: dtypes a policy tier may name. Wider types (f64) never ride the round
+#: program; narrower ones (fp8) have no XLA story on every backend yet.
+POLICY_DTYPES = ("float32", "bfloat16")
+
+PRECISION_PRESETS = ("f32", "bf16_mixed", "bf16_pure")
+
+
+@dataclass(frozen=True)
+class PrecisionPolicy:
+    """Per-tier dtypes for one experiment. Frozen -> hashable -> static."""
+
+    name: str = "f32"
+    param_dtype: str = "float32"
+    compute_dtype: str = "float32"
+    agg_dtype: str = "float32"
+    eval_dtype: str = "float32"
+    wire_dtype: str = "float32"
+
+    def __post_init__(self) -> None:
+        for tier in ("param", "compute", "agg", "eval", "wire"):
+            v = getattr(self, f"{tier}_dtype")
+            if v not in POLICY_DTYPES:
+                raise ValueError(
+                    f"{tier}_dtype {v!r} not in {POLICY_DTYPES}")
+
+    # -- jnp views ------------------------------------------------------
+    @property
+    def param_jnp(self):
+        return jnp.dtype(self.param_dtype)
+
+    @property
+    def compute_jnp(self):
+        return jnp.dtype(self.compute_dtype)
+
+    @property
+    def agg_jnp(self):
+        return jnp.dtype(self.agg_dtype)
+
+    @property
+    def eval_jnp(self):
+        return jnp.dtype(self.eval_dtype)
+
+    @property
+    def wire_jnp(self):
+        return jnp.dtype(self.wire_dtype)
+
+    @property
+    def is_f32(self) -> bool:
+        """True when every tier is float32 — the bitwise-backcompat path."""
+        return all(
+            getattr(self, f"{t}_dtype") == "float32"
+            for t in ("param", "compute", "agg", "eval", "wire"))
+
+
+#: The three documented presets (docs/PERFORMANCE.md "Precision policy").
+PRESETS: dict[str, PrecisionPolicy] = {
+    "f32": PrecisionPolicy(name="f32"),
+    # bf16 storage + compute + wire, f32 master aggregation and eval
+    # buffers: the recommended production policy — halves resident HBM,
+    # streamed bytes and wire frames while the defense sort orders and
+    # the loss series stay f32-exact.
+    "bf16_mixed": PrecisionPolicy(
+        name="bf16_mixed", param_dtype="bfloat16", compute_dtype="bfloat16",
+        agg_dtype="float32", eval_dtype="float32", wire_dtype="bfloat16"),
+    # Everything half-width, aggregation included: the ablation policy
+    # that shows what the f32 master accumulate buys. Robust-agg sort
+    # orders may differ from f32 near ties — never the default.
+    "bf16_pure": PrecisionPolicy(
+        name="bf16_pure", param_dtype="bfloat16", compute_dtype="bfloat16",
+        agg_dtype="bfloat16", eval_dtype="bfloat16", wire_dtype="bfloat16"),
+}
+
+
+def resolve_precision(cfg, backend: str | None = None) -> PrecisionPolicy:
+    """The policy a config runs under.
+
+    ``cfg.precision`` names a preset; ``"auto"`` reproduces the historical
+    behavior exactly: params/agg/eval/wire at ``cfg.dtype`` (float32), and
+    ``cfg.compute_dtype`` honored ON TPU ONLY — the legacy gate, kept so
+    existing configs stay bitwise-identical on every backend. Explicit
+    presets are backend-independent: asking for ``bf16_mixed`` on CPU gets
+    real (emulated, slow) bf16 — the caveat lives in docs/PERFORMANCE.md,
+    not in a hard-coded gate.
+    """
+    name = getattr(cfg, "precision", "auto")
+    if name != "auto":
+        return PRESETS[name]
+    if backend is None:
+        backend = jax.default_backend()
+    compute = cfg.compute_dtype if backend == "tpu" else cfg.dtype
+    if cfg.dtype == "float32" and compute == "float32":
+        return PRESETS["f32"]
+    return PrecisionPolicy(name="auto", param_dtype=cfg.dtype,
+                           compute_dtype=compute)
+
+
+def cast_floating(tree, dtype):
+    """Cast the floating leaves of ``tree`` to ``dtype``; integer leaves
+    (labels, counts, optimizer step counters) pass through untouched.
+    Already-matching leaves are returned as-is, so an all-f32 tree under
+    an f32 policy is the SAME pytree — no op inserted, no copy made."""
+    dtype = jnp.dtype(dtype)
+
+    def one(leaf):
+        ldt = getattr(leaf, "dtype", None)
+        if ldt is None or not jnp.issubdtype(ldt, jnp.floating):
+            return leaf
+        if ldt == dtype:
+            return leaf
+        return leaf.astype(dtype)
+
+    return jax.tree_util.tree_map(one, tree)
+
+
+def match_dtypes(tree, like):
+    """Cast each floating leaf of ``tree`` to the dtype of the matching
+    leaf in ``like`` (shapes may differ — only dtypes are read). Used
+    after in-program stages whose arithmetic may have promoted a bf16
+    stack to f32 (Byzantine gauss noise, codec reconstruction), so the
+    round program's dtypes stay policy-determined instead of
+    promotion-determined."""
+    def one(leaf, ref):
+        ldt = getattr(leaf, "dtype", None)
+        rdt = getattr(ref, "dtype", None)
+        if ldt is None or rdt is None:
+            return leaf
+        if not jnp.issubdtype(ldt, jnp.floating) \
+                or not jnp.issubdtype(jnp.dtype(rdt), jnp.floating):
+            return leaf
+        if ldt == rdt:
+            return leaf
+        return leaf.astype(rdt)
+
+    return jax.tree_util.tree_map(one, tree, like)

@@ -60,6 +60,8 @@ import optax
 from feddrift_tpu import obs
 from feddrift_tpu.comm.compress import simulate_codec
 from feddrift_tpu.core.functional import confusion_matrix, cross_entropy, tree_select
+from feddrift_tpu.core.precision import (PrecisionPolicy, cast_floating,
+                                         match_dtypes)
 from feddrift_tpu.parallel.mesh import constrain_pool
 from feddrift_tpu.platform.faults import BYZ_MODES, apply_byzantine_updates
 from feddrift_tpu.platform.hierarchical import two_tier_aggregate
@@ -138,6 +140,15 @@ class TrainStep:
     # the negotiated codec introduces on the broker path.
     codec: str = "none"
     codec_topk_frac: float = 0.4
+    # Static: the end-to-end precision policy (core/precision.py). The
+    # pool/opt-state dtype is whatever the caller stores them at
+    # (param_dtype by contract); inside the round program the policy
+    # drives two boundaries: the aggregation inputs/outputs (agg_dtype in,
+    # param_dtype out — the "accumulate in f32, store in bf16" recipe) and
+    # the [E, M, C] eval-loss buffers + their scan carries (eval_dtype).
+    # Every cast site is a same-dtype identity under the default f32
+    # policy, so the emitted XLA is bit-for-bit the historical program.
+    precision: PrecisionPolicy = field(default_factory=PrecisionPolicy)
     # Static: XLA cost-capture level for the tracked programs
     # (obs/costmodel.py CAPTURE_LEVELS). "lowered" re-lowers each program
     # once at first compile to read cost_analysis() (FLOPs / bytes
@@ -262,7 +273,12 @@ class TrainStep:
             xb, yb = x_flat[idx], y_flat[idx]
             loss, grads = jax.value_and_grad(loss_fn)(p, xb, yb)
             updates, o = self.optimizer.update(grads, o, p)
-            updates = jax.tree_util.tree_map(lambda u: u * lr_scale, updates)
+            # pin the scan carry's dtypes: the f32 lr_scale operand (and
+            # optax bias-correction internals) would promote bf16 updates /
+            # moments to f32 mid-scan; identities under the f32 policy
+            o = match_dtypes(o, opt_state)
+            updates = jax.tree_util.tree_map(
+                lambda u, pp: (u * lr_scale).astype(pp.dtype), updates, p)
             p = optax.apply_updates(p, updates)
             return (p, o), loss
 
@@ -334,6 +350,10 @@ class TrainStep:
             client_params = apply_byzantine_updates(
                 client_params, params, byz_modes, stale_params,
                 jax.random.fold_in(key, 7919), self.byz_scale, self.byz_std)
+            # the gauss attack adds f32 noise: JAX promotion would silently
+            # widen a bf16 stack — pin it back to the pool dtype so the
+            # round program's dtypes stay policy-determined
+            client_params = match_dtypes(client_params, params)
 
         # Wire-codec simulation AFTER the adversary: the defense sees the
         # compressed version of whatever each client (honest or not) sent.
@@ -347,6 +367,7 @@ class TrainStep:
                 diffs, self.codec, self.codec_topk_frac, codec_prev)
             client_params = jax.tree_util.tree_map(
                 lambda g, d: g[:, None] + d, params, decoded)
+            client_params = match_dtypes(client_params, params)
 
         # Masked per-cluster aggregation over the client axis
         # (AggregatorSoftCluster.py:149-185): the registered robust_agg
@@ -354,16 +375,26 @@ class TrainStep:
         # With a sharded client axis the sums become ICI all-reduces.
         # hier_edges > 0 routes the same stack through the two-tier path:
         # edge_agg within each group, server_agg across edge summaries.
+        # Aggregation boundary: accumulate at agg_dtype (f32 under
+        # bf16_mixed — trimmed-mean/Krum sort orders must not move on a
+        # half-width accumulate), store the result back at the pool dtype.
+        # Under the f32 policy every cast below is a same-dtype identity,
+        # so nothing is inserted into the historical program.
+        agg_dt = self.precision.agg_jnp
+        cp_agg = cast_floating(client_params, agg_dt)
+        p_agg = cast_floating(params, agg_dt)
+        n_agg = cast_floating(n, agg_dt)
         if self.hier_edges > 0 and edge_ids is not None:
             new_params, agg_stats = two_tier_aggregate(
-                self.edge_agg, self.server_agg, client_params, n, params,
+                self.edge_agg, self.server_agg, cp_agg, n_agg, p_agg,
                 edge_ids, self.hier_edges, edge_mask, edge_modes,
                 jax.random.fold_in(key, 104729), self.robust_cfg,
                 self.byz_scale, self.byz_std)
         else:
             new_params, agg_stats = aggregate(
-                self.robust_agg, client_params, n, params,
+                self.robust_agg, cp_agg, n_agg, p_agg,
                 jax.random.fold_in(key, 104729), self.robust_cfg)
+        new_params = match_dtypes(new_params, params)
         return (new_params, new_opt, client_params, n, losses, agg_stats,
                 new_codec_prev)
 
@@ -540,8 +571,12 @@ class TrainStep:
         ye = jnp.take(y, t + 1, axis=1)
         M = time_w.shape[0]
         C = x.shape[0]
-        zero_mats = (jnp.zeros((M, C), jnp.int32), jnp.zeros((M, C), jnp.float32),
-                     jnp.zeros((M, C), jnp.int32), jnp.zeros((M, C), jnp.float32))
+        # loss buffers at eval_dtype (correct-counts stay int32): under a
+        # bf16 eval policy the [E, M, C] scan carries halve; under f32
+        # (default) these are exactly the historical buffers
+        ev_dt = self.precision.eval_jnp
+        zero_mats = (jnp.zeros((M, C), jnp.int32), jnp.zeros((M, C), ev_dt),
+                     jnp.zeros((M, C), jnp.int32), jnp.zeros((M, C), ev_dt))
 
         def one(carry, rx):
             r, cm, bz, eid, em, eb = rx
@@ -563,7 +598,8 @@ class TrainStep:
             def do_eval(_):
                 ctr, ltr, _tot = self._acc_matrix_body(p, xt, yt, feat_mask)
                 cte, lte, _ = self._acc_matrix_body(p, xe, ye, feat_mask)
-                return ctr, ltr, cte, lte
+                return (ctr, cast_floating(ltr, ev_dt),
+                        cte, cast_floating(lte, ev_dt))
 
             mats = jax.lax.cond(is_eval, do_eval, lambda _: zero_mats, None)
             bufs = tuple(
@@ -579,7 +615,7 @@ class TrainStep:
             return out_carry, (n, losses, agg_stats)
 
         bufs0 = tuple(jnp.zeros((E, M, C), d) for d in
-                      (jnp.int32, jnp.float32, jnp.int32, jnp.float32))
+                      (jnp.int32, ev_dt, jnp.int32, ev_dt))
         carry0 = (params, opt_states, bufs0)
         if byz_stale:
             # round 0's stale replay degenerates to "re-send the broadcast

@@ -25,11 +25,28 @@ class _Norm(nn.Module):
         if self.kind == "group":
             return nn.GroupNorm(num_groups=min(32, x.shape[-1]))(x)
         # Stateless per-batch normalisation over (N, H, W).
-        mean = x.mean(axis=(0, 1, 2), keepdims=True)
-        var = x.var(axis=(0, 1, 2), keepdims=True)
         scale = self.param("scale", nn.initializers.ones, (x.shape[-1],))
         bias = self.param("bias", nn.initializers.zeros, (x.shape[-1],))
-        return (x - mean) / jnp.sqrt(var + 1e-5) * scale + bias
+        if x.dtype == jnp.float32:
+            mean = x.mean(axis=(0, 1, 2), keepdims=True)
+            var = x.var(axis=(0, 1, 2), keepdims=True)
+            return (x - mean) / jnp.sqrt(var + 1e-5) * scale + bias
+        # Half-width activations (the bf16 precision presets): jnp's
+        # reductions upcast f16/bf16 inputs by materialising a full-size
+        # f32 copy of the feature map per statistic, which costs more HBM
+        # traffic than the f32 policy saved. Accumulate the two moments in
+        # f32 THROUGH a dot instead (the feature map is only ever read at
+        # its own width), then fold the tiny per-channel stats back to the
+        # activation dtype for the full-size normalise.
+        feats = x.shape[-1]
+        xr = x.reshape(-1, feats)
+        ones = jnp.ones((xr.shape[0],), x.dtype)
+        s1 = jnp.matmul(ones, xr, preferred_element_type=jnp.float32)
+        s2 = jnp.matmul(ones, xr * xr, preferred_element_type=jnp.float32)
+        mean32 = s1 / xr.shape[0]
+        var32 = jnp.maximum(s2 / xr.shape[0] - mean32 * mean32, 0.0)
+        inv = (1.0 / jnp.sqrt(var32 + 1e-5) * scale).astype(x.dtype)
+        return (x - mean32.astype(x.dtype)) * inv + bias
 
 
 class BasicBlock(nn.Module):

@@ -67,6 +67,13 @@ class ProgramCost:
     level: str                          # "lowered" | "compiled"
     flops: float | None = None          # per execution of the program
     bytes_accessed: float | None = None
+    # Pre-optimization accounting of the same program: buffers counted at
+    # the widths the program DECLARES. Backend optimizers may promote
+    # narrow dtypes (XLA:CPU emulates bf16 matmuls/convs in f32, adding
+    # convert traffic), so the optimized-HLO `bytes_accessed` above can
+    # overstate a bf16 program's portable cost; this field is the
+    # backend-independent dtype-economics signal the precision axis gates.
+    lowered_bytes_accessed: float | None = None
     argument_bytes: int | None = None   # memory_analysis (compiled only)
     output_bytes: int | None = None
     temp_bytes: int | None = None
@@ -174,6 +181,8 @@ def capture(fn: str, jit_fn, args: tuple, kwargs: dict | None = None,
         lowered = jit_fn.lower(*args, **(kwargs or {}))
         pc = ProgramCost(fn=fn, level=level)
         cost = _cost_dict(lowered)
+        if cost and cost.get("bytes accessed") is not None:
+            pc.lowered_bytes_accessed = float(cost["bytes accessed"])
         if level == "compiled":
             compiled = lowered.compile()
             # compiled cost_analysis reflects the optimized HLO; prefer it

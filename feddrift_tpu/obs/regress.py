@@ -38,6 +38,8 @@ DEFAULT_TOL = {
     "bytes": 0.25,       # fail if bytes_per_round > baseline * (1 + tol)
     "host_overhead": 0.10,   # fail if host_overhead_frac > baseline + tol
     "p99": 0.75,         # fail if round_wall_p99_s > baseline * (1 + tol)
+    "precision_acc": 0.05,   # fail if a reduced-precision row's accuracy
+                             # < this run's own f32 row - tol
 }
 
 
@@ -304,6 +306,73 @@ def compare(candidate: dict, baseline: dict,
     elif isinstance(bh, list):
         skip("hierarchy", "candidate lacks the hierarchy axis")
 
+    # end-to-end precision-policy axis (bench.py --precision; PRECISION
+    # artifacts): one row per (variant, policy) from the paired sweep.
+    # rounds/s under the throughput tolerance, steady-state recompiles as
+    # an ABSOLUTE zero gate (a policy is one jit signature per program,
+    # compiled in warm-up — never a per-round dtype lottery), accuracy of
+    # every reduced-precision row within --tol-precision-acc of this
+    # run's OWN f32 row (immune to a baseline that itself drifted), and
+    # ABSOLUTE ceilings on the bf16_mixed cost-model/wire ratios — the
+    # ISSUE-15 acceptance bars: program_bytes_accessed <= 0.60x and wire
+    # bytes/round <= 0.55x of the paired f32 row. Rows are keyed
+    # precision[{variant}:{policy}] so future model variants never
+    # collide with the resnet rows.
+    cpr, bpr = candidate.get("precision"), baseline.get("precision")
+    if isinstance(cpr, list) and isinstance(bpr, list):
+        def _vp(e):
+            return (e.get("variant") or "resnet", e.get("policy"))
+
+        by_vp = {_vp(e): e for e in bpr if isinstance(e, dict)}
+        f32_by_variant = {_vp(e)[0]: e for e in cpr if isinstance(e, dict)
+                          and e.get("policy") == "f32"}
+        for e in cpr:
+            if not isinstance(e, dict):
+                continue
+            variant, pol = _vp(e)
+            name = f"precision[{variant}:{pol}]"
+            be = by_vp.get((variant, pol))
+            if be is None:
+                skip(name, "variant/policy point missing in baseline")
+                continue
+            bv, cv = be.get("rounds_per_sec"), e.get("rounds_per_sec")
+            if bv and cv:
+                floor = bv * (1.0 - tol["rounds"])
+                rows.append(row(f"{name}.rounds_per_s", bv, cv,
+                                f">= {floor:.3f}", cv < floor))
+            rec = e.get("steady_recompiles")
+            if rec is not None:
+                rows.append(row(f"{name}.steady_recompiles",
+                                be.get("steady_recompiles"), rec, "== 0",
+                                rec > 0,
+                                note="one program per policy, compiled "
+                                     "in warm-up"))
+            acc = e.get("final_test_acc")
+            acc32 = (f32_by_variant.get(variant)
+                     or {}).get("final_test_acc")
+            if pol != "f32" and acc is not None and acc32 is not None:
+                floor = acc32 - tol["precision_acc"]
+                rows.append(row(f"{name}.final_test_acc",
+                                be.get("final_test_acc"), acc,
+                                f">= {floor:.4f}", acc < floor,
+                                note="vs this run's own f32 row"))
+            br = e.get("bytes_accessed_ratio")
+            if pol == "bf16_mixed" and br is not None:
+                rows.append(row(f"{name}.bytes_accessed_ratio",
+                                be.get("bytes_accessed_ratio"), br,
+                                "<= 0.6", br > 0.60,
+                                note="absolute HBM-traffic ceiling vs "
+                                     "own f32 row"))
+            wr = e.get("wire_bytes_ratio")
+            if pol == "bf16_mixed" and wr is not None:
+                rows.append(row(f"{name}.wire_bytes_ratio",
+                                be.get("wire_bytes_ratio"), wr,
+                                "<= 0.55", wr > 0.55,
+                                note="absolute wire-bytes ceiling vs "
+                                     "own f32 row"))
+    elif isinstance(bpr, list):
+        skip("precision", "candidate lacks the precision axis")
+
     # serving read-path axis (bench.py --serve; SERVE artifacts): one row
     # per (mode, max-bucket) point from the closed-loop traffic generator.
     # requests/s under the throughput tolerance, request p99 under the
@@ -423,6 +492,11 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--tol-p99", type=float, default=DEFAULT_TOL["p99"],
                     help="relative round_wall_p99_s growth tolerated "
                          "(default %(default)s)")
+    ap.add_argument("--tol-precision-acc", type=float,
+                    default=DEFAULT_TOL["precision_acc"],
+                    help="absolute accuracy drop tolerated for a reduced-"
+                         "precision row vs its own run's f32 row "
+                         "(default %(default)s)")
     ap.add_argument("--json", action="store_true", help="machine-readable")
     args = ap.parse_args(argv)
 
@@ -438,7 +512,8 @@ def main(argv: list[str] | None = None) -> int:
                         "acc": args.tol_acc, "compiles": args.tol_compiles,
                         "bytes": args.tol_bytes,
                         "host_overhead": args.tol_host_overhead,
-                        "p99": args.tol_p99})
+                        "p99": args.tol_p99,
+                        "precision_acc": args.tol_precision_acc})
     regressed = any(r["status"] == "regress" for r in rows)
     if args.json:
         print(json.dumps({"regressed": regressed, "rows": rows,

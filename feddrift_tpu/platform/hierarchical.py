@@ -264,12 +264,18 @@ class EdgeRelay:
             if got is None:
                 continue
             _uname, arr = got
-            arrs.append(np.asarray(arr, np.float32))
+            arrs.append(np.asarray(arr))
             if tctx is None:
                 tctx = self.down.last_trace    # first update anchors the chain
         if not arrs:
             return None
-        summary = np.mean(np.stack(arrs), axis=0)
+        # mean in an f32 master whatever the frame dtype (the precision
+        # policy's agg-in-f32 rule applied at the wire tier), then forward
+        # the summary at the members' own dtype so bf16 frames stay bf16
+        # end-to-end client -> edge -> server
+        acc = np.mean(np.stack(
+            [a.astype(np.float32) for a in arrs]), axis=0)  # lint: r7-ok (f32 master accumulator)
+        summary = acc.astype(arrs[0].dtype)
         self.rounds_relayed += 1
         self.last_members = len(arrs)
         obs.emit("edge_aggregated", edge=self.edge_id, wire=True,

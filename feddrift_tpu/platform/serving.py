@@ -89,13 +89,22 @@ class MalformedRequestError(ValueError):
 # Legacy round-lockstep executor (reference mobile backend)
 # ======================================================================
 
+def _pool_asarray(v) -> np.ndarray:
+    """Admission boundary for pool arrays: a typed array keeps its dtype
+    (a bf16 pool must survive round trips un-upcast), while dtype-less
+    input — JSON lists decode as float64 — normalizes to float32."""
+    arr = np.asarray(v)
+    if arr.dtype == np.float64:
+        return arr.astype(np.float32)  # lint: r7-ok (JSON-decode boundary)
+    return arr
+
+
 class ServingState:
     """Round state: registered devices, current params, pending uploads."""
 
     def __init__(self, init_params: dict[str, np.ndarray]) -> None:
         self.lock = threading.Lock()
-        self.params = {k: np.asarray(v, np.float32)
-                       for k, v in init_params.items()}
+        self.params = {k: _pool_asarray(v) for k, v in init_params.items()}
         self.round = 0
         self.next_device = 0
         self.uploads: dict[int, tuple[dict[str, np.ndarray], float]] = {}
@@ -121,8 +130,14 @@ class ServingState:
     def upload(self, device_id: int, num_samples: float,
                params: dict[str, list]) -> int:
         # decode outside the lock: per-upload array conversion is the
-        # expensive half of admission and needs no shared state
-        arrays = {k: np.asarray(v, np.float32) for k, v in params.items()}
+        # expensive half of admission and needs no shared state. Each
+        # array decodes to the EXPECTED param's dtype (self.params is
+        # replaced atomically, so an unlocked dtype read is safe); unknown
+        # keys decode through the plain boundary and fail the key check.
+        expected = self.params
+        arrays = {k: (np.asarray(v).astype(expected[k].dtype)
+                      if k in expected else _pool_asarray(v))
+                  for k, v in params.items()}
         weight = float(num_samples)
         with self.lock:
             if not (0 <= device_id < self.next_device):
@@ -145,11 +160,15 @@ class ServingState:
                                  "round discarded")
         # ... and the weighted average itself runs OUTSIDE the lock:
         # concurrent get_model/register/upload calls proceed while the
-        # O(devices x model) reduction grinds.
-        agg = {k: np.zeros_like(v) for k, v in self.params.items()}
+        # O(devices x model) reduction grinds. Accumulation runs in an f32
+        # master whatever the pool dtype (precision policy agg-in-f32
+        # rule), cast back to the pool dtype on commit.
+        agg = {k: np.zeros(v.shape, np.float32)
+               for k, v in expected.items()}
         for p, n in pending.values():
             for k in agg:
-                agg[k] += p[k] * (n / total)
+                agg[k] += p[k].astype(np.float32) * (n / total)  # lint: r7-ok (f32 master accumulator)
+        agg = {k: a.astype(expected[k].dtype) for k, a in agg.items()}
         with self.lock:
             if self.round == round_taken:   # lost only to a concurrent reset
                 self.params = agg

@@ -34,6 +34,7 @@ from feddrift_tpu.algorithms import algorithm_class, make_algorithm
 from feddrift_tpu.comm import multihost
 from feddrift_tpu.config import ExperimentConfig
 from feddrift_tpu.core.pool import ModelPool
+from feddrift_tpu.core.precision import resolve_precision
 from feddrift_tpu.core.step import TrainStep, make_optimizer
 from feddrift_tpu.data.registry import make_dataset
 from feddrift_tpu.models import create_model
@@ -78,8 +79,15 @@ class Experiment:
         # layout; empty dict = legacy 1-D clients mesh over all devices.
         self.mesh = mesh if mesh is not None \
             else make_mesh(shape=cfg.mesh_shape or None)
+        # End-to-end precision policy (core/precision.py): resolved ONCE
+        # here — "auto" reproduces the legacy dtype/compute_dtype behavior
+        # (bf16 apply boundary on TPU only), explicit presets apply on any
+        # backend. The pool is created AT param_dtype, so a bf16 policy is
+        # bf16 from the first stored leaf (optimizer moments follow).
+        self.precision = resolve_precision(cfg)
         self.pool = ModelPool.create(self.module, _sample_input(self.ds),
-                                     cfg.num_models, seed=cfg.seed + 42)
+                                     cfg.num_models, seed=cfg.seed + 42,
+                                     param_dtype=self.precision.param_dtype)
         # Commit the pool to the mesh (replicated) up front: every jitted
         # step consumes COMMITTED x/y (shard_client_arrays), so its param
         # outputs come back committed to a NamedSharding — if the t=0
@@ -114,6 +122,9 @@ class Experiment:
             server_agg=cfg.server_robust_agg,
             codec=cfg.compress_codec,
             codec_topk_frac=cfg.compress_topk_frac,
+            # Static: the resolved precision policy — drives the in-program
+            # aggregation boundary (agg_dtype) and eval-buffer dtypes.
+            precision=self.precision,
             # Static: XLA cost-capture level (obs/costmodel.py) — each
             # tracked program's first compile also harvests cost_analysis
             # (and memory_analysis under "compiled") into program_cost
@@ -366,6 +377,8 @@ class Experiment:
             clients=self.C_, num_models=self.pool.num_models,
             comm_round=cfg.comm_round, train_iterations=cfg.train_iterations,
             backend=jax.default_backend(), compute_dtype=cfg.compute_dtype,
+            precision=self.precision.name,
+            param_dtype=self.precision.param_dtype,
             seed=cfg.seed, concept_matrix=concept_matrix,
             population=cfg.population_size or None)
         if cfg.debug_checks:
@@ -377,14 +390,18 @@ class Experiment:
             self.sanitizer = Sanitizer(cfg, bus=self.events)
 
     def _make_apply(self):
-        """Forward fn honoring cfg.compute_dtype.
+        """Forward fn honoring the resolved precision policy.
 
-        'bfloat16' = mixed precision ON TPU ONLY: params and float inputs are
-        cast to bf16 at the call boundary so matmuls/convs hit the MXU at
-        full rate, logits are cast back to f32 for the loss, and gradients
-        arrive f32 through the cast ops (params themselves stay f32 — the
-        standard TPU recipe). On CPU/GPU backends bf16 is emulated and slow,
-        so the cast is skipped there; 'float32' disables it everywhere.
+        When the policy's compute dtype differs from the stored leaves,
+        params and float inputs are cast at the call boundary so
+        matmuls/convs run at compute_dtype (the MXU rate lever on TPU),
+        and logits are cast back to f32 for the loss — gradients arrive
+        through the cast ops at the PARAM dtype, the standard mixed
+        recipe. When param == compute == float32 (the f32 policy, and
+        "auto" off-TPU) the forward is the bare module apply, bit-for-bit
+        the historical program. Explicit bf16 presets run on every
+        backend; CPUs emulate bf16 slowly — a documented caveat
+        (docs/PERFORMANCE.md), not a hard-coded gate.
 
         cfg.remat additionally wraps the forward in jax.checkpoint so
         activations are rematerialized in the backward pass — trades FLOPs
@@ -392,18 +409,22 @@ class Experiment:
         keep the [M, C] pool axes resident on one chip.
         """
         module = self.module
-        if (self.cfg.compute_dtype == "bfloat16"
-                and jax.default_backend() == "tpu"):
-            def apply_fn(p, x):
-                p16 = jax.tree_util.tree_map(
-                    lambda l: l.astype(jnp.bfloat16)
-                    if l.dtype == jnp.float32 else l, p)
-                if x.dtype == jnp.float32:
-                    x = x.astype(jnp.bfloat16)
-                return module.apply({"params": p16}, x).astype(jnp.float32)
-        else:
+        pol = self.precision
+        if pol.param_dtype == "float32" and pol.compute_dtype == "float32":
             def apply_fn(p, x):
                 return module.apply({"params": p}, x)
+        else:
+            compute_dt = pol.compute_jnp
+
+            def apply_fn(p, x):
+                pc = jax.tree_util.tree_map(
+                    lambda l: l.astype(compute_dt)
+                    if jnp.issubdtype(l.dtype, jnp.floating)
+                    and l.dtype != compute_dt else l, p)
+                if jnp.issubdtype(x.dtype, jnp.floating) \
+                        and x.dtype != compute_dt:
+                    x = x.astype(compute_dt)
+                return module.apply({"params": pc}, x).astype(jnp.float32)
         if self.cfg.remat:
             apply_fn = jax.checkpoint(apply_fn)
         return apply_fn

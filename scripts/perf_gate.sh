@@ -16,18 +16,18 @@ cd "$(dirname "$0")/.."
 out="$(mktemp -d)"
 trap 'rm -rf "$out"' EXIT
 
-echo "[perf_gate 1/10] graftlint: static analysis must be clean"
+echo "[perf_gate 1/11] graftlint: static analysis must be clean"
 # cheapest stage first: the lint verb is pre-jax and runs in ~1s; a dirty
 # tree fails the gate before any bench spends minutes compiling
 python -m feddrift_tpu lint feddrift_tpu/ --strict
 
-echo "[perf_gate 2/10] warm run (populates the persistent compile cache)"
+echo "[perf_gate 2/11] warm run (populates the persistent compile cache)"
 python bench.py --smoke --cpu > "$out/warm.json"
 
-echo "[perf_gate 3/10] measured run"
+echo "[perf_gate 3/11] measured run"
 python bench.py --smoke --cpu > "$out/bench.json"
 
-echo "[perf_gate 4/10] cost-model + critical-path fields present"
+echo "[perf_gate 4/11] cost-model + critical-path fields present"
 python - "$out/bench.json" <<'EOF'
 import json, sys
 d = json.loads(open(sys.argv[1]).read().strip().splitlines()[-1])
@@ -44,7 +44,7 @@ print(f"  mfu_estimate={d['mfu_estimate']} (source={d['mfu']['source']}), "
       f"round_wall_p99_s={d['round_wall_p99_s']}")
 EOF
 
-echo "[perf_gate 5/10] critical_path on a smoke run dir"
+echo "[perf_gate 5/11] critical_path on a smoke run dir"
 # bench.py runs without an out_dir (no spans.jsonl), so the attribution
 # verb gets its own tiny recorded run: 2 iterations, per-round path.
 JAX_PLATFORMS=cpu python -m feddrift_tpu run \
@@ -68,7 +68,7 @@ print(f"  dominant_segment={d['dominant_segment']}, "
       f"host_overhead_frac_mean={d['host_overhead_frac_mean']}")
 EOF
 
-echo "[perf_gate 6/10] megastep: K=4 vs K=1 bitwise parity + zero steady recompiles"
+echo "[perf_gate 6/11] megastep: K=4 vs K=1 bitwise parity + zero steady recompiles"
 # the megastep fuses K whole iterations into one device program; the gate
 # is (a) bitwise-identical params/accuracy vs the K=1 driver and (b) no
 # jit cache growth past the single warm-up compile across blocks
@@ -101,7 +101,7 @@ print(f"  parity OK (leafdiff=0.0, {len(a4)} eval points), "
       f"megastep cache entries={n}")
 EOF
 
-echo "[perf_gate 7/10] composed megastep: population+hierarchy K=4 parity + throughput"
+echo "[perf_gate 7/11] composed megastep: population+hierarchy K=4 parity + throughput"
 # the megastep gate is per-feature: population cohorts, hierarchy and
 # chaos schedules all fuse now. Gate is (a) bitwise parity (params, eval
 # series, registry bookkeeping) vs the K=1 driver, (b) no megastep jit
@@ -182,7 +182,7 @@ print(f"  parity OK (leafdiff=0.0, {len(a4)} eval points); "
 assert r4 >= r1, f"composed K=4 slower than its own K=1: {r4:.1f} vs {r1:.1f}"
 EOF
 
-echo "[perf_gate 8/10] serving: batched >= 3x unbatched rps, zero steady recompiles"
+echo "[perf_gate 8/11] serving: batched >= 3x unbatched rps, zero steady recompiles"
 # The cluster-routed read path (platform/serving.py): warm every bucket,
 # drive a seeded closed loop twice — unbatched (bucket set {1}) and
 # batched — and hold (a) an absolute unbatched requests/s floor (sanity:
@@ -238,7 +238,65 @@ assert un["requests_per_s"] >= 200, \
 assert ratio >= 3.0, f"micro-batching payoff collapsed: {ratio:.2f}x"
 EOF
 
-echo "[perf_gate 9/10] regress: self-comparison (warm), then vs BENCH_r05.json"
+echo "[perf_gate 9/11] precision: bf16_mixed smoke (accuracy + recompiles) + artifact gate"
+# End-to-end precision policy (core/precision.py): a fast fnn smoke proves
+# the policy actually reaches the compiled round program — bf16 pool
+# params, one jit signature per function under BOTH policies (dtype flips
+# must not retrace in steady state), accuracy within the regress
+# tolerance of the paired f32 run, and a live per-policy cost-model
+# capture. The hard HBM/wire ceilings (bytes_accessed <= 0.60x, wire
+# bytes <= 0.55x of f32) are properties of the COMPUTE-BOUND resnet8
+# preset, not of a 62-param fnn (cast sites and f32 loss/eval terms
+# dominate at toy scale), so those gates run via `regress` on the
+# committed PRECISION_r15.json rows below rather than re-measuring.
+JAX_PLATFORMS=cpu python - <<'EOF'
+from feddrift_tpu.config import ExperimentConfig
+from feddrift_tpu.obs import costmodel
+from feddrift_tpu.simulation.runner import Experiment
+
+BASE = dict(dataset="sea", model="fnn", concept_drift_algo="softcluster",
+            concept_drift_algo_arg="H_A_C_1_10_0", concept_num=4,
+            change_points="A", client_num_in_total=4, client_num_per_round=4,
+            train_iterations=8, comm_round=4, epochs=1, batch_size=50,
+            sample_num=50, frequency_of_the_test=4, megastep_k=4, seed=7,
+            trace_sync=True, cost_model="compiled")
+
+def run(policy):
+    costmodel.clear()
+    exp = Experiment(ExperimentConfig(**BASE, precision=policy))
+    exp.run()
+    ba = sum((c.lowered_bytes_accessed or c.bytes_accessed or 0)
+             for c in costmodel.costs().values())
+    sigs = {k: len(v) for k, v in exp.step._signatures.items()}
+    return exp, ba, sigs
+
+e32, ba32, sig32 = run("f32")
+e16, ba16, sig16 = run("bf16_mixed")
+import jax
+dts = {str(l.dtype) for l in jax.tree_util.tree_leaves(e16.pool.params)}
+assert dts == {"bfloat16"}, f"bf16_mixed pool params not bf16: {dts}"
+for name, sigs in (("f32", sig32), ("bf16_mixed", sig16)):
+    bad = {k: n for k, n in sigs.items() if n != 1}
+    assert not bad, f"{name}: steady-state retraces: {bad}"
+assert ba32 > 0 and ba16 > 0, \
+    f"per-policy cost-model capture empty: f32={ba32} bf16={ba16}"
+a32 = e32.logger.last("Test/Acc")
+a16 = e16.logger.last("Test/Acc")
+assert abs(a16 - a32) <= 0.05, \
+    f"bf16_mixed accuracy drifted past tolerance: {a16} vs f32 {a32}"
+print(f"  acc f32={a32:.3f} bf16_mixed={a16:.3f} (tol 0.05), "
+      f"bytes_accessed ratio={ba16 / ba32:.2f} (info-only at fnn scale), "
+      f"jit signatures/fn=1 under both policies")
+EOF
+# committed resnet8-on-FMoW artifact: the regress PRECISION axis holds
+# the absolute ceilings (bytes_accessed <= 0.60x and wire <= 0.55x of
+# the paired f32 row for bf16_mixed, steady_recompiles == 0, accuracy
+# within --tol-precision-acc of the same run's f32 row) — a
+# self-comparison still fails if any committed row violates them
+python -m feddrift_tpu regress PRECISION_r15.json \
+    --baseline PRECISION_r15.json --tol-precision-acc 0.05
+
+echo "[perf_gate 10/11] regress: self-comparison (warm), then vs BENCH_r05.json"
 # back-to-back smoke runs on a busy 1-core host: generous relative noise
 # margins, but identical round counts make every metric comparable
 python -m feddrift_tpu regress "$out/bench.json" --baseline "$out/warm.json" \
@@ -249,7 +307,7 @@ python -m feddrift_tpu regress "$out/bench.json" --baseline "$out/warm.json" \
 python -m feddrift_tpu regress "$out/bench.json" --baseline BENCH_r05.json \
     --tol-rounds 0.9 --tol-acc 0.15
 
-echo "[perf_gate 10/10] ops plane overhead: enabled run within 2% of disabled"
+echo "[perf_gate 11/11] ops plane overhead: enabled run within 2% of disabled"
 # The /metrics + /healthz server, SLO engine and status tap must stay off
 # the hot path. Resolving a 2% bound on a noisy 1-core host needs a
 # paired design: BOTH experiments live in one process, iterations

@@ -81,8 +81,14 @@ GOLDEN = {
         def body(x, steps):
             return x
         """,
+    "R7": """\
+        import numpy as np
+
+        def decode(pool):
+            return pool.astype(np.float32)
+        """,
 }
-GOLDEN_LINE = {"R1": 2, "R2": 3, "R3": 16, "R4": 4, "R5": 4}
+GOLDEN_LINE = {"R1": 2, "R2": 3, "R3": 16, "R4": 4, "R5": 4, "R7": 4}
 
 
 @pytest.mark.parametrize("rule", sorted(GOLDEN))
@@ -245,6 +251,39 @@ def test_r5_matching_signature_clean(engine, tmp_path):
         """
     _, findings = _lint_file(engine, tmp_path, "goodjit.py", src)
     assert findings == []
+
+
+def test_r7_asarray_dtype_kwarg_flagged(engine, tmp_path):
+    src = """\
+        import numpy as np
+
+        def coerce(update):
+            return np.asarray(update, dtype=np.float32)
+        """
+    _, findings = _lint_file(engine, tmp_path, "coerce.py", src)
+    assert [f.rule for f in findings] == ["R7"]
+    assert findings[0].line == 4
+
+
+def test_r7_dtype_preserving_calls_clean(engine, tmp_path):
+    src = """\
+        import numpy as np
+
+        def keep(update, expected):
+            a = np.asarray(update)
+            b = update.astype(expected.dtype)
+            c = update.astype(np.int32)
+            return a, b, c
+        """
+    _, findings = _lint_file(engine, tmp_path, "keep.py", src)
+    assert [f for f in findings if f.rule == "R7"] == []
+
+
+def test_r7_only_applies_to_pool_modules_in_package(engine):
+    # simulation/runner.py is outside the R7 pool/update module set: its
+    # f32 ensemble-weight coercions are report-path, must not fire R7
+    findings = engine.run([os.path.join(PKG, "simulation", "runner.py")])
+    assert [f for f in findings if f.rule == "R7"] == []
 
 
 def test_r5_donated_read_after_dispatch(engine, tmp_path):

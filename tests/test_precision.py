@@ -1,0 +1,478 @@
+"""End-to-end precision policy (core/precision.py): preset resolution,
+pool/opt-state storage dtype, the agg-in-f32 aggregation boundary, wire
+frames at the policy dtype, and the serving plane's dtype preservation.
+
+The load-bearing contracts pinned here:
+
+- the ``f32`` policy is BITWISE identical to the historical default
+  ("auto" off-TPU) — every cast site is a same-dtype identity, so
+  enabling the policy machinery costs nothing on existing runs;
+- one policy, three drivers: per-round host loop, fused single-iteration
+  scan and the K>1 megastep must agree bitwise under bf16 too — the
+  policy threads through all three compiled paths, not just one;
+- robust aggregation is structural: trimmed-mean/krum active/rejected
+  counts are identical across policies (the f32 aggregation master keeps
+  sort order; the trim count is a function of participation, not values);
+- zero steady-state recompiles per policy after warmup — a policy is ONE
+  jit signature, not a per-round dtype lottery;
+- wire frames declare and honor their dtype: bf16 halves the "none"
+  payload, decoders reject undeclared widths instead of misparsing.
+"""
+
+import jax
+import jax.numpy as jnp
+import ml_dtypes
+import numpy as np
+import pytest
+
+from feddrift_tpu import obs
+from feddrift_tpu.comm.compress import (CorruptFrameError, UpdateReceiver,
+                                        UpdateSender, decode_frame,
+                                        encode_frame, simulate_codec)
+from feddrift_tpu.comm.pubsub import Broker
+from feddrift_tpu.config import ExperimentConfig
+from feddrift_tpu.core.pool import ModelPool
+from feddrift_tpu.core.precision import (PRESETS, PrecisionPolicy,
+                                         cast_floating, match_dtypes,
+                                         resolve_precision)
+from feddrift_tpu.data.registry import make_dataset
+from feddrift_tpu.models import create_model
+from feddrift_tpu.platform.serving import ServingState
+from feddrift_tpu.simulation.runner import Experiment, run_experiment
+
+BF16 = np.dtype(ml_dtypes.bfloat16)
+
+
+def _cfg(**kw):
+    base = dict(dataset="sea", model="lr", concept_drift_algo="oblivious",
+                concept_drift_algo_arg="", concept_num=1,
+                client_num_in_total=8, client_num_per_round=8,
+                train_iterations=6, comm_round=3, epochs=1, batch_size=50,
+                sample_num=50, frequency_of_the_test=3, lr=0.05,
+                seed=7, trace_sync=True)
+    base.update(kw)
+    return ExperimentConfig(**base)
+
+
+def _leafdiff(a, b):
+    la, lb = jax.tree_util.tree_leaves(a), jax.tree_util.tree_leaves(b)
+    assert len(la) == len(lb)
+    return max(float(np.max(np.abs(np.asarray(x, np.float32)
+                                   - np.asarray(y, np.float32))))
+               for x, y in zip(la, lb))
+
+
+def _float_dtypes(tree):
+    return {str(l.dtype) for l in jax.tree_util.tree_leaves(tree)
+            if jnp.issubdtype(l.dtype, jnp.floating)}
+
+
+# ---------------------------------------------------------------- policy
+class TestPolicyResolution:
+    def test_presets(self):
+        f32 = PRESETS["f32"]
+        assert f32.is_f32
+        assert (f32.param_dtype, f32.compute_dtype, f32.agg_dtype,
+                f32.eval_dtype, f32.wire_dtype) == ("float32",) * 5
+        mixed = PRESETS["bf16_mixed"]
+        assert (mixed.param_dtype, mixed.compute_dtype,
+                mixed.wire_dtype) == ("bfloat16",) * 3
+        # the guide rule: accumulate in f32, store in bf16
+        assert mixed.agg_dtype == "float32"
+        assert mixed.eval_dtype == "float32"
+        pure = PRESETS["bf16_pure"]
+        assert (pure.param_dtype, pure.compute_dtype, pure.agg_dtype,
+                pure.eval_dtype, pure.wire_dtype) == ("bfloat16",) * 5
+
+    def test_auto_off_tpu_is_f32(self):
+        pol = resolve_precision(_cfg(), backend="cpu")
+        assert pol.is_f32 and pol.param_dtype == "float32"
+
+    def test_auto_on_tpu_keeps_bf16_apply_boundary(self):
+        pol = resolve_precision(_cfg(compute_dtype="bfloat16"),
+                                backend="tpu")
+        assert pol.compute_dtype == "bfloat16"
+        assert pol.param_dtype == "float32"
+
+    def test_explicit_preset_ignores_backend(self):
+        for backend in ("cpu", "tpu", None):
+            pol = resolve_precision(_cfg(precision="bf16_mixed"),
+                                    backend=backend)
+            assert pol is PRESETS["bf16_mixed"]
+
+    def test_unknown_precision_rejected(self):
+        with pytest.raises(ValueError, match="precision"):
+            _cfg(precision="fp8")
+        with pytest.raises(ValueError):
+            PrecisionPolicy(param_dtype="float16")
+
+    def test_cast_floating_skips_ints_and_same_dtype_identity(self):
+        tree = {"w": jnp.ones((2, 2), jnp.float32),
+                "n": jnp.ones((2,), jnp.int32)}
+        out = cast_floating(tree, "bfloat16")
+        assert str(out["w"].dtype) == "bfloat16"
+        assert out["n"] is tree["n"]          # ints untouched
+        same = cast_floating(tree, "float32")
+        assert same["w"] is tree["w"]         # identity, no new op
+
+    def test_match_dtypes_follows_reference_leaves(self):
+        tree = {"a": jnp.ones((3,), jnp.float32),
+                "b": jnp.ones((3,), jnp.float32)}
+        like = {"a": jnp.ones((5,), jnp.bfloat16),   # shapes may differ
+                "b": jnp.ones((5,), jnp.float32)}
+        out = match_dtypes(tree, like)
+        assert str(out["a"].dtype) == "bfloat16"
+        assert out["b"] is tree["b"]
+
+
+# ---------------------------------------------------------------- pool
+class TestPoolParamDtype:
+    def _pool(self, **kw):
+        cfg = ExperimentConfig(dataset="sea", train_iterations=2,
+                               sample_num=16)
+        ds = make_dataset(cfg)
+        mod = create_model("fnn", ds, cfg)
+        return ModelPool.create(mod, jnp.zeros((2, 3)), 3, seed=7, **kw)
+
+    def test_pool_stored_at_param_dtype(self):
+        pool = self._pool(param_dtype="bfloat16")
+        assert _float_dtypes(pool.params) == {"bfloat16"}
+
+    def test_reinit_slot_preserves_dtype(self):
+        pool = self._pool(param_dtype="bfloat16", identical=True)
+        pool.reinit_slot(1)
+        assert _float_dtypes(pool.params) == {"bfloat16"}
+
+    def test_distinct_reinit_slot_preserves_dtype(self):
+        pool = self._pool(param_dtype="bfloat16")
+        pool.distinct_reinit_slot(2, seed=123)
+        assert _float_dtypes(pool.params) == {"bfloat16"}
+
+
+# ---------------------------------------------------------------- e2e
+class TestPolicyParity:
+    def test_f32_policy_bitwise_backcompat(self):
+        # enabling the policy machinery must not perturb a single bit of
+        # the historical default path
+        e_auto = run_experiment(_cfg())               # precision="auto"
+        e_f32 = run_experiment(_cfg(precision="f32"))
+        assert _leafdiff(e_auto.pool.params, e_f32.pool.params) == 0.0
+        assert e_auto.logger.series("Test/Acc") == \
+            e_f32.logger.series("Test/Acc")
+        assert _float_dtypes(e_f32.pool.params) == {"float32"}
+
+    def test_bf16_mixed_accuracy_within_tolerance(self):
+        e_f32 = run_experiment(_cfg(precision="f32"))
+        e_mix = run_experiment(_cfg(precision="bf16_mixed"))
+        assert _float_dtypes(e_mix.pool.params) == {"bfloat16"}
+        a32 = e_f32.logger.last("Test/Acc")
+        a16 = e_mix.logger.last("Test/Acc")
+        assert abs(a32 - a16) <= 0.1, (a32, a16)
+
+    def test_bf16_pure_trains(self):
+        e = run_experiment(_cfg(precision="bf16_pure"))
+        assert _float_dtypes(e.pool.params) == {"bfloat16"}
+        assert e.logger.last("Test/Acc") > 0.6
+
+    def test_opt_state_follows_param_dtype(self):
+        # optimizer moments are the dominant resident [M, C, ...] buffers:
+        # they must inherit the bf16 storage, not silently stay f32
+        exp = Experiment(_cfg(precision="bf16_mixed"))
+        opt = exp.step.init_opt_states(
+            exp.pool.params, exp.pool.num_models, exp.C_pad)
+        assert _float_dtypes(opt) <= {"bfloat16"}
+
+    def test_three_drivers_bitwise_under_bf16(self):
+        # one policy, three compiled paths: the per-round host loop, the
+        # fused single-iteration scan and the K=4 megastep must produce
+        # the SAME bf16 pool — the policy is threaded, not re-derived
+        kw = dict(precision="bf16_mixed", train_iterations=8)
+        e_round = run_experiment(_cfg(chunk_rounds=False, **kw))
+        e_fused = run_experiment(_cfg(megastep_k=1, **kw))
+        e_mega = run_experiment(_cfg(megastep_k=4, **kw))
+        assert "train_megastep" in e_mega.step._signatures
+        assert _leafdiff(e_round.pool.params, e_fused.pool.params) == 0.0
+        assert _leafdiff(e_fused.pool.params, e_mega.pool.params) == 0.0
+        assert e_round.logger.series("Test/Acc") == \
+            e_mega.logger.series("Test/Acc")
+
+    def test_robust_agg_counts_identical_across_policies(self):
+        # trimmed-mean trims a FIXED per-coordinate count: the defense's
+        # active/rejected bookkeeping is participation-structural, so a
+        # precision change must not alter a single count
+        kw = dict(byzantine_clients="0,3", robust_agg="trimmed_mean",
+                  robust_trim_frac=0.3)
+
+        def stats(exp):
+            return [(e["strategy"], e["active"], e["rejected"], e["clipped"])
+                    for e in exp.events.ring
+                    if e["kind"] == "robust_agg_applied"]
+
+        e_f32 = run_experiment(_cfg(precision="f32", **kw))
+        e_mix = run_experiment(_cfg(precision="bf16_mixed", **kw))
+        s32, s16 = stats(e_f32), stats(e_mix)
+        assert s32 and s32 == s16
+        assert any(r[2] > 0 for r in s32)     # non-vacuous: trims happened
+
+    def test_zero_recompiles_after_warmup_per_policy(self):
+        # 8 iterations at K=4 = two blocks; block 2 must replay block 1's
+        # signature under bf16 exactly as it does under f32
+        for precision in ("f32", "bf16_mixed"):
+            exp = Experiment(_cfg(precision=precision, megastep_k=4,
+                                  train_iterations=8))
+            t = exp.run_megastep(0, exp._megastep_span(0))
+            n0 = exp.step._train_megastep_jit._cache_size()
+            sigs0 = len(exp.step._signatures["train_megastep"])
+            assert sigs0 == 1
+            while t < exp.cfg.train_iterations:
+                t += exp.run_megastep(t, exp._megastep_span(t))
+            assert exp.step._train_megastep_jit._cache_size() == n0
+            assert len(exp.step._signatures["train_megastep"]) == 1
+
+    def test_run_start_event_names_policy(self):
+        exp = Experiment(_cfg(precision="bf16_mixed"))
+        starts = [e for e in exp.events.ring if e["kind"] == "run_start"]
+        assert starts and starts[-1]["precision"] == "bf16_mixed"
+        assert starts[-1]["param_dtype"] == "bfloat16"
+
+
+# ---------------------------------------------------------------- wire
+RNG = np.random.RandomState(0)
+ARR32 = RNG.randn(40, 37).astype(np.float32)
+ARR16 = ARR32.astype(BF16)
+
+
+class TestWireDtype:
+    def test_frames_declare_actual_dtype(self):
+        assert encode_frame(ARR32, "none")["dtype"] == "float32"
+        assert encode_frame(ARR16, "none")["dtype"] == "bfloat16"
+
+    def test_bf16_none_roundtrip_halves_payload(self):
+        import base64
+        f32, f16 = encode_frame(ARR32, "none"), encode_frame(ARR16, "none")
+        raw32 = len(base64.b64decode(f32["p"]["data"]))
+        raw16 = len(base64.b64decode(f16["p"]["data"]))
+        assert raw16 * 2 == raw32
+        out = decode_frame(f16)
+        assert out.dtype == BF16 and (out == ARR16).all()
+
+    def test_bf16_int8_roundtrip(self):
+        out = decode_frame(encode_frame(ARR16, "int8"))
+        assert out.dtype == BF16
+        a = ARR16.astype(np.float32)
+        step = (a.max() - a.min()) / 255.0
+        assert np.abs(out.astype(np.float32) - a).max() <= step / 2 + 0.01
+
+    def test_bf16_delta_chain_carries_dtype(self):
+        prev = None
+        for _ in range(4):
+            arr = RNG.randn(30, 11).astype(np.float32).astype(BF16)
+            out = decode_frame(encode_frame(arr, "delta", prev=prev),
+                               prev=prev)
+            assert out.dtype == BF16
+            assert np.abs(out.astype(np.float32)
+                          - arr.astype(np.float32)).max() < 0.1
+            prev = out
+
+    def test_undeclared_dtype_rejected(self):
+        from feddrift_tpu.comm.compress import _digest
+        frame = encode_frame(ARR32.astype(np.float64), "none")
+        assert frame["dtype"] == "float32"    # normalized at encode
+        frame = encode_frame(ARR32, "none")
+        # an unmodified forgery dies on the digest; re-sign it so the
+        # decoder's own dtype whitelist is what rejects it
+        frame["dtype"] = "float64"
+        frame["digest"] = _digest(frame)
+        with pytest.raises(CorruptFrameError, match="dtype"):
+            decode_frame(frame)
+
+    def test_width_mismatch_rejected(self):
+        from feddrift_tpu.comm.compress import _digest
+        # a frame that declares f32 but carries a bf16-width payload must
+        # fail the length check, not silently misparse
+        frame = encode_frame(ARR16, "none")
+        frame["dtype"] = "float32"
+        frame["digest"] = _digest(frame)
+        with pytest.raises(CorruptFrameError, match="length"):
+            decode_frame(frame)
+
+    def test_sender_wire_bytes_halve_for_bf16(self):
+        # the raw-bytes baseline is the ACTUAL dtype's width: a bf16 link
+        # reports half the f32 link's bytes instead of pretending every
+        # update is 4 bytes/element
+        obs.configure(None)
+        broker = Broker()
+        tx = UpdateSender(broker, "fl/u", codec="int8")
+        rx = UpdateReceiver(broker, "fl/u")
+        tx.send("u32", ARR32)
+        tx.send("u16", ARR16)
+        _, got32 = rx.recv(timeout=1.0)
+        _, got16 = rx.recv(timeout=1.0)
+        assert got32.dtype == np.float32 and got16.dtype == BF16
+        evs = obs.get_bus().events("update_compressed")
+        by_name = {e["update"]: e for e in evs
+                   if e["update"] in ("u32", "u16")}
+        # raw_bytes is the would-be uncompressed frame at the ACTUAL
+        # dtype; base64 payload halves, headers add a fixed tail
+        assert by_name["u16"]["raw_bytes"] < 0.55 * by_name["u32"]["raw_bytes"]
+
+    def test_simulate_codec_preserves_stack_dtype(self):
+        # device-side codec simulation mirrors the wire contract: quantize
+        # in f32 arithmetic, return the stack's own dtype (int8-from-bf16
+        # without a silent upcast of the [M, C, ...] update stack)
+        stack32 = jnp.asarray(RNG.randn(2, 3, 16)).astype(jnp.float32)
+        out32, _ = simulate_codec((stack32,), "int8")
+        assert out32[0].dtype == jnp.float32
+        stack16 = stack32.astype(jnp.bfloat16)
+        for codec in ("int8", "topk"):
+            out16, _ = simulate_codec((stack16,), codec, topk_frac=0.25)
+            assert out16[0].dtype == jnp.bfloat16
+
+
+# ---------------------------------------------------------------- serving
+class TestServingDtype:
+    def test_pool_dtype_preserved_end_to_end(self):
+        init = {"w": np.arange(6, dtype=np.float32).reshape(2, 3)
+                .astype(BF16), "b": np.zeros(3, np.float32)}
+        state = ServingState(init)
+        assert state.params["w"].dtype == BF16
+        d0, d1 = state.register(), state.register()
+        up = {k: np.asarray(v, np.float32).tolist()
+              for k, v in init.items()}
+        state.upload(d0, 10.0, up)
+        r = state.upload(d1, 30.0, up)
+        assert r == 1
+        # aggregation ran through the f32 master and committed back at
+        # the POOL dtype — no silent upcast
+        assert state.params["w"].dtype == BF16
+        assert state.params["b"].dtype == np.float32
+
+    def test_json_decode_boundary_still_f32_for_f32_pool(self):
+        state = ServingState({"w": np.zeros((2, 2), np.float32)})
+        state.register()
+        state.upload(0, 1.0, {"w": [[1.0, 2.0], [3.0, 4.0]]})
+        assert state.params["w"].dtype == np.float32
+
+
+# ---------------------------------------------------------------- norm
+class TestHalfWidthNorm:
+    """models/resnet.py _Norm: the bf16 branch must stay half-width.
+
+    jnp reductions upcast bf16 inputs by materialising a full-size f32
+    copy of the feature map; the norm's half-width branch accumulates the
+    moments through an f32-preferring dot instead. The gate is on the
+    LOWERED HLO: no full-size f32 tensor may appear in a bf16 norm."""
+
+    def _norm(self):
+        from feddrift_tpu.models.resnet import _Norm
+        return _Norm("batch")
+
+    def test_bf16_norm_close_to_f32(self):
+        rng = np.random.RandomState(0)
+        x32 = jnp.asarray(rng.normal(2.0, 3.0, (8, 8, 8, 16))
+                          .astype(np.float32))
+        norm = self._norm()
+        params = norm.init(jax.random.PRNGKey(0), x32)
+        y32 = norm.apply(params, x32)
+        p16 = jax.tree_util.tree_map(
+            lambda l: l.astype(jnp.bfloat16), params)
+        y16 = norm.apply(p16, x32.astype(jnp.bfloat16))
+        assert y16.dtype == jnp.bfloat16
+        # normalised output is ~unit-scale; bf16 carries ~2-3 decimal
+        # digits, and the E[x^2]-E[x]^2 moments ride an f32 accumulator
+        diff = np.max(np.abs(np.asarray(y16, dtype=np.float32)
+                             - np.asarray(y32)))
+        assert diff < 0.1, diff
+
+    def test_bf16_norm_lowers_without_f32_feature_map(self):
+        norm = self._norm()
+        x16 = jnp.zeros((8, 8, 8, 16), jnp.bfloat16)
+        p16 = jax.tree_util.tree_map(
+            lambda l: l.astype(jnp.bfloat16),
+            norm.init(jax.random.PRNGKey(0), x16))
+        txt = jax.jit(norm.apply).lower(p16, x16).as_text()
+        assert "tensor<8x8x8x16xf32>" not in txt
+        assert "tensor<4096x16xf32>" not in txt      # reshaped view
+
+    def test_f32_norm_path_unchanged(self):
+        # the f32 branch is the pre-policy program: mean/var directly
+        rng = np.random.RandomState(1)
+        x = jnp.asarray(rng.normal(size=(4, 8, 8, 8)).astype(np.float32))
+        norm = self._norm()
+        params = norm.init(jax.random.PRNGKey(0), x)
+        y = norm.apply(params, x)
+        mean = np.asarray(x).mean(axis=(0, 1, 2), keepdims=True)
+        var = np.asarray(x).var(axis=(0, 1, 2), keepdims=True)
+        ref = (np.asarray(x) - mean) / np.sqrt(var + 1e-5)
+        assert y.dtype == jnp.float32
+        np.testing.assert_allclose(np.asarray(y), ref, rtol=2e-5,
+                                   atol=2e-5)
+
+
+# ---------------------------------------------------------------- regress
+class TestPrecisionRegressAxis:
+    def _rows(self, policy, rps, acc, rec=0, br=None, wr=None):
+        e = {"variant": "resnet", "policy": policy, "rounds_per_sec": rps,
+             "final_test_acc": acc, "steady_recompiles": rec}
+        if br is not None:
+            e["bytes_accessed_ratio"] = br
+        if wr is not None:
+            e["wire_bytes_ratio"] = wr
+        return e
+
+    def _artifact(self, rps16=8.0, acc16=0.70, rec=0, br=0.5, wr=0.5):
+        return {"precision": [
+            self._rows("f32", 6.0, 0.72),
+            self._rows("bf16_mixed", rps16, acc16, rec, br, wr)]}
+
+    def test_ok_and_absolute_ceiling_gates(self):
+        from feddrift_tpu.obs.regress import compare
+        base = self._artifact()
+        ok = compare(self._artifact(rps16=7.8, acc16=0.69), base)
+        ms = {r["metric"]: r for r in ok
+              if r["metric"].startswith("precision")}
+        assert ms["precision[resnet:bf16_mixed].rounds_per_s"][
+            "status"] == "ok"
+        assert ms["precision[resnet:bf16_mixed].final_test_acc"][
+            "status"] == "ok"
+        assert ms["precision[resnet:bf16_mixed].bytes_accessed_ratio"][
+            "status"] == "ok"
+        assert ms["precision[resnet:bf16_mixed].wire_bytes_ratio"][
+            "status"] == "ok"
+        # the ratio/recompile/accuracy gates are ABSOLUTE: a baseline
+        # that itself regressed cannot grandfather a bad candidate in
+        bad = compare(self._artifact(acc16=0.60, rec=1, br=0.8, wr=0.7),
+                      self._artifact(acc16=0.60, rec=1, br=0.8, wr=0.7))
+        ms = {r["metric"]: r for r in bad
+              if r["metric"].startswith("precision")}
+        assert ms["precision[resnet:bf16_mixed].final_test_acc"][
+            "status"] == "regress"      # 0.60 < own f32 0.72 - 0.05
+        assert ms["precision[resnet:bf16_mixed].steady_recompiles"][
+            "status"] == "regress"
+        assert ms["precision[resnet:bf16_mixed].bytes_accessed_ratio"][
+            "status"] == "regress"      # 0.8 > 0.60 ceiling
+        assert ms["precision[resnet:bf16_mixed].wire_bytes_ratio"][
+            "status"] == "regress"      # 0.7 > 0.55 ceiling
+
+    def test_acc_gate_is_vs_own_f32_row_and_f32_row_exempt(self):
+        from feddrift_tpu.obs.regress import compare
+        rows = compare(self._artifact(), self._artifact())
+        named = [r["metric"] for r in rows
+                 if r["metric"].startswith("precision")]
+        # the f32 row carries no precision-acc gate (it IS the reference)
+        assert "precision[resnet:f32].final_test_acc" not in named
+        assert "precision[resnet:bf16_mixed].final_test_acc" in named
+
+    def test_missing_variant_point_skips(self):
+        from feddrift_tpu.obs.regress import compare
+        base = {"precision": [self._rows("f32", 6.0, 0.72)]}
+        rows = compare(self._artifact(), base)
+        ms = {r["metric"]: r for r in rows
+              if r["metric"].startswith("precision")}
+        assert ms["precision[resnet:bf16_mixed]"]["status"] == "skip"
+
+    def test_baseline_without_axis_skips(self):
+        from feddrift_tpu.obs.regress import compare
+        rows = compare({"value": 1.0}, self._artifact())
+        skips = [r for r in rows if r["metric"] == "precision"]
+        assert skips and skips[0]["status"] == "skip"
