@@ -625,7 +625,8 @@ def _serve_bench(smoke: bool) -> list:
                                   concurrency=concurrency)
             tg.run(max(requests // 10, 50))    # closed-loop warm (threads,
             rec0 = _serve_recompiles()         # queues, branch caches)
-            stats = tg.run(requests)
+            eng.reset_latency_stats()          # sketch covers measured
+            stats = tg.run(requests)           # traffic only, not warm-up
             recompiles = _serve_recompiles() - rec0
         finally:
             eng.close()
@@ -653,6 +654,280 @@ def _serve_bench(smoke: bool) -> list:
         print(json.dumps({"partial": f"serve@{max_bucket}", **row}),
               file=sys.stderr)
     return out
+
+
+def _quality_bench(smoke: bool) -> dict:
+    """Model-quality plane axis (ISSUE 16): seeded drifting-traffic serve
+    bench behind QUALITY_r1*.json, gated by `regress` on three absolute
+    acceptance bars plus the usual relative throughput/p99 tolerances:
+
+    - the streaming live-accuracy estimate (delayed-label join feeding
+      windowed per-model accuracy) lands within --tol-quality-acc of the
+      offline oracle computed client-side over the SAME labeled stream;
+    - a clean merge (two slots holding bitwise-identical params) canary-
+      COMMITS, and a deliberately wrong merge (survivor slot holds an
+      anti-model: the classifier layer negated, so re-homed clients get
+      flipped logits) canary-ROLLS-BACK — verdict events carry lineage
+      ids, and no OTHER canary ever rolls back (clean_canary_rollbacks);
+    - shadow duplicate-execution costs < 5% requests/s vs canary-off on
+      identical traffic, at ZERO steady-state recompiles (the shadow
+      forward replays the warmed bucket signatures).
+    """
+    import threading
+
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+
+    from feddrift_tpu import obs
+    from feddrift_tpu.core.pool import ModelPool
+    from feddrift_tpu.data.registry import make_dataset
+    from feddrift_tpu.models import create_model
+    from feddrift_tpu.platform.canary import CanaryController
+    from feddrift_tpu.platform.serving import (InferenceEngine, RoutingTable,
+                                               TrafficGenerator)
+
+    cfg = _canonical_cfg(True, train_iterations=1, comm_round=1)
+    ds = make_dataset(cfg)
+    module = create_model(cfg.model, ds, cfg)
+    sample = jnp.asarray(ds.x[0, 0, :2])
+    pool = ModelPool.create(module, sample, cfg.num_models,
+                            seed=cfg.seed + 42, identical=False)
+    # slot 1 := slot 0 — two clusters whose models genuinely converged;
+    # merging them is the GOOD swap (shadow answers match live bitwise)
+    pool.copy_slot(1, 0)
+    # slot 2 := slot 3 with the classifier layer negated — a corrupt
+    # survivor; merging 3 into 2 is the DELIBERATELY WRONG swap (the
+    # candidate generation answers re-homed clients with flipped logits)
+    p3 = pool.slot(3)
+    last_layer = sorted(p3.keys())[-1]
+    pool.set_slot(2, {k: (jax.tree_util.tree_map(lambda a: -a, v)
+                          if k == last_layer else v)
+                      for k, v in p3.items()})
+
+    population = 64
+    rng = np.random.RandomState(14)
+    routing = RoutingTable(rng.randint(0, cfg.num_models, size=population))
+    window = 200 if smoke else 400
+    eps = 0.1               # label noise: live accuracy targets ~0.9
+    eng = InferenceEngine(pool, routing, quality_window=window).start()
+    ctl = CanaryController(eng, fraction=1.0, min_samples=48,
+                           acc_margin=0.02, seed=3, timeout_s=600.0)
+    # genesis history so verdict lineage ids resolve through the DAG
+    for m in range(cfg.num_models):
+        ctl.note_event({"kind": "cluster_create", "model": m,
+                        "iteration": 0})
+    eng.attach_canary(ctl)
+
+    def _serve_recompiles() -> int:
+        snap = obs.registry().snapshot()
+        return sum(int(v) for k, v in snap.items()
+                   if k.startswith('jit_recompiles{fn="serve_forward'))
+
+    num_classes = int(np.asarray(eng.step.forward(
+        eng._gen.params,
+        jnp.zeros((1,) + eng._example_shape, dtype=eng._example_dtype),
+        jnp.zeros((1,), dtype=jnp.int32))).shape[-1])
+
+    lock = threading.Lock()
+    oracle: list = []        # (model, correct) from the client's own view
+
+    def labeled_run(n: int, seed: int, concurrency: int = 8,
+                    record: bool = False) -> None:
+        """Closed-loop labeled traffic: submit, then close the delayed-
+        label loop with y = served prediction flipped with prob eps —
+        the client-side (pred == y) log IS the offline oracle."""
+        per = [n // concurrency] * concurrency
+        for i in range(n % concurrency):
+            per[i] += 1
+
+        def worker(w: int) -> None:
+            wr = np.random.RandomState(
+                (seed * 1_000_003 + w * 7_919 + 1) % (2**31 - 1))
+            recs = []
+            for _ in range(per[w]):
+                c = int(wr.randint(population))
+                x = wr.standard_normal(eng._example_shape).astype(
+                    eng._example_dtype, copy=False)
+                try:
+                    res = eng.submit(c, x, timeout=30.0)
+                except Exception:   # noqa: BLE001 — keep the loop closed
+                    continue
+                pred = int(np.argmax(res.logits))
+                y = pred if wr.uniform() >= eps else \
+                    int((pred + 1 + wr.randint(num_classes - 1))
+                        % num_classes)
+                eng.observe_label(res.request_id, y)
+                recs.append((int(res.model), pred == y))
+            if record:
+                with lock:
+                    oracle.extend(recs)
+
+        threads = [threading.Thread(target=worker, args=(w,), daemon=True)
+                   for w in range(concurrency)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+
+    try:
+        eng.warmup()
+        TrafficGenerator(eng, clients=range(population), seed=14,
+                         concurrency=8).run(100)    # unlabeled warm
+        rec0 = _serve_recompiles()
+
+        # phase A — clean labeled traffic: streaming estimate vs oracle
+        n_a = window * 2
+        labeled_run(n_a, seed=21, record=True)
+        snap_a = eng.quality.snapshot()
+        per_model = {}
+        gaps = [0.0]
+        by_model: dict = {}
+        for m, ok in oracle:
+            by_model.setdefault(m, []).append(ok)
+        for m, oks in sorted(by_model.items()):
+            # oracle over the estimator's own window, not all history —
+            # both sides then summarize the same tail of the stream
+            tail = oks[-window:]
+            o = float(np.mean(tail))
+            lw = (snap_a.get("per_model") or {}).get(str(m)) or {}
+            live = lw.get("accuracy")
+            row = {"oracle_accuracy": round(o, 4),
+                   "live_accuracy": live, "labeled": len(oks)}
+            if live is not None and len(tail) >= 30:
+                row["gap"] = round(abs(live - o), 4)
+                gaps.append(row["gap"])
+            per_model[str(m)] = row
+        oracle_acc = float(np.mean([ok for _, ok in oracle][-window:]))
+        live_acc = snap_a.get("accuracy")
+        if live_acc is not None:
+            gaps.append(abs(live_acc - oracle_acc))
+        print(json.dumps({"partial": "quality@clean",
+                          "live_accuracy": live_acc,
+                          "oracle_accuracy": round(oracle_acc, 4)}),
+              file=sys.stderr)
+
+        # phase B — drifting traffic: shift the input distribution so the
+        # read-path entropy stream moves (KS detector; not gated)
+        def shifted_x(r):
+            return (6.0 * r.standard_normal(eng._example_shape)
+                    + 4.0).astype(eng._example_dtype, copy=False)
+        TrafficGenerator(eng, clients=range(population), seed=15,
+                         concurrency=8,
+                         make_x=shifted_x).run(300 if smoke else 600)
+        drift_suspected = int(eng.quality.snapshot()["drift_suspected"])
+
+        # phase C — canaried swaps: clean merge commits, corrupt merge
+        # rolls back (labels keep flowing so both verdicts close on
+        # samples, not timeout)
+        def run_canary(rec: dict) -> dict:
+            n_before = len(ctl.verdicts)
+            eng.apply_cluster_event(rec)
+            for i in range(40):
+                if len(ctl.verdicts) > n_before:
+                    break
+                labeled_run(64, seed=1000 + 37 * i)
+            if len(ctl.verdicts) == n_before:
+                return {"verdict": "hung"}
+            v = ctl.verdicts[-1]
+            print(json.dumps({"partial": f"quality@{rec['kind']}"
+                                         f":{rec.get('merged')}",
+                              **{k: v[k] for k in ("verdict", "decided_by",
+                                                   "live_acc", "shadow_acc",
+                                                   "lineage_ids")}}),
+                  file=sys.stderr)
+            return v
+
+        good = run_canary({"kind": "cluster_merge", "base": 0, "merged": 1,
+                           "iteration": 1})
+        bad = run_canary({"kind": "cluster_merge", "base": 2, "merged": 3,
+                          "iteration": 2})
+        clean_rollbacks = sum(
+            1 for v in ctl.verdicts
+            if v.get("verdict") == "rollback" and v is not bad)
+
+        # phase D — shadow overhead on identical traffic: INTERLEAVED
+        # canary-off/on legs. A single off/on pair is hostage to closed-
+        # loop throughput drift on a shared host (observed swings ~±10%
+        # dwarf the <5% signal); alternating the modes and comparing
+        # medians cancels the monotone warm-up/scheduler component.
+        n_perf = 1500 if smoke else 3000
+        pairs = 3
+        eng.reset_latency_stats()
+
+        def _leg(seed: int, canary_on: bool) -> dict:
+            if canary_on:
+                ctl.fraction = 0.1
+                eng.apply_cluster_event(
+                    {"kind": "cluster_merge", "base": 2, "merged": 3,
+                     "iteration": 100 + seed})
+            r = TrafficGenerator(eng, clients=range(population),
+                                 seed=seed, concurrency=32).run(n_perf)
+            if canary_on:
+                ctl.abort()   # no labels flow here: cancel, next leg is
+            return r          # truly canary-idle
+
+        _leg(15, False)       # unmeasured: warm BOTH modes before any
+        _leg(15, True)        # measured leg (first-open canary setup —
+        off_legs, on_legs = [], []  # lineage replay etc — is one-time)
+        for k in range(pairs):
+            # alternate which mode goes first: closed-loop throughput
+            # drifts monotonically as the host warms, so a fixed order
+            # would systematically favor one mode
+            modes = (True, False) if k % 2 else (False, True)
+            for canary_on in modes:
+                r = _leg(16 + 2 * k + int(canary_on), canary_on)
+                (on_legs if canary_on else off_legs).append(r)
+        recompiles = _serve_recompiles() - rec0
+    finally:
+        eng.close()
+
+    off_rps = [r["requests_per_s"] for r in off_legs]
+    on_rps = [r["requests_per_s"] for r in on_legs]
+    off = {"requests_per_s": float(np.median(off_rps)),
+           "p99_ms": float(np.median(
+               [r["p99_ms"] for r in off_legs if r.get("p99_ms")])),
+           "errors": sum(int(r["errors"]) for r in off_legs)}
+    on = {"requests_per_s": float(np.median(on_rps)),
+          "errors": sum(int(r["errors"]) for r in on_legs)}
+    ratio = (round(on["requests_per_s"] / off["requests_per_s"], 4)
+             if off["requests_per_s"] else None)
+    max_gap = round(max(gaps), 4)
+    row = {
+        "variant": "drifting_serve",
+        "population": population,
+        "num_models": cfg.num_models,
+        "window": window,
+        "label_noise": eps,
+        "labeled": int(snap_a["labeled"]),
+        "live_accuracy": live_acc,
+        "oracle_accuracy": round(oracle_acc, 4),
+        "live_oracle_gap": max_gap,
+        "per_model": per_model,
+        "drift_suspected": drift_suspected,
+        "good_merge": {k: good.get(k) for k in
+                       ("verdict", "decided_by", "samples", "live_acc",
+                        "shadow_acc", "acc_delta", "agreement",
+                        "lineage_ids")},
+        "bad_merge": {k: bad.get(k) for k in
+                      ("verdict", "decided_by", "samples", "live_acc",
+                       "shadow_acc", "acc_delta", "agreement",
+                       "lineage_ids")},
+        "good_merge_committed": int(good.get("verdict") == "commit"),
+        "bad_merge_rolled_back": int(bad.get("verdict") == "rollback"),
+        "clean_canary_rollbacks": int(clean_rollbacks),
+        "shadow_overhead": {"requests": n_perf, "concurrency": 32,
+                            "fraction": 0.1, "pairs": pairs,
+                            "off_rps": [round(v, 1) for v in off_rps],
+                            "on_rps": [round(v, 1) for v in on_rps]},
+        "shadow_overhead_ratio": ratio,
+        "requests_per_s": round(off["requests_per_s"], 2),
+        "p99_ms": round(off["p99_ms"], 3) if off.get("p99_ms") else None,
+        "errors": int(off["errors"]) + int(on["errors"]),
+        "steady_recompiles": int(recompiles),
+    }
+    print(json.dumps({"partial": "quality", **row}), file=sys.stderr)
+    return row
 
 
 def _megastep_cfg(smoke: bool, K: int):
@@ -1112,6 +1387,12 @@ def main() -> None:
         # ceiling, batched >= 3x unbatched, zero steady recompiles)
         "serve": (_serve_bench(smoke)
                   if "--serve" in sys.argv else None),
+        # model-quality plane axis (opt-in: labeled drifting-traffic
+        # serve bench with canaried swaps); committed as QUALITY_r1*.json
+        # and gated by `regress` (live-vs-oracle accuracy gap, canary
+        # verdicts, shadow overhead < 5%, zero steady recompiles)
+        "quality": (_quality_bench(smoke)
+                    if "--quality" in sys.argv else None),
     }
     print(json.dumps(out))
     if conv is not None and "error" in conv:

@@ -162,6 +162,7 @@ def main(argv: list[str] | None = None) -> int:
     reg_p.add_argument("--tol-host-overhead", type=float, default=None)
     reg_p.add_argument("--tol-p99", type=float, default=None)
     reg_p.add_argument("--tol-precision-acc", type=float, default=None)
+    reg_p.add_argument("--tol-quality-acc", type=float, default=None)
     reg_p.add_argument("--json", action="store_true")
 
     cp_p = sub.add_parser(
@@ -216,6 +217,15 @@ def main(argv: list[str] | None = None) -> int:
     srv_p.add_argument("--ops_port", type=int, default=None,
                        help="also expose /metrics + /healthz on this port "
                             "(0 = ephemeral)")
+    srv_p.add_argument("--quality_window", type=int, default=0,
+                       help="enable the streaming model-quality plane "
+                            "with this label window (0 = off; "
+                            "docs/OBSERVABILITY.md Model-quality plane)")
+    srv_p.add_argument("--canary_fraction", type=float, default=0.0,
+                       help="shadow-canary cluster events on this "
+                            "fraction of affected traffic before "
+                            "committing the swap (0 = swap immediately; "
+                            "docs/SERVING.md Canarying hot swaps)")
     srv_p.add_argument("--platform", type=str, default="",
                        help="force a JAX platform (e.g. 'cpu')")
 
@@ -268,7 +278,8 @@ def main(argv: list[str] | None = None) -> int:
         from feddrift_tpu.obs.regress import main as regress_main
         argv_r = [args.candidate, "--baseline", args.baseline]
         for flag in ("tol_rounds", "tol_wall", "tol_acc", "tol_compiles",
-                     "tol_host_overhead", "tol_p99", "tol_precision_acc"):
+                     "tol_host_overhead", "tol_p99", "tol_precision_acc",
+                     "tol_quality_acc"):
             v = getattr(args, flag)
             if v is not None:
                 argv_r += [f"--{flag.replace('_', '-')}", str(v)]
@@ -326,6 +337,15 @@ def main(argv: list[str] | None = None) -> int:
                 client_id="serve-cli")
             engine.attach_broker(
                 broker, topic=args.topic or serving.CLUSTER_TOPIC)
+        if args.quality_window > 0:
+            engine.enable_quality(window=args.quality_window)
+        if args.canary_fraction > 0:
+            from feddrift_tpu.platform.canary import CanaryController
+            engine.attach_canary(CanaryController(
+                engine, fraction=args.canary_fraction))
+        if broker is not None:
+            # fleet lane serve/<pid>: REQ/S, P99-REQ, POOL-VER, CANARY
+            engine.attach_ops(broker)
         engine.start()
         engine.warmup()
         try:

@@ -290,6 +290,24 @@ class ExperimentConfig:
     slo_host_overhead: float = 0.0      # host_overhead_frac ceiling
     slo_p99_round_wall_s: float = 0.0   # per-round wall p99 ceiling (s)
     slo_eval_gap: float = 0.0           # train-test accuracy gap ceiling
+    slo_model_accuracy: float = 0.0     # serving joined-label accuracy floor
+    # --- model-quality plane (obs/quality.py, platform/canary.py;
+    # docs/OBSERVABILITY.md "Model-quality plane") ----------------------
+    # Streaming per-model quality on the serving read path: a delayed-
+    # label joiner + windowed accuracy/confidence/entropy/ECE estimators.
+    # quality_window = labeled requests between model_quality events
+    # (0 = plane disabled); quality_ttl_s = prediction retention for the
+    # request_id -> label join.
+    quality_window: int = 0
+    quality_ttl_s: float = 60.0
+    # Lineage-aware shadow canarying of serving hot swaps: fraction of
+    # affected-cluster traffic shadow-executed through the candidate
+    # generation (0 = canarying off, cluster events swap immediately),
+    # the labeled-comparison sample floor before a verdict, and the
+    # accuracy margin the candidate may lose before rollback.
+    canary_fraction: float = 0.0
+    canary_min_samples: int = 32
+    canary_acc_margin: float = 0.02
 
     def __post_init__(self) -> None:
         if self.population_size == 0 \
@@ -370,6 +388,20 @@ class ExperimentConfig:
                 raise ValueError(f"{name} must be >= 0 (0 disables)")
         if self.slo_host_overhead > 1.0:
             raise ValueError("slo_host_overhead is a fraction in (0, 1]")
+        if not 0.0 <= self.slo_model_accuracy <= 1.0:
+            raise ValueError("slo_model_accuracy must be in [0, 1] "
+                             "(0 disables)")
+        if self.quality_window < 0:
+            raise ValueError("quality_window must be >= 0 (0 disables)")
+        if self.quality_ttl_s <= 0:
+            raise ValueError("quality_ttl_s must be > 0")
+        if not 0.0 <= self.canary_fraction <= 1.0:
+            raise ValueError("canary_fraction must be in [0, 1] "
+                             "(0 disables)")
+        if self.canary_min_samples < 1:
+            raise ValueError("canary_min_samples must be >= 1")
+        if not 0.0 <= self.canary_acc_margin <= 1.0:
+            raise ValueError("canary_acc_margin must be in [0, 1]")
         if self.hierarchy_edges < 0:
             raise ValueError("hierarchy_edges must be >= 0")
         if self.hierarchy_edges > 0:

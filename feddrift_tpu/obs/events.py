@@ -111,6 +111,11 @@ EVENT_KINDS = frozenset({
     # serving read path (platform/serving.py)
     "request_served",       # one inference request answered (routing + latency)
     "pool_swapped",         # engine published a new pool/routing generation
+    # model-quality plane (obs/quality.py, platform/canary.py)
+    "model_quality",        # windowed per-model live accuracy/confidence/ECE
+    "serve_drift_suspected",  # read-path entropy-distribution shift detected
+    "canary_started",       # cluster event intercepted -> shadow canary open
+    "canary_verdict",       # canary decided: commit (swap) or rollback
 })
 
 RING_SIZE = 4096

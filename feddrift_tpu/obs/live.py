@@ -210,7 +210,8 @@ class SLObjective:
 def default_slos(rounds_per_s: float = 0.0,
                  host_overhead: float = 0.0,
                  p99_round_wall_s: float = 0.0,
-                 eval_gap: float = 0.0) -> list:
+                 eval_gap: float = 0.0,
+                 model_accuracy: float = 0.0) -> list:
     """The runner's objective set; a threshold of 0 disables that
     objective. Broker liveness is always on (it only samples on
     heartbeat/reconnect events, so it is free otherwise)."""
@@ -257,6 +258,15 @@ def default_slos(rounds_per_s: float = 0.0,
             budget_frac=0.34, burn_rate=1.5, min_samples=2,
             cooldown_s=60.0, severity="warn",
             description="train-test accuracy gap above objective"))
+    if model_accuracy > 0:
+        objs.append(SLObjective(
+            "model_accuracy_floor", ("model_quality",),
+            lambda r: r.get("accuracy"),
+            objective=model_accuracy, direction="min", window=8,
+            budget_frac=0.25, burn_rate=2.0, min_samples=3,
+            cooldown_s=30.0, severity="crit",
+            description="serving joined-label accuracy below the floor "
+                        "(obs/quality.py windowed estimate)"))
     return objs
 
 
@@ -395,6 +405,24 @@ def _quantile_digests(reg=None) -> dict:
             if isinstance(v, dict) and "quantiles" in v}
 
 
+# p99 exemplars: the latest outlier next to a sketch's digest (e.g. the
+# worst serve request's trace id beside request_latency_seconds_q), so a
+# tail spike in /status is one hop from its trace.json slice. A sketch
+# keeps no samples, so the exemplar is the only survivor of the outlier.
+_exemplars: dict[str, dict] = {}
+_exemplars_lock = threading.Lock()
+
+
+def record_exemplar(name: str, **fields) -> None:
+    with _exemplars_lock:
+        _exemplars[name] = {**fields, "ts": round(time.time(), 3)}
+
+
+def exemplars() -> dict:
+    with _exemplars_lock:
+        return {k: dict(v) for k, v in _exemplars.items()}
+
+
 def status_snapshot(slo: Optional[SLOEngine] = None,
                     board: Optional[StatusBoard] = None,
                     reg=None) -> dict:
@@ -402,6 +430,9 @@ def status_snapshot(slo: Optional[SLOEngine] = None,
     doc = board.fields()
     doc["active_alerts"] = slo.active() if slo is not None else []
     doc["quantiles"] = _quantile_digests(reg)
+    ex = exemplars()
+    if ex:
+        doc["exemplars"] = ex
     doc["pid"] = os.getpid()
     return doc
 
@@ -411,6 +442,10 @@ _METRIC_PREFIXES = (
     "rounds_degraded", "host_overhead_frac", "round_wall_seconds_q",
     "dispatch_gap_seconds_q", "num_models", "alerts_raised", "slo_burns",
     "heartbeats_missed", "edge_", "publish_retries",
+    # serving read path + model-quality plane (platform/serving.py,
+    # obs/quality.py, platform/canary.py)
+    "requests_served", "serve_", "pool_version", "pool_swaps",
+    "request_latency_seconds_q", "model_accuracy_q", "canary_",
 )
 
 
@@ -727,15 +762,18 @@ def _sketch_q(snap: dict, name: str, q: str):
 def render_fleet(lanes: dict) -> str:
     """The merged multi-process table the ``fleet`` CLI verb prints."""
     cols = ("LANE", "PID", "ITER", "ROUNDS/S", "P99 WALL", "BYTES OUT",
-            "STRAGGLERS", "RECONNECTS", "ALERTS", "HEALTH")
+            "STRAGGLERS", "RECONNECTS", "REQ/S", "P99-REQ", "POOL-VER",
+            "CANARY", "ALERTS", "HEALTH")
     rows = []
     for lane in sorted(lanes):
         snap = lanes[lane]
         st = snap.get("status") or {}
         health = snap.get("health") or {}
+        extra = snap.get("extra") or {}
         bytes_out = _metric(snap, "client_bytes_out")
         if bytes_out is None:
             bytes_out = _metric(snap, "broker_bytes_out")
+        pool_ver = _metric(snap, "pool_version")
         rows.append((
             lane,
             _fmt(snap.get("pid")),
@@ -745,6 +783,10 @@ def render_fleet(lanes: dict) -> str:
             _fmt(int(bytes_out) if bytes_out is not None else None),
             _fmt(_metric(snap, "stragglers_masked")),
             _fmt((health.get("broker") or {}).get("reconnects")),
+            _fmt(extra.get("requests_per_s"), 1),
+            _fmt(_sketch_q(snap, "request_latency_seconds_q", "0.99"), 4),
+            _fmt(int(pool_ver) if pool_ver is not None else None),
+            _fmt(extra.get("canary")),
             _fmt(len(st.get("active_alerts") or [])),
             health.get("status", "-"),
         ))

@@ -1,0 +1,358 @@
+"""Streaming model-quality plane for the serving read path.
+
+Training-side eval sees quality once per iteration, offline, on the
+trainer's own split. This module measures it ON the read path, live,
+from real traffic — the E-step quality of the deployed assignment
+(arXiv:2111.10192) accounted where arXiv:2307.06561 argues it must be:
+server-side, per request, O(1).
+
+Three estimators, all host-side and allocation-light:
+
+- ``LabelJoiner`` — delayed-label join. Labels for online traffic arrive
+  seconds-to-minutes after the prediction (the user clicked, the sensor
+  confirmed), so every served request parks its prediction in a TTL ring
+  keyed by request id; ``observe_label(request_id, y)`` closes the loop
+  or misses (expired / evicted / unknown) without ever growing past the
+  capacity bound.
+
+- ``QualityMonitor`` — windowed per-model accuracy, mean confidence,
+  output entropy and a streaming ECE calibration sketch over the joined
+  stream. Feeds the ``model_accuracy_q{model=}`` / ``serve_entropy_q
+  {model=}`` quantile sketches and emits one ``model_quality`` event
+  every ``window`` labeled requests.
+
+- ``EntropyShiftDetector`` — a windowed two-sample KS statistic on the
+  prediction-entropy stream (reference window vs. sliding current
+  window). A score past the threshold emits ``serve_drift_suspected``:
+  drift detection on the READ path, where the trainer's oracle cannot
+  see, and without waiting for labels at all.
+
+Pure numpy + stdlib; safe to import from the jax-free CLI paths.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import OrderedDict, deque
+from typing import Optional
+
+import numpy as np
+
+from feddrift_tpu.obs.events import emit
+from feddrift_tpu.obs.instruments import registry
+
+DEFAULT_ECE_BINS = 10
+
+
+def softmax_1d(logits) -> np.ndarray:
+    """Numerically stable softmax over one logits row (host-side)."""
+    z = np.asarray(logits, dtype=np.float64).ravel()
+    z = z - z.max()
+    e = np.exp(z)
+    return e / e.sum()
+
+
+def prediction_stats(logits) -> tuple[int, float, float]:
+    """(argmax, confidence, entropy) of one logits row — the per-request
+    quality triple, O(classes)."""
+    p = softmax_1d(logits)
+    pred = int(np.argmax(p))
+    conf = float(p[pred])
+    # entropy in nats; clip avoids log(0) on saturated rows
+    ent = float(-np.sum(p * np.log(np.clip(p, 1e-12, None))))
+    return pred, conf, ent
+
+
+class StreamingECE:
+    """Expected Calibration Error sketch: fixed confidence bins, per-bin
+    (count, confidence sum, correct sum). O(1) per labeled request, no
+    sample retention — the streaming analogue of the binned ECE."""
+
+    def __init__(self, bins: int = DEFAULT_ECE_BINS) -> None:
+        self.bins = int(bins)
+        self.count = np.zeros(self.bins, dtype=np.int64)
+        self.conf_sum = np.zeros(self.bins, dtype=np.float64)
+        self.correct_sum = np.zeros(self.bins, dtype=np.float64)
+
+    def observe(self, confidence: float, correct: bool) -> None:
+        b = min(int(confidence * self.bins), self.bins - 1)
+        self.count[b] += 1
+        self.conf_sum[b] += confidence
+        self.correct_sum[b] += 1.0 if correct else 0.0
+
+    def ece(self) -> Optional[float]:
+        n = int(self.count.sum())
+        if n == 0:
+            return None
+        mask = self.count > 0
+        acc = self.correct_sum[mask] / self.count[mask]
+        conf = self.conf_sum[mask] / self.count[mask]
+        w = self.count[mask] / n
+        return float(np.sum(w * np.abs(acc - conf)))
+
+
+class _Pending:
+    __slots__ = ("model", "client", "pred", "confidence", "entropy", "ts")
+
+    def __init__(self, model: int, client: int, pred: int,
+                 confidence: float, entropy: float, ts: float) -> None:
+        self.model = model
+        self.client = client
+        self.pred = pred
+        self.confidence = confidence
+        self.entropy = entropy
+        self.ts = ts
+
+
+class LabelJoiner:
+    """request_id -> prediction ring buffer with TTL.
+
+    Insert-ordered (request ids are monotonic), so expiry is a pop from
+    the front; ``capacity`` bounds memory when labels never arrive."""
+
+    def __init__(self, ttl_s: float = 60.0, capacity: int = 65536,
+                 time_fn=time.time) -> None:
+        if ttl_s <= 0:
+            raise ValueError("ttl_s must be > 0")
+        self.ttl_s = float(ttl_s)
+        self.capacity = int(capacity)
+        self._time = time_fn
+        self._ring: "OrderedDict[int, _Pending]" = OrderedDict()
+        self.expired = 0
+        self.evicted = 0
+
+    def __len__(self) -> int:
+        return len(self._ring)
+
+    def _sweep(self, now: float) -> None:
+        horizon = now - self.ttl_s
+        while self._ring:
+            _, entry = next(iter(self._ring.items()))
+            if entry.ts >= horizon:
+                break
+            self._ring.popitem(last=False)
+            self.expired += 1
+        while len(self._ring) > self.capacity:
+            self._ring.popitem(last=False)
+            self.evicted += 1
+
+    def record(self, request_id: int, entry: _Pending) -> None:
+        self._ring[int(request_id)] = entry
+        self._sweep(self._time())
+
+    def pop(self, request_id: int) -> Optional[_Pending]:
+        # labels arrive from EXTERNAL feedback loops — an id that never
+        # was a request id (wrong type included) is a miss, not an error
+        try:
+            entry = self._ring.pop(int(request_id), None)
+        except (TypeError, ValueError):
+            return None
+        if entry is None:
+            return None
+        if entry.ts < self._time() - self.ttl_s:
+            self.expired += 1
+            return None
+        return entry
+
+
+class EntropyShiftDetector:
+    """Windowed KS-style shift score on the entropy stream.
+
+    Anchors a reference window (the first ``window`` samples after
+    construction or ``reset()``), then slides a current window and
+    scores the two empirical CDFs with the two-sample KS statistic every
+    ``window // 2`` samples. A score past ``threshold`` fires once and
+    re-anchors the reference to the current window, so a sustained shift
+    reports a step, not a spam stream."""
+
+    def __init__(self, window: int = 64, threshold: float = 0.5) -> None:
+        if window < 8:
+            raise ValueError("drift window must be >= 8")
+        self.window = int(window)
+        self.threshold = float(threshold)
+        self._ref: list[float] = []
+        self._cur: deque = deque(maxlen=self.window)
+        self._since_eval = 0
+
+    def reset(self) -> None:
+        """Re-anchor on the next ``window`` samples (e.g. after a swap —
+        a new generation legitimately changes the output distribution)."""
+        self._ref = []
+        self._cur.clear()
+        self._since_eval = 0
+
+    @staticmethod
+    def ks_statistic(a, b) -> float:
+        """Two-sample KS: max CDF gap between sorted samples ``a``/``b``."""
+        a = np.sort(np.asarray(a, dtype=np.float64))
+        b = np.sort(np.asarray(b, dtype=np.float64))
+        grid = np.concatenate([a, b])
+        ca = np.searchsorted(a, grid, side="right") / a.size
+        cb = np.searchsorted(b, grid, side="right") / b.size
+        return float(np.max(np.abs(ca - cb)))
+
+    def observe(self, entropy: float) -> Optional[float]:
+        """Returns the KS score when the detector fires, else None."""
+        if len(self._ref) < self.window:
+            self._ref.append(float(entropy))
+            return None
+        self._cur.append(float(entropy))
+        if len(self._cur) < self.window:
+            return None
+        self._since_eval += 1
+        if self._since_eval < max(self.window // 2, 1):
+            return None
+        self._since_eval = 0
+        score = self.ks_statistic(self._ref, list(self._cur))
+        if score < self.threshold:
+            return None
+        self._ref = list(self._cur)
+        self._cur.clear()
+        return score
+
+
+class _ModelWindow:
+    """Windowed per-model aggregates over the labeled stream."""
+
+    __slots__ = ("correct", "confidence", "entropy")
+
+    def __init__(self, window: int) -> None:
+        self.correct: deque = deque(maxlen=window)
+        self.confidence: deque = deque(maxlen=window)
+        self.entropy: deque = deque(maxlen=window)
+
+    def stats(self) -> Optional[dict]:
+        n = len(self.correct)
+        if n == 0:
+            return None
+        return {
+            "n": n,
+            "accuracy": round(float(sum(self.correct)) / n, 4),
+            "mean_confidence": round(
+                float(sum(self.confidence)) / n, 4),
+            "mean_entropy": round(float(sum(self.entropy)) / n, 4),
+        }
+
+
+class QualityMonitor:
+    """The per-engine quality plane: joiner + windowed estimators +
+    sketches + ``model_quality`` / ``serve_drift_suspected`` events.
+
+    ``record_prediction`` runs on the serving dispatcher (one call per
+    answered request, O(classes)); ``observe_label`` runs on whatever
+    thread the label producer uses. One lock covers both — every
+    operation under it is a deque append or a dict insert."""
+
+    def __init__(self, window: int = 100, ttl_s: float = 60.0,
+                 capacity: int = 65536, ece_bins: int = DEFAULT_ECE_BINS,
+                 drift_window: int = 64, drift_threshold: float = 0.5,
+                 time_fn=time.time) -> None:
+        if window < 1:
+            raise ValueError("quality window must be >= 1")
+        self.window = int(window)
+        self.joiner = LabelJoiner(ttl_s=ttl_s, capacity=capacity,
+                                  time_fn=time_fn)
+        self.ece = StreamingECE(bins=ece_bins)
+        self.drift = EntropyShiftDetector(window=drift_window,
+                                          threshold=drift_threshold)
+        self._lock = threading.Lock()
+        self._models: dict[int, _ModelWindow] = {}
+        self._overall = _ModelWindow(self.window)
+        self.labeled = 0
+        self.missed = 0
+        self._since_event = 0
+        self.drift_suspected = 0
+        self._reg = registry()
+
+    # -- read-path half -------------------------------------------------
+    def record_prediction(self, request_id: int, model: int, logits,
+                          client: int = -1) -> None:
+        pred, conf, ent = prediction_stats(logits)
+        self._reg.quantile_sketch("serve_entropy_q",
+                                  model=str(int(model))).observe(ent)
+        with self._lock:
+            self.joiner.record(request_id, _Pending(
+                int(model), int(client), pred, conf, ent,
+                self.joiner._time()))
+            score = self.drift.observe(ent)
+        if score is not None:
+            self.drift_suspected += 1
+            emit("serve_drift_suspected", score=round(score, 4),
+                 threshold=self.drift.threshold,
+                 window=self.drift.window, signal="entropy")
+
+    # -- label half -----------------------------------------------------
+    def observe_label(self, request_id: int, y) -> Optional[dict]:
+        """Join one delayed label; returns the joined record (model,
+        pred, correct, ...) or None when the prediction expired."""
+        with self._lock:
+            entry = self.joiner.pop(request_id)
+            if entry is None:
+                self.missed += 1
+                return None
+            correct = entry.pred == int(y)
+            mw = self._models.get(entry.model)
+            if mw is None:
+                mw = self._models[entry.model] = _ModelWindow(self.window)
+            for w in (mw, self._overall):
+                w.correct.append(1 if correct else 0)
+                w.confidence.append(entry.confidence)
+                w.entropy.append(entry.entropy)
+            self.ece.observe(entry.confidence, correct)
+            self.labeled += 1
+            self._since_event += 1
+            fire = self._since_event >= self.window
+            if fire:
+                self._since_event = 0
+            acc = float(sum(mw.correct)) / len(mw.correct)
+        self._reg.quantile_sketch(
+            "model_accuracy_q", model=str(entry.model)).observe(acc)
+        if fire:
+            emit("model_quality", **self._event_fields())
+        return {"model": entry.model, "client": entry.client,
+                "pred": entry.pred, "correct": correct,
+                "confidence": entry.confidence, "entropy": entry.entropy}
+
+    # -- snapshots ------------------------------------------------------
+    def _event_fields(self) -> dict:
+        with self._lock:
+            per_model = {str(m): w.stats()
+                         for m, w in sorted(self._models.items())}
+            overall = self._overall.stats()
+            return {
+                "labeled": self.labeled,
+                "missed": self.missed,
+                "window": self.window,
+                "accuracy": overall["accuracy"] if overall else None,
+                "mean_confidence": (overall["mean_confidence"]
+                                    if overall else None),
+                "mean_entropy": (overall["mean_entropy"]
+                                 if overall else None),
+                "ece": (round(self.ece.ece(), 4)
+                        if self.ece.ece() is not None else None),
+                "per_model": per_model,
+            }
+
+    def snapshot(self) -> dict:
+        """JSON-ready quality summary (bench artifacts, /status extras,
+        engine.stats())."""
+        out = self._event_fields()
+        out["pending"] = len(self.joiner)
+        out["expired"] = self.joiner.expired
+        out["drift_suspected"] = self.drift_suspected
+        return out
+
+    def accuracy(self, model: Optional[int] = None) -> Optional[float]:
+        with self._lock:
+            w = self._overall if model is None \
+                else self._models.get(int(model))
+            if w is None or not w.correct:
+                return None
+            return float(sum(w.correct)) / len(w.correct)
+
+    def on_swap(self) -> None:
+        """Generation swap hook: re-anchor the shift detector (the new
+        generation's output distribution is a legitimate step)."""
+        with self._lock:
+            self.drift.reset()

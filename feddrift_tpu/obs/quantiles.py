@@ -139,6 +139,17 @@ class QuantileSketch:
             est = self._est.get(float(q))
             return est.quantile() if est is not None else None
 
+    def reset(self) -> None:
+        """Restart the stream in place: benchmarks drop warm-up samples
+        between phases so the exported digest covers only the measured
+        window, without invalidating references to this instrument."""
+        with self._lock:
+            self._est = {q: P2Estimator(q) for q in self.quantiles}
+            self.count = 0
+            self.sum = 0.0
+            self.min = math.inf
+            self.max = -math.inf
+
     def snapshot(self) -> dict:
         with self._lock:
             return {

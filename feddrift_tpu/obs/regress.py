@@ -40,6 +40,9 @@ DEFAULT_TOL = {
     "p99": 0.75,         # fail if round_wall_p99_s > baseline * (1 + tol)
     "precision_acc": 0.05,   # fail if a reduced-precision row's accuracy
                              # < this run's own f32 row - tol
+    "quality_acc": 0.05,     # fail if the streaming live-accuracy estimate
+                             # drifts further than this from the offline
+                             # oracle on the same labeled stream
 }
 
 
@@ -431,6 +434,71 @@ def compare(candidate: dict, baseline: dict,
                                  "this run's own unbatched row"))
     elif isinstance(bsv, list):
         skip("serve", "candidate lacks the serve axis")
+
+    # model-quality axis (bench.py --quality; QUALITY artifacts): the
+    # seeded drifting-traffic serve bench with live label joins and two
+    # canaried merges (one good, one deliberately wrong). Gates are
+    # mostly ABSOLUTE against this run's own rows — the acceptance bars,
+    # immune to a baseline that itself regressed: streaming accuracy
+    # within --tol-quality-acc of the offline oracle on the same stream,
+    # the good merge canary-committed and the corrupted one rolled back,
+    # zero rollbacks outside the deliberate corruption, shadow-on
+    # throughput >= 0.95x shadow-off (the <5% duplicate-execute budget),
+    # and zero steady-state recompiles (shadow forwards replay warm
+    # signatures). p99/requests-per-s ride the usual relative tolerances
+    # when the baseline carries the axis.
+    cq, bq = candidate.get("quality"), baseline.get("quality")
+    if isinstance(cq, dict):
+        bqd = bq if isinstance(bq, dict) else {}
+        gap = cq.get("live_oracle_gap")
+        if gap is not None:
+            rows.append(row("quality.live_oracle_gap",
+                            bqd.get("live_oracle_gap"), gap,
+                            f"<= {tol['quality_acc']:.4f}",
+                            gap > tol["quality_acc"],
+                            note="streaming estimate vs offline oracle "
+                                 "on the same labeled stream"))
+        gm = cq.get("good_merge_committed")
+        if gm is not None:
+            rows.append(row("quality.good_merge_committed",
+                            bqd.get("good_merge_committed"), gm, "== 1",
+                            gm != 1, note="clean merge must canary-commit"))
+        bm = cq.get("bad_merge_rolled_back")
+        if bm is not None:
+            rows.append(row("quality.bad_merge_rolled_back",
+                            bqd.get("bad_merge_rolled_back"), bm, "== 1",
+                            bm != 1,
+                            note="corrupted merge must canary-rollback"))
+        cr = cq.get("clean_canary_rollbacks")
+        if cr is not None:
+            rows.append(row("quality.clean_canary_rollbacks",
+                            bqd.get("clean_canary_rollbacks"), cr, "== 0",
+                            cr > 0,
+                            note="no false rollbacks on clean traffic"))
+        sr = cq.get("shadow_overhead_ratio")
+        if sr is not None:
+            rows.append(row("quality.shadow_overhead_ratio",
+                            bqd.get("shadow_overhead_ratio"), sr,
+                            ">= 0.95", sr < 0.95,
+                            note="shadow-on rps vs own shadow-off rps"))
+        rec = cq.get("steady_recompiles")
+        if rec is not None:
+            rows.append(row("quality.steady_recompiles",
+                            bqd.get("steady_recompiles"), rec, "== 0",
+                            rec > 0,
+                            note="shadow forwards replay warm signatures"))
+        bp, cp = bqd.get("p99_ms"), cq.get("p99_ms")
+        if bp and cp:
+            ceil = bp * (1.0 + tol["p99"])
+            rows.append(row("quality.p99_ms", bp, cp,
+                            f"<= {ceil:.3f}", cp > ceil))
+        bv, cv = bqd.get("requests_per_s"), cq.get("requests_per_s")
+        if bv and cv:
+            floor = bv * (1.0 - tol["rounds"])
+            rows.append(row("quality.requests_per_s", bv, cv,
+                            f">= {floor:.1f}", cv < floor))
+    elif isinstance(bq, dict):
+        skip("quality", "candidate lacks the quality axis")
     return rows
 
 
@@ -497,6 +565,11 @@ def main(argv: list[str] | None = None) -> int:
                     help="absolute accuracy drop tolerated for a reduced-"
                          "precision row vs its own run's f32 row "
                          "(default %(default)s)")
+    ap.add_argument("--tol-quality-acc", type=float,
+                    default=DEFAULT_TOL["quality_acc"],
+                    help="absolute gap tolerated between the streaming "
+                         "live-accuracy estimate and the offline oracle "
+                         "on the same labeled stream (default %(default)s)")
     ap.add_argument("--json", action="store_true", help="machine-readable")
     args = ap.parse_args(argv)
 
@@ -513,7 +586,8 @@ def main(argv: list[str] | None = None) -> int:
                         "bytes": args.tol_bytes,
                         "host_overhead": args.tol_host_overhead,
                         "p99": args.tol_p99,
-                        "precision_acc": args.tol_precision_acc})
+                        "precision_acc": args.tol_precision_acc,
+                        "quality_acc": args.tol_quality_acc})
     regressed = any(r["status"] == "regress" for r in rows)
     if args.json:
         print(json.dumps({"regressed": regressed, "rows": rows,

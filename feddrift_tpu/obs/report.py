@@ -162,6 +162,49 @@ def summarize(run_dir: str) -> dict[str, Any]:
             "last": alert_recs[-5:],
         }
 
+    # -- model-quality plane (obs/quality.py, platform/canary.py) --------
+    # live per-model accuracy on the read path + shadow canary verdicts
+    mq = [e for e in events if e["kind"] == "model_quality"]
+    drifts = [e for e in events if e["kind"] == "serve_drift_suspected"]
+    starts = [e for e in events if e["kind"] == "canary_started"]
+    verdicts = [e for e in events if e["kind"] == "canary_verdict"]
+    if mq or drifts or starts or verdicts:
+        q: dict[str, Any] = {}
+        if mq:
+            last = mq[-1]
+            q["live"] = {
+                "snapshots": len(mq),
+                "labeled": last.get("labeled"),
+                "missed": last.get("missed"),
+                "window": last.get("window"),
+                "accuracy": last.get("accuracy"),
+                "mean_confidence": last.get("mean_confidence"),
+                "mean_entropy": last.get("mean_entropy"),
+                "ece": last.get("ece"),
+                "per_model": last.get("per_model"),
+            }
+        if drifts:
+            q["drift_suspected"] = {
+                "count": len(drifts),
+                "last_score": drifts[-1].get("score"),
+                "last_iteration": drifts[-1].get("iteration"),
+            }
+        if starts or verdicts:
+            q["canary"] = {
+                "started": len(starts),
+                "commits": sum(1 for v in verdicts
+                               if v.get("verdict") == "commit"),
+                "rollbacks": sum(1 for v in verdicts
+                                 if v.get("verdict") == "rollback"),
+                "verdicts": [
+                    {k: v.get(k) for k in
+                     ("verdict", "reason", "decided_by", "samples",
+                      "live_acc", "shadow_acc", "acc_delta", "agreement",
+                      "slots", "lineage_ids")}
+                    for v in verdicts[-8:]],
+            }
+        out["quality"] = q
+
     # -- faults ---------------------------------------------------------
     faults = [e for e in events if e["kind"] in FAULT_KINDS]
     if faults:
@@ -492,6 +535,46 @@ def render(summary: dict[str, Any]) -> str:
             L.append(f"  oracle agreement: final ARI {osum['final_ari']:.4f} "
                      f"(best {osum['best_ari']:.4f}, "
                      f"mean {osum['mean_ari']:.4f})")
+
+    q = summary.get("quality")
+    if q:
+        L.append("")
+        L.append("quality:")
+        lv = q.get("live")
+        if lv:
+            acc = lv.get("accuracy")
+            line = (f"  live accuracy "
+                    f"{'-' if acc is None else format(acc, '.4f')} "
+                    f"(window {lv['window']}, labeled {lv['labeled']}, "
+                    f"missed {lv['missed']}")
+            if lv.get("ece") is not None:
+                line += f", ECE {lv['ece']:.3f}"
+            if lv.get("mean_entropy") is not None:
+                line += f", entropy {lv['mean_entropy']:.3f}"
+            L.append(line + ")")
+            pm = lv.get("per_model") or {}
+            bits = [f"m{m}={d['accuracy']:.3f}(n={d['n']})"
+                    for m, d in sorted(pm.items()) if d]
+            if bits:
+                L.append(f"  per-model: {', '.join(bits)}")
+        dr = q.get("drift_suspected")
+        if dr:
+            L.append(f"  serve drift suspected: {dr['count']}x "
+                     f"(last KS score {dr['last_score']})")
+        cn = q.get("canary")
+        if cn:
+            L.append(f"  canaries: {cn['started']} started, "
+                     f"{cn['commits']} committed, "
+                     f"{cn['rollbacks']} rolled back")
+            for v in cn.get("verdicts") or []:
+                lids = "<-".join(str(x) for x in (v.get("lineage_ids")
+                                                  or [])) or "?"
+                delta = v.get("acc_delta")
+                why = (f"shadow acc {delta:+} over {v.get('samples')} labels"
+                       if delta is not None else "no label evidence")
+                L.append(f"    {v.get('reason', '?')} {lids} -> "
+                         f"{v.get('verdict', '?')} ({why}, "
+                         f"by {v.get('decided_by')})")
 
     faults = summary.get("faults")
     L.append("")

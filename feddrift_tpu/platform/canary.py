@@ -1,0 +1,409 @@
+"""Lineage-aware shadow canarying of serving hot swaps.
+
+PR 14's ``InferenceEngine.apply_cluster_event`` folds trainer cluster
+events into immediate generation swaps — fire-and-forget: nothing ever
+checks that the post-merge routing actually answers better. This module
+converts those swaps into EVIDENCE-GATED decisions (ROADMAP item 1):
+
+- ``CanaryController`` intercepts canary-eligible cluster events
+  (merges and splits by default). Instead of swapping, it builds the
+  candidate generation — the same plan ``apply_cluster_event`` would
+  have committed — places the candidate params through the identical
+  ``place_pool`` path (so the shadow forward replays the warm
+  per-bucket signature: ZERO new compiles), and opens a canary.
+
+- While a canary is open, a seeded ``fraction`` of the micro-batches
+  carrying affected-cluster traffic is **shadow duplicate-executed**
+  through the candidate: one extra forward dispatch per sampled batch,
+  answers still served from the live generation — bitwise
+  traffic-invisible (the ``TestHotSwap`` parity invariants keep
+  holding verbatim).
+
+- Joined labels (``engine.observe_label``) score both generations on
+  the same requests. Past ``min_samples`` labeled comparisons the
+  verdict fires: **commit** (candidate accuracy within ``acc_margin``
+  of live — publish the swap) or **rollback** (keep the live
+  generation, raise a crit alert). ``canary_started`` /
+  ``canary_verdict`` events carry the PR 5 lineage ids of the slots
+  involved, so ``report`` can render "merge L2<-L5 rolled back:
+  shadow acc -0.12".
+
+The controller is pure host-side except the shadow forward (the one
+already-compiled program); all bookkeeping is O(1) per request.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Optional
+
+import numpy as np
+
+from feddrift_tpu.obs import alerts as obs_alerts
+from feddrift_tpu.obs.events import emit
+from feddrift_tpu.obs.instruments import registry
+
+log = logging.getLogger("feddrift_tpu")
+
+# cluster-event kinds that are canaried by default: structural rewires
+# whose quality is checkable by comparing answers on live traffic.
+# (deletes make clients unroutable — nothing to compare; assigns are the
+# trainer's own E-step ground truth and swap immediately.)
+DEFAULT_CANARY_KINDS = frozenset({"cluster_merge", "cluster_split"})
+
+
+class _Candidate:
+    """One open canary: the planned swap + its scoreboard."""
+
+    __slots__ = ("rec", "plan", "params", "routing", "affected",
+                 "lineage_ids", "slots", "opened_ts",
+                 "live_correct", "shadow_correct", "labeled",
+                 "agree", "compared", "shadow_batches", "cmp", "labels")
+
+    def __init__(self, rec: dict, plan: dict, params, routing,
+                 affected: frozenset, lineage_ids: list, slots: list,
+                 opened_ts: float) -> None:
+        self.rec = rec
+        self.plan = plan
+        self.params = params          # device-placed candidate pool (or
+        self.routing = routing        # None = live params, routing-only)
+        self.affected = affected
+        self.lineage_ids = lineage_ids
+        self.slots = slots
+        self.opened_ts = opened_ts
+        self.live_correct = 0
+        self.shadow_correct = 0
+        self.labeled = 0
+        self.agree = 0
+        self.compared = 0
+        self.shadow_batches = 0
+        self.cmp: dict[int, tuple[int, int]] = {}  # rid -> (live, shadow)
+        # labels that arrived BEFORE their row's shadow compare landed:
+        # the shadow forward runs after the live answer is released, so a
+        # fast labeler (closed-loop bench, immediate-feedback serving)
+        # routinely wins that race — the join must work from both sides
+        self.labels: dict[int, int] = {}           # rid -> y
+
+
+class CanaryController:
+    """Gate between a serving engine and its cluster-event feed.
+
+    Attach with ``engine.attach_canary(controller)``; the engine then
+    consults ``wants()`` / ``intercept()`` from ``apply_cluster_event``,
+    calls ``on_batch()`` once per served micro-batch and ``on_label()``
+    from ``observe_label``. Thread-safe: intercept runs on the broker
+    consumer, on_batch on the dispatcher, on_label on label producers.
+    """
+
+    def __init__(self, engine, fraction: float = 0.1,
+                 min_samples: int = 32, acc_margin: float = 0.02,
+                 kinds=DEFAULT_CANARY_KINDS, seed: int = 0,
+                 timeout_s: float = 120.0,
+                 alerts_path: Optional[str] = None,
+                 time_fn=time.time) -> None:
+        if not 0.0 < fraction <= 1.0:
+            raise ValueError("canary fraction must be in (0, 1]")
+        if min_samples < 1:
+            raise ValueError("canary min_samples must be >= 1")
+        self.engine = engine
+        self.fraction = float(fraction)
+        self.min_samples = int(min_samples)
+        self.acc_margin = float(acc_margin)
+        self.kinds = frozenset(kinds)
+        self.timeout_s = float(timeout_s)
+        self.alerts_path = alerts_path
+        self._time = time_fn
+        self._rng = np.random.RandomState(int(seed) % (2**31 - 1))
+        self._lock = threading.RLock()
+        self._pending: Optional[_Candidate] = None
+        self._deferred: list[dict] = []
+        self._events: list[dict] = []   # committed cluster-event history
+        self.verdicts: list[dict] = []
+        reg = registry()
+        self._commits = reg.counter("canary_commits")
+        self._rollbacks = reg.counter("canary_rollbacks")
+        self._shadow_batches = reg.counter("canary_shadow_batches")
+
+    # -- event-feed half ------------------------------------------------
+    def wants(self, kind) -> bool:
+        return kind in self.kinds
+
+    def note_event(self, rec: dict) -> None:
+        """Record a committed (non-canaried) cluster event so lineage
+        resolution tracks the same history the trainer's DAG has."""
+        with self._lock:
+            self._events.append(dict(rec))
+
+    def _lineage_ids(self, slots: list[int]) -> list:
+        """Resolve the named pool slots to their CURRENT lineage ids by
+        replaying the committed event history through the PR 5 builder."""
+        from feddrift_tpu.obs.lineage import build_lineage
+        with self._lock:
+            lin = build_lineage(list(self._events))
+        out = []
+        for s in slots:
+            node = lin._current.get(int(s))
+            if node is None:
+                # slot predates the recorded history: mint its genesis
+                # node through the builder's own lazy primitive so the
+                # id matches what a full-history replay would assign
+                node = lin._ensure(int(s), None)
+            out.append(node.lid)
+        return out
+
+    @staticmethod
+    def _slots_of(rec: dict) -> list[int]:
+        kind = rec.get("kind")
+        if kind == "cluster_merge":
+            return [int(rec["base"]), int(rec["merged"])]
+        if kind == "cluster_split":
+            return [int(rec["model"]), int(rec["new_model"])]
+        if kind in ("cluster_create", "cluster_delete"):
+            return [int(rec["model"])]
+        return []
+
+    def intercept(self, rec: dict) -> None:
+        """Open a canary for one eligible cluster event (or defer it when
+        one is already open). Returns None: no generation is published
+        until the verdict commits."""
+        with self._lock:
+            if self._pending is not None:
+                self._deferred.append(dict(rec))
+                return None
+            plan = self.engine._plan_cluster_event(rec)
+            if plan is None:
+                return None
+            params = None
+            if plan.get("params") is not None:
+                params = self.engine._place_params(plan["params"])
+            slots = self._slots_of(rec)
+            lids = self._lineage_ids(slots)
+            affected = frozenset(int(s) for s in slots)
+            self._pending = _Candidate(
+                dict(rec), plan, params, plan["routing"], affected,
+                lids, slots, self._time())
+        emit("canary_started", reason=rec.get("kind"), slots=slots,
+             lineage_ids=lids, fraction=self.fraction,
+             min_samples=self.min_samples,
+             live_version=self.engine.version)
+        return None
+
+    # -- read-path half -------------------------------------------------
+    def on_batch(self, gen, live, routes, xb, mb, out, bucket) -> None:
+        """Dispatcher hook, called AFTER the live answers were released.
+        Seeded per-batch sampling: with probability ``fraction`` a batch
+        carrying affected-cluster traffic is duplicate-executed through
+        the candidate generation and its predictions parked for the
+        label join. Never raises into the dispatcher."""
+        cand = self._pending
+        if cand is None:
+            return
+        try:
+            self._shadow_batch(cand, gen, live, routes, xb, mb, out,
+                               bucket)
+        except Exception:   # noqa: BLE001 — shadow work must not hurt live
+            log.warning("canary: shadow execution failed", exc_info=True)
+        if self._time() - cand.opened_ts > self.timeout_s:
+            self._finalize(cand, decided_by="timeout")
+
+    def _shadow_batch(self, cand, gen, live, routes, xb, mb, out,
+                      bucket) -> None:
+        import jax.numpy as jnp
+        # sample FIRST: a skipped batch costs one RNG draw, not a
+        # per-row routing pass — the not-taken path is what every live
+        # batch pays while a canary is open, so it must stay O(1)
+        with self._lock:
+            take = self._rng.uniform() < self.fraction
+        if not take:
+            return
+        # candidate routes per live row; unroutable rows keep the live
+        # route (they are simply not affected-comparable)
+        mb_c = np.array(mb, copy=True)
+        affected_rows = []
+        for i, r in enumerate(live):
+            try:
+                m = cand.routing.route(r.client)
+            except Exception:   # noqa: BLE001 — unroutable under candidate
+                continue
+            mb_c[i] = m
+            if m != routes[i] or routes[i] in cand.affected:
+                affected_rows.append(i)
+        if not affected_rows:
+            return
+        params = cand.params if cand.params is not None else gen.params
+        shadow = np.asarray(  # lint: r2-ok (shadow canary fetch: off the answer path, runs after every live request in the batch was released)
+            self.engine.step.forward(params, jnp.asarray(xb),
+                                     jnp.asarray(mb_c)))
+        fire = False
+        with self._lock:
+            cand.shadow_batches += 1
+            for i in affected_rows:
+                r = live[i]
+                live_pred = int(np.argmax(out[i]))
+                shadow_pred = int(np.argmax(shadow[i]))
+                cand.compared += 1
+                if live_pred == shadow_pred:
+                    cand.agree += 1
+                early = cand.labels.pop(r.rid, None)
+                if early is not None:
+                    # the label beat the shadow compare: join right here
+                    cand.labeled += 1
+                    if live_pred == early:
+                        cand.live_correct += 1
+                    if shadow_pred == early:
+                        cand.shadow_correct += 1
+                else:
+                    cand.cmp[r.rid] = (live_pred, shadow_pred)
+            fire = cand.labeled >= self.min_samples
+        self._shadow_batches.inc()
+        if fire:
+            self._finalize(cand, decided_by="samples")
+
+    # -- label half -----------------------------------------------------
+    def on_label(self, request_id: int, y) -> None:
+        cand = self._pending
+        if cand is None:
+            return
+        fire = False
+        with self._lock:
+            pair = cand.cmp.pop(int(request_id), None)
+            if pair is None:
+                # shadow compare not parked (yet): remember the label so
+                # _shadow_batch can complete the join from its side. A
+                # bounded stash — most stashed rids belong to batches the
+                # seeded sampler skipped and will never be compared.
+                if len(cand.labels) >= 4096:
+                    cand.labels.pop(next(iter(cand.labels)))
+                cand.labels[int(request_id)] = int(y)
+                return
+            live_pred, shadow_pred = pair
+            yv = int(y)
+            cand.labeled += 1
+            if live_pred == yv:
+                cand.live_correct += 1
+            if shadow_pred == yv:
+                cand.shadow_correct += 1
+            fire = cand.labeled >= self.min_samples
+        if fire:
+            self._finalize(cand, decided_by="samples")
+
+    # -- verdict --------------------------------------------------------
+    def _finalize(self, cand: _Candidate, decided_by: str) -> None:
+        with self._lock:
+            if self._pending is not cand:
+                return
+            self._pending = None
+            live_acc = (cand.live_correct / cand.labeled
+                        if cand.labeled else None)
+            shadow_acc = (cand.shadow_correct / cand.labeled
+                          if cand.labeled else None)
+            agreement = (cand.agree / cand.compared
+                         if cand.compared else None)
+            if cand.labeled >= self.min_samples:
+                commit = shadow_acc >= live_acc - self.acc_margin
+            else:
+                # no evidence (traffic/labels dried up before the sample
+                # floor): fail OPEN — the trainer's decision stands, the
+                # verdict records that it went ungated
+                commit = True
+            verdict = {
+                "verdict": "commit" if commit else "rollback",
+                "reason": cand.rec.get("kind"),
+                "decided_by": decided_by,
+                "samples": cand.labeled,
+                "min_samples": self.min_samples,
+                "live_acc": (round(live_acc, 4)
+                             if live_acc is not None else None),
+                "shadow_acc": (round(shadow_acc, 4)
+                               if shadow_acc is not None else None),
+                "acc_delta": (round(shadow_acc - live_acc, 4)
+                              if cand.labeled else None),
+                "agreement": (round(agreement, 4)
+                              if agreement is not None else None),
+                "shadow_batches": cand.shadow_batches,
+                "slots": cand.slots,
+                "lineage_ids": cand.lineage_ids,
+            }
+            if commit:
+                self._events.append(cand.rec)
+        if commit:
+            version = self.engine.swap(
+                params=cand.plan.get("params"),
+                routing=cand.plan.get("routing"),
+                reason=cand.plan.get("reason", "canary"),
+                **cand.plan.get("evidence", {}))
+            verdict["version"] = version
+            self._commits.inc()
+        else:
+            self._rollbacks.inc()
+            self._raise_rollback_alert(verdict)
+        self.verdicts.append(verdict)
+        emit("canary_verdict", **verdict)
+        log.info("canary %s: %s %s (live=%s shadow=%s agree=%s n=%d)",
+                 verdict["verdict"], verdict["reason"],
+                 "<-".join(cand.lineage_ids), verdict["live_acc"],
+                 verdict["shadow_acc"], verdict["agreement"],
+                 cand.labeled)
+        # drain any event that arrived while this canary was open
+        with self._lock:
+            nxt = self._deferred.pop(0) if self._deferred else None
+        if nxt is not None:
+            self.engine.apply_cluster_event(nxt)
+
+    def abort(self) -> bool:
+        """Operator cancel: discard the pending candidate, keep the live
+        generation, no verdict event. The aborted cluster event is NOT
+        replayed (the operator is overriding the trainer); any deferred
+        events drain normally. Returns True when a canary was open."""
+        with self._lock:
+            cand = self._pending
+            self._pending = None
+            nxt = self._deferred.pop(0) if self._deferred else None
+        if nxt is not None:
+            self.engine.apply_cluster_event(nxt)
+        return cand is not None
+
+    def _raise_rollback_alert(self, verdict: dict) -> None:
+        lids = "<-".join(verdict["lineage_ids"]) or "?"
+        alert = {
+            "kind": "alert_raised",
+            "rule": "canary_rollback",
+            "severity": "crit",
+            "message": (f"{verdict['reason']} {lids} rolled back: "
+                        f"shadow acc {verdict['acc_delta']}"),
+            **{k: verdict[k] for k in ("live_acc", "shadow_acc",
+                                       "agreement", "samples", "slots",
+                                       "lineage_ids")},
+        }
+        emit("alert_raised", **{k: v for k, v in alert.items()
+                                if k != "kind"})
+        registry().counter("alerts_raised", rule="canary_rollback").inc()
+        if self.alerts_path:
+            obs_alerts.append_alert(self.alerts_path, alert)
+
+    # -- diagnostics ----------------------------------------------------
+    def state(self) -> str:
+        cand = self._pending
+        if cand is None:
+            return "idle"
+        return (f"{cand.rec.get('kind', '?')}:"
+                f"{cand.labeled}/{self.min_samples}")
+
+    def stats(self) -> dict:
+        cand = self._pending
+        return {
+            "state": self.state(),
+            "commits": int(self._commits.value),
+            "rollbacks": int(self._rollbacks.value),
+            "shadow_batches": int(self._shadow_batches.value),
+            "deferred": len(self._deferred),
+            "pending": None if cand is None else {
+                "reason": cand.rec.get("kind"),
+                "labeled": cand.labeled,
+                "compared": cand.compared,
+                "lineage_ids": cand.lineage_ids,
+            },
+            "verdicts": list(self.verdicts),
+        }

@@ -52,6 +52,7 @@ regress SERVE axis gates.
 
 from __future__ import annotations
 
+import itertools
 import json
 import logging
 import queue as queue_mod
@@ -324,19 +325,25 @@ class _Generation:
 
 @dataclass
 class ServeResult:
-    """One answered request."""
+    """One answered request. ``request_id`` keys the delayed-label loop:
+    pass it back through ``engine.observe_label(request_id, y)`` once the
+    ground truth arrives (obs/quality.py)."""
     logits: np.ndarray
     model: int
     version: int
+    request_id: int = -1
 
 
 class _Request:
-    __slots__ = ("client", "x", "ctx", "t0", "ts", "done", "result", "error")
+    __slots__ = ("client", "x", "ctx", "rid", "t0", "ts", "done", "result",
+                 "error")
 
-    def __init__(self, client: int, x: np.ndarray, ctx: dict) -> None:
+    def __init__(self, client: int, x: np.ndarray, ctx: dict,
+                 rid: int) -> None:
         self.client = client
         self.x = x
         self.ctx = ctx
+        self.rid = rid
         self.t0 = time.perf_counter()
         self.ts = time.time()
         self.done = threading.Event()
@@ -356,7 +363,8 @@ class InferenceEngine:
 
     def __init__(self, pool, routing: RoutingTable, mesh=None,
                  buckets=SERVE_BUCKETS, max_wait_s: float = 0.002,
-                 cost_capture: str = "off") -> None:
+                 cost_capture: str = "off", quality_window: int = 0,
+                 quality_ttl_s: float = 60.0) -> None:
         from feddrift_tpu.core.step import ForwardStep
         from feddrift_tpu.parallel.mesh import place_pool
 
@@ -383,6 +391,15 @@ class InferenceEngine:
         self._thread: threading.Thread | None = None
         self._sub_thread: threading.Thread | None = None
         self._swap_lock = threading.Lock()
+        self._rid = itertools.count(1)      # monotonic request ids
+        self._lat_p99_exemplar = (0.0, None, None)  # (lat, trace_id, client)
+        # model-quality plane (obs/quality.py): enabled by quality_window
+        # > 0 at construction or lazily by enable_quality()
+        self.quality = None
+        if quality_window > 0:
+            self.enable_quality(window=quality_window, ttl_s=quality_ttl_s)
+        self._canary = None                 # platform/canary.py controller
+        self._ops = None                    # fleet-lane OpsPublisher
 
         from feddrift_tpu import obs
         reg = obs.registry()
@@ -402,6 +419,9 @@ class InferenceEngine:
         return self
 
     def close(self) -> None:
+        if self._ops is not None:
+            self._ops.close()
+            self._ops = None
         with self._cond:
             self._stop = True
             self._cond.notify_all()
@@ -471,7 +491,7 @@ class InferenceEngine:
 
         from feddrift_tpu.obs import spans
         ctx = spans.child_of(trace) if trace else spans.new_trace()
-        req = _Request(client, xa, ctx)
+        req = _Request(client, xa, ctx, next(self._rid))
         with self._cond:
             self._queue.append(req)
             self._cond.notify()
@@ -517,6 +537,7 @@ class InferenceEngine:
     def _serve_batch(self, batch: list[_Request]) -> None:
         import jax.numpy as jnp
         from feddrift_tpu import obs
+        from feddrift_tpu.obs import live as obs_live
         from feddrift_tpu.obs import spans
 
         gen = self._gen      # ONE reference read: params+routing coherent
@@ -549,15 +570,33 @@ class InferenceEngine:
         for i, r in enumerate(live):
             lat = done - r.t0
             r.result = ServeResult(logits=out[i], model=int(mb[i]),
-                                   version=gen.version)
+                                   version=gen.version, request_id=r.rid)
             self._lat.observe(lat)
+            if lat > self._lat_p99_exemplar[0]:
+                # p99 exemplar: the worst request's trace id survives
+                # next to the sketch digest (surfaced in /status extras)
+                self._lat_p99_exemplar = (
+                    lat, r.ctx.get("trace_id"), r.client)
+                obs_live.record_exemplar(
+                    "request_latency_seconds_q", latency_s=round(lat, 6),
+                    trace_id=r.ctx.get("trace_id"), client=r.client,
+                    model=int(mb[i]), version=gen.version)
             spans.record("serve_request", r.ts, lat, cat="serve",
                          client=r.client, model=int(mb[i]), batch=b,
                          version=gen.version, **r.ctx)
             obs.emit("request_served", client=r.client, model=int(mb[i]),
                      version=gen.version, batch=b,
                      latency_ms=round(lat * 1e3, 3))
+            if self.quality is not None:
+                self.quality.record_prediction(r.rid, int(mb[i]), out[i],
+                                               client=r.client)
             r.done.set()
+        # shadow canary AFTER every live answer was released: duplicate-
+        # execute the (already padded) batch through the candidate
+        # generation — extra dispatcher occupancy only, zero answer-path
+        # latency, bitwise traffic-invisible
+        if self._canary is not None:
+            self._canary.on_batch(gen, live, routes, xb, mb, out, b)
     # lint: hot-path-end
 
     # -- hot swap -------------------------------------------------------
@@ -571,19 +610,13 @@ class InferenceEngine:
         grabbed the old generation keeps a fully consistent view and the
         next micro-batch gets a fully consistent new one.
         """
-        import jax
-        import jax.numpy as jnp
-        from feddrift_tpu.parallel.mesh import place_pool
         from feddrift_tpu import obs
 
         with self._swap_lock:
             cur = self._gen
             new_params = cur.params
             if params is not None:
-                new_params = place_pool(
-                    self.mesh,
-                    jax.tree_util.tree_map(jnp.asarray, params))
-                jax.block_until_ready(new_params)
+                new_params = self._place_params(params)
             new_routing = routing if routing is not None else cur.routing
             gen = _Generation(cur.version + 1, new_params, new_routing,
                               cur.num_models)
@@ -592,11 +625,42 @@ class InferenceEngine:
         obs.registry().counter("pool_swaps").inc()
         obs.emit("pool_swapped", version=gen.version, reason=reason,
                  models=gen.num_models, **evidence)
+        if self.quality is not None:
+            self.quality.on_swap()
         return gen.version
+
+    def _place_params(self, params):
+        """Convert + mesh-place a host pool pytree exactly the way
+        ``swap`` publishes one, so a canary's shadow forward replays the
+        warm-up signature (sharding + committed-ness identical)."""
+        import jax
+        import jax.numpy as jnp
+        from feddrift_tpu.parallel.mesh import place_pool
+        placed = place_pool(self.mesh,
+                            jax.tree_util.tree_map(jnp.asarray, params))
+        jax.block_until_ready(placed)
+        return placed
 
     def apply_cluster_event(self, rec: dict) -> int | None:
         """Fold one trainer cluster-structure event into a swap; returns
-        the new version, or None for irrelevant/ignored kinds."""
+        the new version, or None for irrelevant/ignored kinds — and None
+        while a ``CanaryController`` holds the event open as a shadow
+        canary (the swap publishes only on a commit verdict)."""
+        kind = rec.get("kind")
+        if self._canary is not None and self._canary.wants(kind):
+            return self._canary.intercept(rec)
+        plan = self._plan_cluster_event(rec)
+        if plan is None:
+            return None
+        if self._canary is not None:
+            self._canary.note_event(rec)
+        return self.swap(params=plan.get("params"), routing=plan["routing"],
+                         reason=plan["reason"], **plan.get("evidence", {}))
+
+    def _plan_cluster_event(self, rec: dict) -> dict | None:
+        """Build the candidate (params, routing) one cluster event
+        implies WITHOUT publishing it — the shared half of the immediate
+        swap and the canaried swap."""
         kind = rec.get("kind")
         if kind == "cluster_assign":
             # dense per-slot assignment; population mode carries the slot
@@ -610,7 +674,7 @@ class InferenceEngine:
                 c, m = int(slot), int(m)
                 if 0 <= c < rt.population and m >= 0:
                     rt.table[c] = m
-            return self.swap(routing=rt, reason="cluster_assign")
+            return {"routing": rt, "reason": "cluster_assign"}
         if kind == "cluster_merge":
             base, merged = int(rec["base"]), int(rec["merged"])
             rt = self._gen.routing.copy()
@@ -618,8 +682,8 @@ class InferenceEngine:
             # surviving lineage: the trainer folded merged's params into
             # base and reinitialized the merged slot, so re-homed clients
             # must read base — the routing rewrite IS the param swap
-            return self.swap(routing=rt, reason="cluster_merge",
-                             base=base, merged=merged)
+            return {"routing": rt, "reason": "cluster_merge",
+                    "evidence": {"base": base, "merged": merged}}
         if kind == "cluster_split":
             model, new_model = int(rec["model"]), int(rec["new_model"])
             moved = [int(c) for c in rec.get("clients_moved", [])]
@@ -630,14 +694,15 @@ class InferenceEngine:
             # child slot starts from the parent's params (nearest
             # surviving lineage) until the trainer pushes refined ones
             params = _copy_pool_slot(self._gen.params, new_model, model)
-            return self.swap(params=params, routing=rt,
-                             reason="cluster_split",
-                             model=model, new_model=new_model)
+            return {"params": params, "routing": rt,
+                    "reason": "cluster_split",
+                    "evidence": {"model": model, "new_model": new_model}}
         if kind == "cluster_delete":
             m = int(rec["model"])
             rt = self._gen.routing.copy()
             rt.table[rt.table == m] = -1
-            return self.swap(routing=rt, reason="cluster_delete", model=m)
+            return {"routing": rt, "reason": "cluster_delete",
+                    "evidence": {"model": m}}
         if kind == "cluster_create":
             model = int(rec["model"])
             rt = self._gen.routing.copy()
@@ -649,8 +714,9 @@ class InferenceEngine:
             if init_from is not None and int(init_from) >= 0:
                 params = _copy_pool_slot(self._gen.params, model,
                                          int(init_from))
-            return self.swap(params=params, routing=rt,
-                             reason="cluster_create", model=model)
+            return {"params": params, "routing": rt,
+                    "reason": "cluster_create",
+                    "evidence": {"model": model}}
         return None
 
     def attach_broker(self, client, topic: str = CLUSTER_TOPIC) -> None:
@@ -680,13 +746,101 @@ class InferenceEngine:
                 log.warning("serving: dropped malformed cluster event",
                             exc_info=True)
 
+    # -- model-quality plane (obs/quality.py, platform/canary.py) -------
+    def enable_quality(self, window: int = 100, ttl_s: float = 60.0,
+                       **kw) -> "InferenceEngine":
+        """Attach the streaming quality plane: per-model windowed
+        accuracy/confidence/entropy/ECE over the delayed-label join,
+        ``model_quality`` events every ``window`` labeled requests, and
+        the read-path entropy shift detector."""
+        from feddrift_tpu.obs.quality import QualityMonitor
+        self.quality = QualityMonitor(window=window, ttl_s=ttl_s, **kw)
+        return self
+
+    def observe_label(self, request_id: int, y) -> bool:
+        """Close the delayed-label loop for one served request (the id
+        rides on ``ServeResult.request_id``). Feeds the quality
+        estimators and any open canary's scoreboard; returns True when
+        the prediction was still joinable (not expired/evicted)."""
+        joined = None
+        if self.quality is not None:
+            joined = self.quality.observe_label(request_id, y)
+        if self._canary is not None:
+            self._canary.on_label(request_id, y)
+        return joined is not None
+
+    def attach_canary(self, controller) -> "InferenceEngine":
+        """Gate ``apply_cluster_event`` through a
+        ``platform.canary.CanaryController``: eligible cluster events
+        open shadow canaries instead of swapping immediately."""
+        self._canary = controller
+        return self
+
+    @property
+    def canary(self):
+        return self._canary
+
+    def attach_ops(self, client, lane: str | None = None,
+                   interval_s: float = 2.0, slo=None) -> "InferenceEngine":
+        """Join the fleet plane: publish this engine's snapshot on the
+        ``<ns>/ops/serve/<pid>`` lane so replicated serving engines show
+        up in the ``fleet`` table next to runner/edge lanes."""
+        import os
+
+        from feddrift_tpu.obs.live import OpsPublisher, StatusBoard
+        board = StatusBoard()
+        last = {"served": 0, "ts": time.monotonic()}
+
+        def extra() -> dict:
+            now = time.monotonic()
+            served = int(self._served.value)
+            dt = now - last["ts"]
+            rps = (served - last["served"]) / dt if dt > 0 else 0.0
+            last["served"], last["ts"] = served, now
+            board.beat()
+            board.update(pool_version=self._gen.version)
+            lat, trace_id, client_id = self._lat_p99_exemplar
+            out = {"requests_per_s": round(rps, 2),
+                   "pool_version": self._gen.version,
+                   "canary": (self._canary.state()
+                              if self._canary is not None else None),
+                   "p99_exemplar": ({"latency_s": round(lat, 6),
+                                     "trace_id": trace_id,
+                                     "client": client_id}
+                                    if trace_id is not None else None)}
+            if self.quality is not None:
+                out["quality"] = {"accuracy": self.quality.accuracy(),
+                                  "labeled": self.quality.labeled}
+            return out
+
+        self._ops = OpsPublisher(
+            client, lane if lane is not None else f"serve/{os.getpid()}",
+            interval_s=interval_s, slo=slo, board=board,
+            extra_fn=extra).start()
+        return self
+
     # -- diagnostics ----------------------------------------------------
+    def reset_latency_stats(self) -> None:
+        """Restart the request-latency digest + p99 exemplar in place.
+        Benchmarks call this between closed-loop warm-up and measurement
+        so the exported p99 covers only measured traffic — the warm-up
+        phase's cold-cache tail otherwise dominates the P² sketch for the
+        whole run (a full registry reset would instead orphan the
+        engine's held instrument references)."""
+        self._lat.reset()
+        self._lat_p99_exemplar = (0.0, None, None)
+
     def stats(self) -> dict:
         snap = self._lat.snapshot()
-        return {"served": int(self._served.value),
-                "batches": int(self._batches.value),
-                "version": self._gen.version,
-                "latency": snap}
+        out = {"served": int(self._served.value),
+               "batches": int(self._batches.value),
+               "version": self._gen.version,
+               "latency": snap}
+        if self.quality is not None:
+            out["quality"] = self.quality.snapshot()
+        if self._canary is not None:
+            out["canary"] = self._canary.stats()
+        return out
 
 
 def _copy_pool_slot(params, dst: int, src: int):

@@ -235,7 +235,8 @@ class Experiment:
             rounds_per_s=cfg.slo_rounds_per_s,
             host_overhead=cfg.slo_host_overhead,
             p99_round_wall_s=cfg.slo_p99_round_wall_s,
-            eval_gap=cfg.slo_eval_gap)
+            eval_gap=cfg.slo_eval_gap,
+            model_accuracy=cfg.slo_model_accuracy)
         if self._ops_active or any(v > 0 for v in slo_thresholds.values()):
             self.slo = obs.live.SLOEngine(
                 objectives=obs.live.default_slos(**slo_thresholds),

@@ -53,6 +53,13 @@
 #    reflects the degradation, and after a broker restart on the same
 #    port the replayed subscription resumes hot-swaps (a cluster event
 #    published post-recovery re-routes live requests).
+# 12) canary domain — a cluster merge whose CANDIDATE generation params
+#    are corrupt (classifier layer negated: flipped logits) lands
+#    mid-traffic on a canaried engine: the shadow-scored verdict ROLLS
+#    BACK (live generation kept, routing untouched), a crit
+#    canary_rollback alert lands in alerts.jsonl, and the closed-loop
+#    traffic flowing throughout sees ZERO errors — the corrupt swap is
+#    traffic-invisible.
 #
 # Usage: scripts/chaos_smoke.sh            (~2-3 min on one CPU core)
 set -euo pipefail
@@ -63,12 +70,12 @@ OUT=$(mktemp -d)
 trap 'rm -rf "$OUT"' EXIT
 RUN="$OUT/run"
 
-echo "== [1/11] chaos transport e2e (drop_prob=0.2 + broker kill/restart) =="
+echo "== [1/12] chaos transport e2e (drop_prob=0.2 + broker kill/restart) =="
 timeout -k 10 300 python -m pytest tests/test_resilience.py -q \
     -p no:cacheprovider -p no:randomly \
     -k "ChaosEndToEnd or survives_broker_kill or heartbeat_missed"
 
-echo "== [2/11] preemption: SIGTERM a real run, then --auto_resume =="
+echo "== [2/12] preemption: SIGTERM a real run, then --auto_resume =="
 ARGS=(--dataset sine --model fnn --concept_drift_algo win-1
       --concept_num 2 --client_num_in_total 4 --client_num_per_round 4
       --train_iterations 6 --comm_round 8 --epochs 2
@@ -105,15 +112,15 @@ print(f"resume OK: {len(rows)} metric rows, final Test/Acc="
       f"{rows[-1]['Test/Acc']:.4f}")
 EOF
 
-echo "== [3/11] event taxonomy consistency (strict: no dead kinds) =="
+echo "== [3/12] event taxonomy consistency (strict: no dead kinds) =="
 python scripts/check_events_schema.py --strict
 
-echo "== [4/11] byzantine smoke: trimmed_mean defends where mean fails =="
+echo "== [4/12] byzantine smoke: trimmed_mean defends where mean fails =="
 timeout -k 10 300 python -m pytest tests/test_robust_agg.py -q \
     -p no:cacheprovider -p no:randomly \
     -k "trimmed_mean_defends_where_mean_fails"
 
-echo "== [5/11] decision observability: kill clients -> alerts + lineage =="
+echo "== [5/12] decision observability: kill clients -> alerts + lineage =="
 LRUN="$OUT/lineage-run"
 timeout -k 10 300 python - "$LRUN" <<'EOF'
 import sys
@@ -147,7 +154,7 @@ python -m feddrift_tpu report "$LRUN" > "$OUT/report.txt"
 grep -q "alerts:" "$OUT/report.txt" \
     || { echo "report missing alerts section"; exit 1; }
 
-echo "== [6/11] participation: 10^3 population, 20% stragglers + churn =="
+echo "== [6/12] participation: 10^3 population, 20% stragglers + churn =="
 PRUN="$OUT/population-run"
 timeout -k 10 300 python -m feddrift_tpu run \
     --dataset sea --model fnn --concept_drift_algo softcluster \
@@ -166,7 +173,7 @@ python -m feddrift_tpu report "$PRUN" > "$OUT/preport.txt"
 grep -q "participation:" "$OUT/preport.txt" \
     || { echo "report missing participation section"; exit 1; }
 
-echo "== [7/11] fused participation: megastep_k=4 kill -> resume, same cohorts =="
+echo "== [7/12] fused participation: megastep_k=4 kill -> resume, same cohorts =="
 FREF="$OUT/fused-ref"
 FRUN="$OUT/fused-run"
 FARGS=(--dataset sea --model fnn --concept_drift_algo oblivious
@@ -224,7 +231,7 @@ print(f"fused resume OK: {len(c_ref)} iterations, identical cohort "
       f"schedule, {len(rows)} metric rows")
 EOF
 
-echo "== [8/11] hierarchy: 10^3 population, kill edge 0 mid-run =="
+echo "== [8/12] hierarchy: 10^3 population, kill edge 0 mid-run =="
 HRUN="$OUT/hierarchy-run"
 timeout -k 10 300 python -m feddrift_tpu run \
     --dataset sea --model fnn --concept_drift_algo softcluster \
@@ -262,12 +269,12 @@ grep -q "hierarchy:" "$OUT/hreport.txt" \
 grep -q "re-homed:" "$OUT/hreport.txt" \
     || { echo "report missing re-homed line"; exit 1; }
 
-echo "== [9/11] causal trace continuity across broker reconnect =="
+echo "== [9/12] causal trace continuity across broker reconnect =="
 timeout -k 10 300 python -m pytest tests/test_causal_trace.py -q \
     -p no:cacheprovider -p no:randomly \
     -k "trace_survives_broker_reconnect"
 
-echo "== [10/11] live ops plane: broker kill -> /healthz 503 + slo_burn -> recovery =="
+echo "== [10/12] live ops plane: broker kill -> /healthz 503 + slo_burn -> recovery =="
 ORUN="$OUT/ops-run"
 mkdir -p "$ORUN"
 timeout -k 10 300 python - "$ORUN" <<'EOF'
@@ -335,7 +342,7 @@ print(f"  recovery OK: /healthz {code} {doc['status']}, "
 client.close(); srv.close(); broker2.close()
 EOF
 
-echo "== [11/11] serving: broker kill mid-traffic -> degrade, swaps resume =="
+echo "== [11/12] serving: broker kill mid-traffic -> degrade, swaps resume =="
 SRUN="$OUT/serve-run"
 mkdir -p "$SRUN"
 timeout -k 10 300 python - "$SRUN" <<'EOF'
@@ -457,6 +464,92 @@ stats = engine.stats()
 engine.close(); client.close(); srv.close(); broker2.close()
 print(f"  recovery OK: {stats['served']} served total, 0 errors, "
       f"pool version {stats['version']}")
+EOF
+
+echo "== [12/12] canary: corrupt candidate mid-swap -> rollback + crit alert, 0 errors =="
+CRUN="$OUT/canary-run"
+mkdir -p "$CRUN"
+timeout -k 10 300 python - "$CRUN" <<'EOF'
+import json, os, sys, threading, time
+import numpy as np
+import jax.numpy as jnp
+from feddrift_tpu import obs
+from feddrift_tpu.config import ExperimentConfig
+from feddrift_tpu.core.pool import ModelPool
+from feddrift_tpu.data.registry import make_dataset
+from feddrift_tpu.models import create_model
+from feddrift_tpu.platform.canary import CanaryController
+from feddrift_tpu.platform.serving import InferenceEngine, RoutingTable
+
+out = sys.argv[1]
+obs.configure(os.path.join(out, "events.jsonl"))
+apath = os.path.join(out, "alerts.jsonl")
+
+cfg = ExperimentConfig(dataset="sea", train_iterations=2, sample_num=16)
+ds = make_dataset(cfg)
+pool = ModelPool.create(create_model("fnn", ds, cfg),
+                        jnp.asarray(ds.x[0, 0, :2]), 2, seed=7,
+                        identical=False)
+# corrupt the CANDIDATE: the merge survivor (slot 0) holds slot 1's
+# params with the classifier layer negated — every re-homed client
+# would get flipped logits if the swap published
+p1 = pool.slot(1)
+last = sorted(p1.keys())[-1]
+pool.set_slot(0, {k: ({kk: -vv for kk, vv in v.items()} if k == last
+                      else v) for k, v in p1.items()})
+engine = InferenceEngine(pool, RoutingTable([1] * 8),
+                         buckets=(1, 2, 4)).start()
+engine.enable_quality(window=100)
+ctl = CanaryController(engine, fraction=1.0, min_samples=32, seed=1,
+                       alerts_path=apath)
+engine.attach_canary(ctl)
+engine.warmup()
+
+# closed-loop labeled traffic for the WHOLE scenario: any request
+# failing while the corrupt candidate is shadow-scored fails the stage
+stop = threading.Event()
+served, errors = [0], [0]
+def pump(w):
+    rng = np.random.RandomState(w)
+    while not stop.is_set():
+        try:
+            r = engine.submit(int(rng.randint(8)),
+                              rng.standard_normal(3).astype(np.float32))
+            engine.observe_label(r.request_id, int(np.argmax(r.logits)))
+            served[0] += 1
+        except Exception:
+            errors[0] += 1
+pumps = [threading.Thread(target=pump, args=(w,), daemon=True)
+         for w in range(4)]
+for t in pumps:
+    t.start()
+
+v0 = engine.version
+engine.apply_cluster_event({"kind": "cluster_merge", "base": 0,
+                            "merged": 1, "iteration": 1})
+deadline = time.monotonic() + 60.0
+while not ctl.verdicts and time.monotonic() < deadline:
+    time.sleep(0.1)
+stop.set()
+for t in pumps:
+    t.join(timeout=5)
+assert ctl.verdicts, "canary verdict never fired under live traffic"
+v = ctl.verdicts[-1]
+assert v["verdict"] == "rollback", v
+assert engine.version == v0, "corrupt candidate was published"
+assert engine.submit(3, np.zeros(3, np.float32)).model == 1, \
+    "routing changed despite rollback"
+assert errors[0] == 0, f"{errors[0]} requests failed during the canary"
+alerts = [json.loads(l) for l in open(apath) if l.strip()]
+assert any(a.get("rule") == "canary_rollback"
+           and a.get("severity") == "crit" for a in alerts), alerts
+engine.close()
+kinds = [json.loads(l)["kind"]
+         for l in open(os.path.join(out, "events.jsonl"))]
+assert "canary_started" in kinds and "canary_verdict" in kinds
+print(f"  rollback OK: shadow_acc={v['shadow_acc']} vs "
+      f"live_acc={v['live_acc']} over {v['samples']} labels, "
+      f"{served[0]} requests served, 0 errors")
 EOF
 
 echo "chaos_smoke: ALL OK"

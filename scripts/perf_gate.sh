@@ -16,18 +16,18 @@ cd "$(dirname "$0")/.."
 out="$(mktemp -d)"
 trap 'rm -rf "$out"' EXIT
 
-echo "[perf_gate 1/11] graftlint: static analysis must be clean"
+echo "[perf_gate 1/12] graftlint: static analysis must be clean"
 # cheapest stage first: the lint verb is pre-jax and runs in ~1s; a dirty
 # tree fails the gate before any bench spends minutes compiling
 python -m feddrift_tpu lint feddrift_tpu/ --strict
 
-echo "[perf_gate 2/11] warm run (populates the persistent compile cache)"
+echo "[perf_gate 2/12] warm run (populates the persistent compile cache)"
 python bench.py --smoke --cpu > "$out/warm.json"
 
-echo "[perf_gate 3/11] measured run"
+echo "[perf_gate 3/12] measured run"
 python bench.py --smoke --cpu > "$out/bench.json"
 
-echo "[perf_gate 4/11] cost-model + critical-path fields present"
+echo "[perf_gate 4/12] cost-model + critical-path fields present"
 python - "$out/bench.json" <<'EOF'
 import json, sys
 d = json.loads(open(sys.argv[1]).read().strip().splitlines()[-1])
@@ -44,7 +44,7 @@ print(f"  mfu_estimate={d['mfu_estimate']} (source={d['mfu']['source']}), "
       f"round_wall_p99_s={d['round_wall_p99_s']}")
 EOF
 
-echo "[perf_gate 5/11] critical_path on a smoke run dir"
+echo "[perf_gate 5/12] critical_path on a smoke run dir"
 # bench.py runs without an out_dir (no spans.jsonl), so the attribution
 # verb gets its own tiny recorded run: 2 iterations, per-round path.
 JAX_PLATFORMS=cpu python -m feddrift_tpu run \
@@ -68,7 +68,7 @@ print(f"  dominant_segment={d['dominant_segment']}, "
       f"host_overhead_frac_mean={d['host_overhead_frac_mean']}")
 EOF
 
-echo "[perf_gate 6/11] megastep: K=4 vs K=1 bitwise parity + zero steady recompiles"
+echo "[perf_gate 6/12] megastep: K=4 vs K=1 bitwise parity + zero steady recompiles"
 # the megastep fuses K whole iterations into one device program; the gate
 # is (a) bitwise-identical params/accuracy vs the K=1 driver and (b) no
 # jit cache growth past the single warm-up compile across blocks
@@ -101,7 +101,7 @@ print(f"  parity OK (leafdiff=0.0, {len(a4)} eval points), "
       f"megastep cache entries={n}")
 EOF
 
-echo "[perf_gate 7/11] composed megastep: population+hierarchy K=4 parity + throughput"
+echo "[perf_gate 7/12] composed megastep: population+hierarchy K=4 parity + throughput"
 # the megastep gate is per-feature: population cohorts, hierarchy and
 # chaos schedules all fuse now. Gate is (a) bitwise parity (params, eval
 # series, registry bookkeeping) vs the K=1 driver, (b) no megastep jit
@@ -182,7 +182,7 @@ print(f"  parity OK (leafdiff=0.0, {len(a4)} eval points); "
 assert r4 >= r1, f"composed K=4 slower than its own K=1: {r4:.1f} vs {r1:.1f}"
 EOF
 
-echo "[perf_gate 8/11] serving: batched >= 3x unbatched rps, zero steady recompiles"
+echo "[perf_gate 8/12] serving: batched >= 3x unbatched rps, zero steady recompiles"
 # The cluster-routed read path (platform/serving.py): warm every bucket,
 # drive a seeded closed loop twice — unbatched (bucket set {1}) and
 # batched — and hold (a) an absolute unbatched requests/s floor (sanity:
@@ -238,7 +238,7 @@ assert un["requests_per_s"] >= 200, \
 assert ratio >= 3.0, f"micro-batching payoff collapsed: {ratio:.2f}x"
 EOF
 
-echo "[perf_gate 9/11] precision: bf16_mixed smoke (accuracy + recompiles) + artifact gate"
+echo "[perf_gate 9/12] precision: bf16_mixed smoke (accuracy + recompiles) + artifact gate"
 # End-to-end precision policy (core/precision.py): a fast fnn smoke proves
 # the policy actually reaches the compiled round program — bf16 pool
 # params, one jit signature per function under BOTH policies (dtype flips
@@ -296,7 +296,7 @@ EOF
 python -m feddrift_tpu regress PRECISION_r15.json \
     --baseline PRECISION_r15.json --tol-precision-acc 0.05
 
-echo "[perf_gate 10/11] regress: self-comparison (warm), then vs BENCH_r05.json"
+echo "[perf_gate 10/12] regress: self-comparison (warm), then vs BENCH_r05.json"
 # back-to-back smoke runs on a busy 1-core host: generous relative noise
 # margins, but identical round counts make every metric comparable
 python -m feddrift_tpu regress "$out/bench.json" --baseline "$out/warm.json" \
@@ -307,7 +307,7 @@ python -m feddrift_tpu regress "$out/bench.json" --baseline "$out/warm.json" \
 python -m feddrift_tpu regress "$out/bench.json" --baseline BENCH_r05.json \
     --tol-rounds 0.9 --tol-acc 0.15
 
-echo "[perf_gate 11/11] ops plane overhead: enabled run within 2% of disabled"
+echo "[perf_gate 11/12] ops plane overhead: enabled run within 2% of disabled"
 # The /metrics + /healthz server, SLO engine and status tap must stay off
 # the hot path. Resolving a 2% bound on a noisy 1-core host needs a
 # paired design: BOTH experiments live in one process, iterations
@@ -357,6 +357,79 @@ print(f"  rounds/s ops-off={off_rps:.3f} ops-on={on_rps:.3f} "
       f"ratio={on_rps / off_rps:.4f} (floor 0.98)")
 assert on_rps >= 0.98 * off_rps, \
     f"ops plane costs more than 2%: {on_rps:.3f} vs {off_rps:.3f} rounds/s"
+EOF
+
+echo "[perf_gate 12/12] canary shadow overhead: canary-on within 5% of canary-off rps"
+# The shadow canary duplicate-executes a seeded fraction of affected
+# micro-batches through the candidate generation (platform/canary.py).
+# Leg-level throughput on a shared host swings far more than the 5%
+# bound, so the gate scores PAIRS: each turn runs one canary-off and
+# one canary-on leg back-to-back (order flipped per turn) and records
+# the on/off ratio; a real >5% overhead would drag every pair down,
+# while machine noise leaves some pair near parity. Pass if the best
+# paired ratio — or the cross-turn median ratio — clears 0.95.
+JAX_PLATFORMS=cpu python - <<'EOF'
+import numpy as np
+import jax.numpy as jnp
+from feddrift_tpu import obs
+from feddrift_tpu.config import ExperimentConfig
+from feddrift_tpu.core.pool import ModelPool
+from feddrift_tpu.data.registry import make_dataset
+from feddrift_tpu.models import create_model
+from feddrift_tpu.platform.canary import CanaryController
+from feddrift_tpu.platform.serving import (InferenceEngine, RoutingTable,
+                                           TrafficGenerator)
+
+cfg = ExperimentConfig(dataset="sea", train_iterations=2, sample_num=16)
+ds = make_dataset(cfg)
+mod = create_model("fnn", ds, cfg)
+pool = ModelPool.create(mod, jnp.asarray(ds.x[0, 0, :2]), 4, seed=7,
+                        identical=False)
+routing = np.random.RandomState(14).randint(0, 4, 64)
+
+def recompiles():
+    return sum(v for k, v in obs.registry().snapshot().items()
+               if k.startswith('jit_recompiles{fn="serve_forward'))
+
+eng = InferenceEngine(pool, RoutingTable(routing),
+                      buckets=(1, 2, 4, 8, 16, 32)).start()
+ctl = CanaryController(eng, fraction=0.1, min_samples=10**9, seed=3,
+                       timeout_s=10**9)
+eng.attach_canary(ctl)
+eng.warmup()
+gen = TrafficGenerator(eng, list(range(64)), seed=0, concurrency=32)
+
+def leg(canary_on):
+    if canary_on:
+        eng.apply_cluster_event({"kind": "cluster_merge", "base": 2,
+                                 "merged": 3})
+    stats = gen.run(2000)
+    if canary_on:
+        assert ctl.abort(), "canary leg ran without an open canary"
+    return stats
+
+leg(False); leg(True)                    # warm both modes, unmeasured
+r0 = recompiles()
+legs = {"off": [], "on": []}
+for turn in range(6):
+    order = ((True, "on"), (False, "off")) if turn % 2 else \
+            ((False, "off"), (True, "on"))
+    for canary_on, name in order:
+        stats = leg(canary_on)
+        assert stats["errors"] == 0, stats
+        legs[name].append(stats["requests_per_s"])
+steady = recompiles() - r0
+eng.close()
+pair_ratios = [on / off for off, on in zip(legs["off"], legs["on"])]
+med = float(np.median(legs["on"]) / np.median(legs["off"]))
+score = max(max(pair_ratios), med)
+print(f"  off med={np.median(legs['off']):.0f} rps, "
+      f"on med={np.median(legs['on']):.0f} rps, "
+      f"pair ratios={[round(r, 3) for r in pair_ratios]}, "
+      f"score={score:.3f} (floor 0.95), steady_recompiles={steady}")
+assert steady == 0, f"shadow execution recompiled: {steady}"
+assert score >= 0.95, \
+    f"shadow overhead above 5%: best pair {max(pair_ratios):.3f}, median {med:.3f}"
 EOF
 
 echo "perf_gate: OK"

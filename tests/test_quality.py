@@ -1,0 +1,382 @@
+"""Model-quality plane tests (obs/quality.py + platform/canary.py).
+
+Covers the three streaming estimators host-side (delayed-label join with
+TTL/capacity bounds, calibration sketch, entropy-shift KS detector), the
+engine-attached quality monitor (live accuracy equals the client-side
+oracle on the same stream), and the lineage-aware shadow canary: a clean
+merge COMMITS, a corrupted candidate ROLLS BACK with a crit alert, events
+arriving mid-canary defer and drain, a dried-up canary fails open on
+timeout, and operator abort discards the candidate without a verdict.
+"""
+
+import json
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from feddrift_tpu import obs
+from feddrift_tpu.config import ExperimentConfig
+from feddrift_tpu.core.pool import ModelPool
+from feddrift_tpu.data.registry import make_dataset
+from feddrift_tpu.models import create_model
+from feddrift_tpu.obs.quality import (EntropyShiftDetector, LabelJoiner,
+                                      QualityMonitor, StreamingECE,
+                                      _Pending, prediction_stats)
+from feddrift_tpu.platform.canary import CanaryController
+from feddrift_tpu.platform.serving import InferenceEngine, RoutingTable
+
+
+@pytest.fixture()
+def bus():
+    b = obs.configure(None)
+    yield b
+    obs.configure(None)
+
+
+def _pool(M=2, identical=False):
+    cfg = ExperimentConfig(dataset="sea", train_iterations=2, sample_num=16)
+    ds = make_dataset(cfg)
+    mod = create_model("fnn", ds, cfg)
+    return ModelPool.create(mod, jnp.zeros((2, 3)), M, seed=7,
+                            identical=identical)
+
+
+def _engine(pool, table, **kw):
+    kw.setdefault("buckets", (1, 2, 4))
+    kw.setdefault("max_wait_s", 0.002)
+    return InferenceEngine(pool, RoutingTable(table), **kw)
+
+
+def _anti(params):
+    """Negate the classifier (last) layer: logits flip, so every
+    prediction disagrees with the original — same entropy, wrong class."""
+    last = sorted(params.keys())[-1]
+    return {k: ({kk: -vv for kk, vv in v.items()} if k == last else v)
+            for k, v in params.items()}
+
+
+class TestPredictionStats:
+    def test_confident_vs_uniform(self):
+        pred, conf, ent = prediction_stats([10.0, 0.0])
+        assert pred == 0 and conf > 0.99 and ent < 0.01
+        _, conf_u, ent_u = prediction_stats([0.0, 0.0])
+        assert abs(conf_u - 0.5) < 1e-9
+        assert abs(ent_u - np.log(2)) < 1e-9
+
+
+class TestLabelJoiner:
+    def test_join_and_miss(self):
+        j = LabelJoiner(ttl_s=60, time_fn=lambda: 100.0)
+        j.record(1, _Pending(0, 5, 1, 0.9, 0.1, 100.0))
+        assert j.pop(1).pred == 1
+        assert j.pop(1) is None          # consumed
+        assert j.pop(42) is None         # never recorded
+
+    def test_garbage_request_id_is_a_miss(self):
+        # labels come from external feedback loops: a non-numeric or
+        # wrong-typed id must degrade to a miss, never raise
+        j = LabelJoiner(ttl_s=60, time_fn=lambda: 100.0)
+        j.record(1, _Pending(0, 5, 1, 0.9, 0.1, 100.0))
+        assert j.pop("not-a-request-id") is None
+        assert j.pop(None) is None
+        assert j.pop(1.0).pred == 1      # numeric strings/floats coerce
+
+    def test_ttl_expiry(self):
+        t = [0.0]
+        j = LabelJoiner(ttl_s=10, time_fn=lambda: t[0])
+        j.record(1, _Pending(0, 0, 1, 0.9, 0.1, t[0]))
+        t[0] = 11.0
+        assert j.pop(1) is None and j.expired == 1
+        # the sweep also evicts from the front on later inserts
+        j.record(2, _Pending(0, 0, 1, 0.9, 0.1, t[0]))
+        t[0] = 30.0
+        j.record(3, _Pending(0, 0, 1, 0.9, 0.1, t[0]))
+        assert len(j) == 1 and j.expired == 2
+
+    def test_capacity_eviction(self):
+        j = LabelJoiner(ttl_s=1e9, capacity=3, time_fn=lambda: 100.0)
+        for rid in range(5):
+            j.record(rid, _Pending(0, 0, 1, 0.9, 0.1, 100.0))
+        assert len(j) == 3 and j.evicted == 2
+        assert j.pop(0) is None and j.pop(4) is not None
+
+
+class TestStreamingECE:
+    def test_empty_is_none(self):
+        assert StreamingECE().ece() is None
+
+    def test_perfect_calibration_near_zero(self):
+        e = StreamingECE(bins=10)
+        rng = np.random.RandomState(0)
+        for _ in range(4000):
+            conf = rng.uniform(0.5, 1.0)
+            e.observe(conf, bool(rng.uniform() < conf))
+        assert e.ece() < 0.05
+
+    def test_overconfidence_shows_up(self):
+        e = StreamingECE(bins=10)
+        for _ in range(100):
+            e.observe(0.95, False)       # always wrong at conf .95
+        assert e.ece() > 0.9
+
+
+class TestEntropyShiftDetector:
+    def test_stationary_never_fires(self):
+        # window 32: the two-sample KS null for n=m=32 sits well below
+        # the 0.5 threshold, so iid noise cannot cross it
+        d = EntropyShiftDetector(window=32, threshold=0.5)
+        rng = np.random.RandomState(0)
+        assert all(d.observe(0.5 + 0.01 * rng.standard_normal()) is None
+                   for _ in range(500))
+
+    def test_step_shift_fires_once_and_reanchors(self):
+        d = EntropyShiftDetector(window=16, threshold=0.5)
+        rng = np.random.RandomState(1)
+        fired = [s for s in (d.observe(0.6 + 0.02 * rng.standard_normal())
+                             for _ in range(100)) if s is not None]
+        fired += [s for s in (d.observe(0.1 + 0.02 * rng.standard_normal())
+                              for _ in range(100)) if s is not None]
+        assert len(fired) == 1 and fired[0] >= 0.5
+        # after re-anchoring the shifted regime is the new normal
+        assert all(d.observe(0.1 + 0.02 * rng.standard_normal()) is None
+                   for _ in range(100))
+
+    def test_reset_reanchors(self):
+        d = EntropyShiftDetector(window=8, threshold=0.5)
+        for _ in range(20):
+            d.observe(0.9)
+        d.reset()
+        assert all(d.observe(0.1) is None for _ in range(50))
+
+
+class TestQualityMonitor:
+    def test_join_accuracy_and_event_cadence(self, bus):
+        m = QualityMonitor(window=5)
+        rng = np.random.RandomState(0)
+        correct = []                     # prediction is always class 0
+        for rid in range(10):
+            m.record_prediction(rid, model=rid % 2, logits=[2.0, -1.0])
+            y = 0 if rng.uniform() < 0.7 else 1
+            rec = m.observe_label(rid, y)
+            assert rec is not None and rec["model"] == rid % 2
+            correct.append(y == 0)
+        snap = m.snapshot()
+        assert snap["labeled"] == 10 and snap["missed"] == 0
+        # the estimate is WINDOWED: last `window` labels only
+        assert snap["accuracy"] == pytest.approx(np.mean(correct[-5:]))
+        # one model_quality event per full window of labels
+        assert sum(1 for e in bus.events()
+                   if e["kind"] == "model_quality") == 2
+        assert set(snap["per_model"]) == {"0", "1"}
+
+    def test_unknown_label_counts_missed(self):
+        m = QualityMonitor(window=5)
+        assert m.observe_label(999, 0) is None
+        assert m.snapshot()["missed"] == 1
+
+    def test_drift_event_from_prediction_stream(self, bus):
+        m = QualityMonitor(window=100, drift_window=8, drift_threshold=0.5)
+        for rid in range(16):
+            m.record_prediction(rid, 0, [8.0, 0.0])     # low entropy
+        for rid in range(16, 64):
+            m.record_prediction(rid, 0, [0.05, 0.0])    # high entropy
+        assert m.drift_suspected >= 1
+        kinds = [e["kind"] for e in bus.events()]
+        assert "serve_drift_suspected" in kinds
+
+    def test_on_swap_resets_detector(self):
+        m = QualityMonitor(window=100, drift_window=8, drift_threshold=0.5)
+        for rid in range(16):
+            m.record_prediction(rid, 0, [8.0, 0.0])
+        m.on_swap()
+        for rid in range(16, 64):
+            m.record_prediction(rid, 0, [0.05, 0.0])
+        assert m.drift_suspected == 0   # new regime became the reference
+
+
+class TestEngineQuality:
+    def test_live_accuracy_matches_client_oracle(self, bus):
+        pool = _pool(M=2)
+        eng = _engine(pool, [0, 1, 0, 1]).start()
+        eng.enable_quality(window=50)
+        try:
+            eng.warmup()
+            rng = np.random.RandomState(3)
+            oracle = []
+            for i in range(40):
+                r = eng.submit(i % 4, rng.standard_normal(3)
+                               .astype(np.float32))
+                pred = int(np.argmax(r.logits))
+                y = pred if rng.uniform() >= 0.25 else 1 - pred
+                assert eng.observe_label(r.request_id, y)
+                oracle.append(pred == y)
+            snap = eng.quality.snapshot()
+            assert snap["labeled"] == 40
+            assert snap["accuracy"] == pytest.approx(np.mean(oracle))
+        finally:
+            eng.close()
+
+
+class TestCanary:
+    def _run_labeled(self, eng, ctl, n=200, seed=0):
+        """Closed loop y := live prediction — live is 'always right',
+        so the verdict isolates the candidate's (dis)agreement."""
+        rng = np.random.RandomState(seed)
+        pop = eng._gen.routing.population
+        for i in range(n):
+            if ctl.verdicts:
+                return
+            r = eng.submit(i % pop, rng.standard_normal(3)
+                           .astype(np.float32))
+            eng.observe_label(r.request_id, int(np.argmax(r.logits)))
+
+    def test_clean_merge_commits_with_lineage(self, bus):
+        pool = _pool(M=2)
+        pool.copy_slot(1, 0)             # genuinely converged clusters
+        eng = _engine(pool, [0, 1, 0, 1]).start()
+        ctl = CanaryController(eng, fraction=1.0, min_samples=8, seed=1)
+        eng.attach_canary(ctl)
+        try:
+            eng.warmup()
+            v0 = eng.version
+            eng.apply_cluster_event({"kind": "cluster_merge", "base": 0,
+                                     "merged": 1, "iteration": 1})
+            assert eng.version == v0     # gated: no immediate swap
+            assert ctl.state().startswith("cluster_merge")
+            self._run_labeled(eng, ctl)
+            assert ctl.verdicts, "canary never reached min_samples"
+            v = ctl.verdicts[-1]
+            assert v["verdict"] == "commit"
+            assert v["agreement"] == pytest.approx(1.0)
+            assert v["samples"] >= 8
+            assert len(v["lineage_ids"]) == 2
+            assert eng.version > v0      # the swap published on commit
+            assert eng.submit(1, np.zeros(3, np.float32)).model == 0
+            kinds = [e["kind"] for e in bus.events()]
+            assert "canary_started" in kinds and "canary_verdict" in kinds
+        finally:
+            eng.close()
+
+    def test_corrupt_candidate_rolls_back_with_crit_alert(self, bus,
+                                                          tmp_path):
+        pool = _pool(M=2)
+        # survivor slot 0 is the ANTI-model of slot 1: the candidate
+        # answers every re-homed client with flipped logits
+        pool.set_slot(0, _anti(pool.slot(1)))
+        eng = _engine(pool, [1, 1, 1, 1]).start()
+        alerts = tmp_path / "alerts.jsonl"
+        ctl = CanaryController(eng, fraction=1.0, min_samples=8, seed=1,
+                               alerts_path=str(alerts))
+        eng.attach_canary(ctl)
+        try:
+            eng.warmup()
+            v0 = eng.version
+            eng.apply_cluster_event({"kind": "cluster_merge", "base": 0,
+                                     "merged": 1, "iteration": 1})
+            self._run_labeled(eng, ctl)
+            assert ctl.verdicts
+            v = ctl.verdicts[-1]
+            assert v["verdict"] == "rollback"
+            assert v["shadow_acc"] < v["live_acc"] - 0.02
+            assert v["agreement"] < 0.1
+            assert eng.version == v0     # live generation kept
+            assert eng.submit(0, np.zeros(3, np.float32)).model == 1
+            lines = [json.loads(ln) for ln in
+                     alerts.read_text().splitlines()]
+            assert any(a["rule"] == "canary_rollback"
+                       and a["severity"] == "crit" for a in lines)
+            al = [e for e in bus.events() if e["kind"] == "alert_raised"]
+            assert any(a["rule"] == "canary_rollback" for a in al)
+        finally:
+            eng.close()
+
+    def test_event_during_open_canary_defers_then_drains(self, bus):
+        pool = _pool(M=3)
+        pool.copy_slot(1, 0)
+        eng = _engine(pool, [0, 1, 2]).start()
+        ctl = CanaryController(eng, fraction=1.0, min_samples=4, seed=1)
+        eng.attach_canary(ctl)
+        try:
+            eng.warmup()
+            eng.apply_cluster_event({"kind": "cluster_merge", "base": 0,
+                                     "merged": 1, "iteration": 1})
+            eng.apply_cluster_event({"kind": "cluster_merge", "base": 0,
+                                     "merged": 2, "iteration": 2})
+            assert ctl.stats()["deferred"] == 1
+            self._run_labeled(eng, ctl)
+            assert ctl.verdicts[0]["verdict"] == "commit"
+            # the deferred merge opened its own canary after the verdict
+            assert ctl.state().startswith("cluster_merge")
+            assert ctl.stats()["pending"]["reason"] == "cluster_merge"
+            assert ctl.stats()["deferred"] == 0
+        finally:
+            eng.close()
+
+    def test_timeout_fails_open(self, bus):
+        t = [0.0]
+        pool = _pool(M=2)
+        eng = _engine(pool, [0, 1]).start()
+        ctl = CanaryController(eng, fraction=1.0, min_samples=8, seed=1,
+                               timeout_s=5.0, time_fn=lambda: t[0])
+        eng.attach_canary(ctl)
+        try:
+            eng.warmup()
+            v0 = eng.version
+            eng.apply_cluster_event({"kind": "cluster_merge", "base": 0,
+                                     "merged": 1, "iteration": 1})
+            t[0] = 6.0                   # labels dried up; past deadline
+            eng.submit(0, np.zeros(3, np.float32))
+            deadline = 100
+            while not ctl.verdicts and deadline:
+                eng.submit(0, np.zeros(3, np.float32))
+                deadline -= 1
+            v = ctl.verdicts[-1]
+            assert v["decided_by"] == "timeout"
+            assert v["verdict"] == "commit"      # fail OPEN, ungated
+            assert v["samples"] < 8
+            assert eng.version > v0
+        finally:
+            eng.close()
+
+    def test_abort_discards_candidate_without_verdict(self, bus):
+        pool = _pool(M=2)
+        eng = _engine(pool, [0, 1]).start()
+        ctl = CanaryController(eng, fraction=1.0, min_samples=8, seed=1)
+        eng.attach_canary(ctl)
+        try:
+            eng.warmup()
+            v0 = eng.version
+            eng.apply_cluster_event({"kind": "cluster_merge", "base": 0,
+                                     "merged": 1, "iteration": 1})
+            assert ctl.abort() is True
+            assert ctl.state() == "idle"
+            assert not ctl.verdicts
+            assert eng.version == v0
+            assert ctl.abort() is False  # idempotent: nothing open
+        finally:
+            eng.close()
+
+    def test_shadow_adds_no_compiles(self, bus):
+        def serve_compiles():
+            snap = obs.registry().snapshot()
+            return sum(v for k, v in snap.items()
+                       if k.startswith('jit_compiles{fn="serve_forward'))
+
+        pool = _pool(M=2)
+        pool.copy_slot(1, 0)
+        eng = _engine(pool, [0, 1, 0, 1]).start()
+        ctl = CanaryController(eng, fraction=1.0, min_samples=8, seed=1)
+        eng.attach_canary(ctl)
+        try:
+            eng.warmup()
+            c0 = serve_compiles()
+            eng.apply_cluster_event({"kind": "cluster_merge", "base": 0,
+                                     "merged": 1, "iteration": 1})
+            self._run_labeled(eng, ctl)
+            assert ctl.verdicts
+            assert ctl.verdicts[-1]["shadow_batches"] > 0
+            assert serve_compiles() == c0, \
+                "shadow forward compiled a new program"
+        finally:
+            eng.close()

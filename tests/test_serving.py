@@ -248,6 +248,119 @@ class TestHotSwap:
             eng.close()
 
 
+class TestClusterEventSequences:
+    """Multi-event lifecycles over ``apply_cluster_event``: the routing
+    and params state must stay coherent across chained rewires, not just
+    after a single one."""
+
+    def test_split_then_merge_same_slot_roundtrips(self):
+        pool = _pool(M=3)
+        eng = _engine(pool, [0, 0, 0]).start()
+        try:
+            eng.warmup()
+            eng.apply_cluster_event(
+                {"kind": "cluster_split", "model": 0, "new_model": 2,
+                 "clients_kept": [0], "clients_moved": [1, 2]})
+            assert eng.submit(1, np.zeros(3, np.float32)).model == 2
+            # the split's child is reabsorbed into its parent slot
+            eng.apply_cluster_event(
+                {"kind": "cluster_merge", "base": 0, "merged": 2})
+            x = np.ones(3, np.float32)
+            for c in range(3):
+                r = eng.submit(c, x)
+                assert r.model == 0
+                expect = pool.apply(pool.slot(0), x[None])[0]
+                np.testing.assert_array_equal(r.logits,
+                                              np.asarray(expect))
+        finally:
+            eng.close()
+
+    def test_delete_under_live_load_degrades_to_unroutable(self):
+        pool = _pool(M=2)
+        eng = _engine(pool, [0, 1, 1, 1]).start()
+        try:
+            eng.warmup()
+            x = np.zeros(3, np.float32)
+            outcomes = []
+
+            def hammer(c):
+                for _ in range(40):
+                    try:
+                        outcomes.append(("ok", eng.submit(c, x).model))
+                    except UnknownClientError:
+                        outcomes.append(("unroutable", None))
+
+            with ThreadPoolExecutor(max_workers=3) as ex:
+                futs = [ex.submit(hammer, c) for c in (1, 2, 3)]
+                eng.apply_cluster_event(
+                    {"kind": "cluster_delete", "model": 1,
+                     "reason": "test"})
+                for f in futs:
+                    f.result(timeout=30)
+            # every in-flight request either answered by the still-live
+            # generation's model 1 or cleanly refused — never crashed,
+            # never misrouted to another slot
+            assert all(m == 1 for kind, m in outcomes if kind == "ok")
+            # after the swap the clients are durably unroutable...
+            with pytest.raises(UnknownClientError):
+                eng.submit(2, x)
+            # ...and untouched clients keep being served
+            assert eng.submit(0, x).model == 0
+        finally:
+            eng.close()
+
+    def test_event_replay_after_broker_reconnect(self):
+        from feddrift_tpu.comm.netbroker import (NetworkBroker,
+                                                 NetworkBrokerClient)
+        from feddrift_tpu.resilience import (ReconnectingBrokerClient,
+                                             RetryPolicy)
+        import time as _time
+
+        broker = NetworkBroker()
+        host, port = broker.host, broker.port
+        cli = ReconnectingBrokerClient(
+            lambda: NetworkBrokerClient(host, port),
+            retry=RetryPolicy(base_delay=0.05, max_delay=0.2,
+                              max_attempts=60, deadline_s=30, seed=0),
+            ack_timeout=0.2)
+        pool = _pool(M=2)
+        eng = _engine(pool, [0, 0]).start()
+        broker2 = None
+        try:
+            eng.warmup()
+            eng.attach_broker(cli, topic="serve/cluster")
+            cli.publish("serve/cluster", json.dumps(
+                {"kind": "cluster_assign", "assignment": [1, 1]}))
+            deadline = _time.monotonic() + 30
+            while eng.version < 2 and _time.monotonic() < deadline:
+                _time.sleep(0.05)
+            assert eng.submit(0, np.zeros(3, np.float32)).model == 1
+
+            broker.close()                   # broker dies mid-stream
+            _time.sleep(0.2)
+            cli.publish("serve/cluster", json.dumps(
+                {"kind": "cluster_assign", "assignment": [0, 0]}))
+            broker2 = NetworkBroker(host=host, port=port)  # same address
+            # the reconnect wrapper replays the subscription AND the
+            # unconfirmed publish; the engine applies it on arrival
+            deadline = _time.monotonic() + 60
+            while _time.monotonic() < deadline:
+                try:
+                    if eng.submit(0, np.zeros(3, np.float32)).model == 0:
+                        break
+                except UnknownClientError:
+                    pass
+                _time.sleep(0.1)
+            assert eng.submit(0, np.zeros(3, np.float32)).model == 0
+            assert cli.reconnects >= 1
+        finally:
+            cli.close()
+            eng.close()
+            broker.close()
+            if broker2 is not None:
+                broker2.close()
+
+
 class TestErrorPaths:
     def test_unknown_client(self):
         eng = _engine(_pool(M=2), [0, -1]).start()
