@@ -276,8 +276,9 @@ class QualityMonitor:
                 int(model), int(client), pred, conf, ent,
                 self.joiner._time()))
             score = self.drift.observe(ent)
+            if score is not None:
+                self.drift_suspected += 1
         if score is not None:
-            self.drift_suspected += 1
             emit("serve_drift_suspected", score=round(score, 4),
                  threshold=self.drift.threshold,
                  window=self.drift.window, signal="entropy")

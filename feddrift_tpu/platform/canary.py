@@ -100,7 +100,7 @@ class CanaryController:
     def __init__(self, engine, fraction: float = 0.1,
                  min_samples: int = 32, acc_margin: float = 0.02,
                  kinds=DEFAULT_CANARY_KINDS, seed: int = 0,
-                 timeout_s: float = 120.0,
+                 timeout_s: float = 120.0, max_deferred: int = 256,
                  alerts_path: Optional[str] = None,
                  time_fn=time.time) -> None:
         if not 0.0 < fraction <= 1.0:
@@ -113,6 +113,7 @@ class CanaryController:
         self.acc_margin = float(acc_margin)
         self.kinds = frozenset(kinds)
         self.timeout_s = float(timeout_s)
+        self.max_deferred = int(max_deferred)
         self.alerts_path = alerts_path
         self._time = time_fn
         self._rng = np.random.RandomState(int(seed) % (2**31 - 1))
@@ -133,8 +134,20 @@ class CanaryController:
     def note_event(self, rec: dict) -> None:
         """Record a committed (non-canaried) cluster event so lineage
         resolution tracks the same history the trainer's DAG has."""
+        self._check_timeout()
         with self._lock:
             self._events.append(dict(rec))
+
+    def _check_timeout(self) -> None:
+        """Finalize an expired canary from ANY entry point — the event
+        feed, label producers, the batch hook. Without this, a canary
+        opened right before traffic stops never closes: every later
+        merge/split defers and structural swaps stall until traffic
+        resumes."""
+        cand = self._pending
+        if cand is not None and \
+                self._time() - cand.opened_ts > self.timeout_s:
+            self._finalize(cand, decided_by="timeout")
 
     def _lineage_ids(self, slots: list[int]) -> list:
         """Resolve the named pool slots to their CURRENT lineage ids by
@@ -168,9 +181,16 @@ class CanaryController:
         """Open a canary for one eligible cluster event (or defer it when
         one is already open). Returns None: no generation is published
         until the verdict commits."""
+        self._check_timeout()
         with self._lock:
             if self._pending is not None:
                 self._deferred.append(dict(rec))
+                if len(self._deferred) > self.max_deferred:
+                    dropped = self._deferred.pop(0)
+                    log.warning(
+                        "canary: deferred backlog over %d, dropping "
+                        "oldest %s event", self.max_deferred,
+                        dropped.get("kind"))
                 return None
             plan = self.engine._plan_cluster_event(rec)
             if plan is None:
@@ -205,8 +225,7 @@ class CanaryController:
                                bucket)
         except Exception:   # noqa: BLE001 — shadow work must not hurt live
             log.warning("canary: shadow execution failed", exc_info=True)
-        if self._time() - cand.opened_ts > self.timeout_s:
-            self._finalize(cand, decided_by="timeout")
+        self._check_timeout()
 
     def _shadow_batch(self, cand, gen, live, routes, xb, mb, out,
                       bucket) -> None:
@@ -262,12 +281,18 @@ class CanaryController:
             self._finalize(cand, decided_by="samples")
 
     # -- label half -----------------------------------------------------
-    def on_label(self, request_id: int, y) -> None:
+    def on_label(self, request_id: int, y) -> bool:
+        """Returns True when an open canary consumed the label — joined
+        it to a parked shadow compare, or stashed it for the in-flight
+        compare of its row's batch."""
+        self._check_timeout()
         cand = self._pending
         if cand is None:
-            return
+            return False
         fire = False
         with self._lock:
+            if self._pending is not cand:   # finalized under our feet
+                return False
             pair = cand.cmp.pop(int(request_id), None)
             if pair is None:
                 # shadow compare not parked (yet): remember the label so
@@ -277,7 +302,7 @@ class CanaryController:
                 if len(cand.labels) >= 4096:
                     cand.labels.pop(next(iter(cand.labels)))
                 cand.labels[int(request_id)] = int(y)
-                return
+                return True
             live_pred, shadow_pred = pair
             yv = int(y)
             cand.labeled += 1
@@ -288,6 +313,7 @@ class CanaryController:
             fire = cand.labeled >= self.min_samples
         if fire:
             self._finalize(cand, decided_by="samples")
+        return True
 
     # -- verdict --------------------------------------------------------
     def _finalize(self, cand: _Candidate, decided_by: str) -> None:
@@ -329,12 +355,13 @@ class CanaryController:
             if commit:
                 self._events.append(cand.rec)
         if commit:
-            version = self.engine.swap(
-                params=cand.plan.get("params"),
-                routing=cand.plan.get("routing"),
-                reason=cand.plan.get("reason", "canary"),
-                **cand.plan.get("evidence", {}))
-            verdict["version"] = version
+            # commit against the CURRENT generation, not the intercept-
+            # time snapshot: non-canaried events (assigns, deletes,
+            # creates) swap immediately while a canary is open, and
+            # replaying the stale plan would silently revert them —
+            # commit_cluster_event re-plans under the engine's swap lock
+            verdict["version"] = self.engine.commit_cluster_event(
+                cand.rec)
             self._commits.inc()
         else:
             self._rollbacks.inc()
@@ -346,10 +373,18 @@ class CanaryController:
                  "<-".join(cand.lineage_ids), verdict["live_acc"],
                  verdict["shadow_acc"], verdict["agreement"],
                  cand.labeled)
-        # drain any event that arrived while this canary was open
-        with self._lock:
-            nxt = self._deferred.pop(0) if self._deferred else None
-        if nxt is not None:
+        # drain every event that arrived while this canary was open
+        self._drain_deferred()
+
+    def _drain_deferred(self) -> None:
+        """Replay the deferred backlog until it empties or one of the
+        replayed events opens the next canary (the rest keep waiting
+        behind it)."""
+        while True:
+            with self._lock:
+                if self._pending is not None or not self._deferred:
+                    return
+                nxt = self._deferred.pop(0)
             self.engine.apply_cluster_event(nxt)
 
     def abort(self) -> bool:
@@ -360,9 +395,7 @@ class CanaryController:
         with self._lock:
             cand = self._pending
             self._pending = None
-            nxt = self._deferred.pop(0) if self._deferred else None
-        if nxt is not None:
-            self.engine.apply_cluster_event(nxt)
+        self._drain_deferred()
         return cand is not None
 
     def _raise_rollback_alert(self, verdict: dict) -> None:

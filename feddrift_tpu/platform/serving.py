@@ -390,9 +390,13 @@ class InferenceEngine:
         self._stop = False
         self._thread: threading.Thread | None = None
         self._sub_thread: threading.Thread | None = None
-        self._swap_lock = threading.Lock()
+        # RLock: commit_cluster_event plans + swaps under one hold
+        self._swap_lock = threading.RLock()
         self._rid = itertools.count(1)      # monotonic request ids
-        self._lat_p99_exemplar = (0.0, None, None)  # (lat, trace_id, client)
+        # (lat, trace_id, client, armed_at): the current p99 exemplar,
+        # age-rearmed so it tracks the recent tail, not the all-time max
+        self._lat_p99_exemplar = (0.0, None, None, 0.0)
+        self.exemplar_max_age_s = 60.0
         # model-quality plane (obs/quality.py): enabled by quality_window
         # > 0 at construction or lazily by enable_quality()
         self.quality = None
@@ -572,11 +576,15 @@ class InferenceEngine:
             r.result = ServeResult(logits=out[i], model=int(mb[i]),
                                    version=gen.version, request_id=r.rid)
             self._lat.observe(lat)
-            if lat > self._lat_p99_exemplar[0]:
-                # p99 exemplar: the worst request's trace id survives
-                # next to the sketch digest (surfaced in /status extras)
+            ex = self._lat_p99_exemplar
+            if lat > ex[0] or done - ex[3] > self.exemplar_max_age_s:
+                # p99 exemplar: the worst RECENT request's trace id
+                # survives next to the sketch digest (surfaced in
+                # /status extras); past exemplar_max_age_s the holder is
+                # re-armed so one ancient outlier can't pin the slot for
+                # the life of the engine
                 self._lat_p99_exemplar = (
-                    lat, r.ctx.get("trace_id"), r.client)
+                    lat, r.ctx.get("trace_id"), r.client, done)
                 obs_live.record_exemplar(
                     "request_latency_seconds_q", latency_s=round(lat, 6),
                     trace_id=r.ctx.get("trace_id"), client=r.client,
@@ -649,13 +657,27 @@ class InferenceEngine:
         kind = rec.get("kind")
         if self._canary is not None and self._canary.wants(kind):
             return self._canary.intercept(rec)
-        plan = self._plan_cluster_event(rec)
-        if plan is None:
-            return None
-        if self._canary is not None:
+        version = self.commit_cluster_event(rec)
+        if version is not None and self._canary is not None:
             self._canary.note_event(rec)
-        return self.swap(params=plan.get("params"), routing=plan["routing"],
-                         reason=plan["reason"], **plan.get("evidence", {}))
+        return version
+
+    def commit_cluster_event(self, rec: dict) -> int | None:
+        """Plan + publish one cluster event atomically against the
+        CURRENT generation. This is the commit half shared by the
+        immediate path and a canary's commit verdict: a canary's
+        intercept-time snapshot can be stale by commit time (non-canaried
+        events — assigns, deletes, creates — swap immediately while the
+        canary is open), so the plan is rebuilt under the swap lock
+        instead of replaying that snapshot."""
+        with self._swap_lock:
+            plan = self._plan_cluster_event(rec)
+            if plan is None:
+                return None
+            return self.swap(params=plan.get("params"),
+                             routing=plan["routing"],
+                             reason=plan["reason"],
+                             **plan.get("evidence", {}))
 
     def _plan_cluster_event(self, rec: dict) -> dict | None:
         """Build the candidate (params, routing) one cluster event
@@ -761,13 +783,17 @@ class InferenceEngine:
         """Close the delayed-label loop for one served request (the id
         rides on ``ServeResult.request_id``). Feeds the quality
         estimators and any open canary's scoreboard; returns True when
-        the prediction was still joinable (not expired/evicted)."""
+        the label was still consumable by EITHER plane — joined by the
+        quality monitor (prediction not expired/evicted) or accepted by
+        an open canary's scoreboard (so canary-only engines still see
+        True for useful labels)."""
         joined = None
         if self.quality is not None:
             joined = self.quality.observe_label(request_id, y)
+        canary_joined = False
         if self._canary is not None:
-            self._canary.on_label(request_id, y)
-        return joined is not None
+            canary_joined = bool(self._canary.on_label(request_id, y))
+        return joined is not None or canary_joined
 
     def attach_canary(self, controller) -> "InferenceEngine":
         """Gate ``apply_cluster_event`` through a
@@ -799,7 +825,7 @@ class InferenceEngine:
             last["served"], last["ts"] = served, now
             board.beat()
             board.update(pool_version=self._gen.version)
-            lat, trace_id, client_id = self._lat_p99_exemplar
+            lat, trace_id, client_id, _armed = self._lat_p99_exemplar
             out = {"requests_per_s": round(rps, 2),
                    "pool_version": self._gen.version,
                    "canary": (self._canary.state()
@@ -828,7 +854,7 @@ class InferenceEngine:
         whole run (a full registry reset would instead orphan the
         engine's held instrument references)."""
         self._lat.reset()
-        self._lat_p99_exemplar = (0.0, None, None)
+        self._lat_p99_exemplar = (0.0, None, None, 0.0)
 
     def stats(self) -> dict:
         snap = self._lat.snapshot()

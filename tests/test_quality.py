@@ -24,7 +24,8 @@ from feddrift_tpu.obs.quality import (EntropyShiftDetector, LabelJoiner,
                                       QualityMonitor, StreamingECE,
                                       _Pending, prediction_stats)
 from feddrift_tpu.platform.canary import CanaryController
-from feddrift_tpu.platform.serving import InferenceEngine, RoutingTable
+from feddrift_tpu.platform.serving import (InferenceEngine, RoutingTable,
+                                           UnknownClientError)
 
 
 @pytest.fixture()
@@ -378,5 +379,118 @@ class TestCanary:
             assert ctl.verdicts[-1]["shadow_batches"] > 0
             assert serve_compiles() == c0, \
                 "shadow forward compiled a new program"
+        finally:
+            eng.close()
+
+    def test_commit_replans_against_current_generation(self, bus):
+        # a non-canaried event swapping while the canary is open must
+        # survive the commit: the verdict re-plans against the CURRENT
+        # generation instead of replaying the intercept-time snapshot
+        pool = _pool(M=3)
+        pool.copy_slot(1, 0)
+        eng = _engine(pool, [0, 1, 2, 2]).start()
+        ctl = CanaryController(eng, fraction=1.0, min_samples=4, seed=1)
+        eng.attach_canary(ctl)
+        try:
+            eng.warmup()
+            eng.apply_cluster_event({"kind": "cluster_merge", "base": 0,
+                                     "merged": 1, "iteration": 1})
+            # cluster 2 is deleted mid-canary: non-canaried, swaps NOW
+            eng.apply_cluster_event({"kind": "cluster_delete", "model": 2,
+                                     "iteration": 2})
+            with pytest.raises(UnknownClientError):
+                eng.submit(2, np.zeros(3, np.float32))
+            rng = np.random.RandomState(0)
+            for i in range(300):
+                if ctl.verdicts:
+                    break
+                r = eng.submit(i % 2, rng.standard_normal(3)
+                               .astype(np.float32))
+                eng.observe_label(r.request_id, int(np.argmax(r.logits)))
+            assert ctl.verdicts and \
+                ctl.verdicts[-1]["verdict"] == "commit"
+            # the merge re-homing published on top of the current state…
+            assert eng.submit(1, np.zeros(3, np.float32)).model == 0
+            # …and the mid-canary delete was NOT rolled back
+            with pytest.raises(UnknownClientError):
+                eng.submit(3, np.zeros(3, np.float32))
+        finally:
+            eng.close()
+
+    def test_timeout_fires_from_event_feed_without_traffic(self, bus):
+        # traffic stops entirely while a canary is open: the next event
+        # arriving on the feed must finalize the expired canary (fail
+        # open) and proceed, instead of deferring forever
+        t = [0.0]
+        pool = _pool(M=3)
+        eng = _engine(pool, [0, 1, 2]).start()
+        ctl = CanaryController(eng, fraction=1.0, min_samples=8, seed=1,
+                               timeout_s=5.0, time_fn=lambda: t[0])
+        eng.attach_canary(ctl)
+        try:
+            eng.warmup()
+            eng.apply_cluster_event({"kind": "cluster_merge", "base": 0,
+                                     "merged": 1, "iteration": 1})
+            t[0] = 6.0
+            eng.apply_cluster_event({"kind": "cluster_merge", "base": 0,
+                                     "merged": 2, "iteration": 2})
+            assert ctl.verdicts and \
+                ctl.verdicts[0]["decided_by"] == "timeout"
+            # the new event opened its own canary rather than deferring
+            assert ctl.stats()["deferred"] == 0
+            assert ctl.stats()["pending"]["reason"] == "cluster_merge"
+        finally:
+            eng.close()
+
+    def test_timeout_fires_from_label_path(self, bus):
+        t = [0.0]
+        pool = _pool(M=2)
+        eng = _engine(pool, [0, 1]).start()
+        ctl = CanaryController(eng, fraction=1.0, min_samples=8, seed=1,
+                               timeout_s=5.0, time_fn=lambda: t[0])
+        eng.attach_canary(ctl)
+        try:
+            eng.warmup()
+            eng.apply_cluster_event({"kind": "cluster_merge", "base": 0,
+                                     "merged": 1, "iteration": 1})
+            t[0] = 6.0
+            assert eng.observe_label(12345, 0) is False
+            assert ctl.verdicts and \
+                ctl.verdicts[0]["decided_by"] == "timeout"
+        finally:
+            eng.close()
+
+    def test_observe_label_true_with_canary_only(self, bus):
+        # quality plane disabled: observe_label must still report True
+        # when an open canary consumed the label
+        pool = _pool(M=2)
+        pool.copy_slot(1, 0)
+        eng = _engine(pool, [0, 1]).start()
+        ctl = CanaryController(eng, fraction=1.0, min_samples=64, seed=1)
+        eng.attach_canary(ctl)
+        try:
+            eng.warmup()
+            r = eng.submit(1, np.zeros(3, np.float32))
+            assert eng.observe_label(r.request_id, 0) is False
+            eng.apply_cluster_event({"kind": "cluster_merge", "base": 0,
+                                     "merged": 1, "iteration": 1})
+            r = eng.submit(1, np.zeros(3, np.float32))
+            assert eng.observe_label(r.request_id, 0) is True
+        finally:
+            eng.close()
+
+    def test_deferred_backlog_is_bounded(self, bus):
+        pool = _pool(M=4)
+        eng = _engine(pool, [0, 1, 2, 3]).start()
+        ctl = CanaryController(eng, fraction=1.0, min_samples=8, seed=1,
+                               max_deferred=2)
+        eng.attach_canary(ctl)
+        try:
+            eng.warmup()
+            for it in range(5):
+                eng.apply_cluster_event(
+                    {"kind": "cluster_merge", "base": 0,
+                     "merged": 1 + it % 3, "iteration": it})
+            assert ctl.stats()["deferred"] == 2
         finally:
             eng.close()

@@ -389,3 +389,30 @@ class TestErrorPaths:
         eng = _engine(_pool(M=2), [0, 1])
         with pytest.raises(RuntimeError):
             eng.submit(0, np.zeros(3, np.float32))
+
+
+class TestLatencyExemplar:
+    def test_p99_exemplar_rearms_past_max_age(self):
+        # an ancient outlier must not pin the exemplar slot forever: past
+        # exemplar_max_age_s the holder is replaced by the next request
+        eng = _engine(_pool(M=2), [0, 1]).start()
+        try:
+            eng.warmup()
+            eng._lat_p99_exemplar = (999.0, "ancient", 0, 0.0)
+            eng.exemplar_max_age_s = 0.0     # everything is stale
+            eng.submit(0, np.zeros(3, np.float32))
+            lat, trace_id, _client, _armed = eng._lat_p99_exemplar
+            assert lat < 999.0 and trace_id != "ancient"
+        finally:
+            eng.close()
+
+    def test_reset_clears_exemplar(self):
+        eng = _engine(_pool(M=2), [0, 1]).start()
+        try:
+            eng.warmup()
+            eng.submit(0, np.zeros(3, np.float32))
+            assert eng._lat_p99_exemplar[0] > 0.0
+            eng.reset_latency_stats()
+            assert eng._lat_p99_exemplar == (0.0, None, None, 0.0)
+        finally:
+            eng.close()
