@@ -93,6 +93,75 @@ def _maybe_init_multihost(args: argparse.Namespace) -> None:
             process_id=args.process_id)
 
 
+def _serve_listen(args: argparse.Namespace, buckets: tuple) -> int:
+    """``serve --listen``: deploy the socket frontend (admission control +
+    replica failover) and optionally drive generated traffic through the
+    SOCKET path — the same bytes a real client would send."""
+    import time
+
+    from feddrift_tpu.platform import frontend as frontend_mod
+    from feddrift_tpu.platform import serving
+
+    fe = frontend_mod.build_frontend(
+        args.run_dir, replicas=max(1, args.replicas),
+        max_pending=args.max_pending, rate_rps=args.rate_rps,
+        slo_p99_ms=args.slo_p99_ms, max_queue=args.max_queue,
+        buckets=buckets, max_wait_s=args.max_wait_ms / 1e3)
+    broker = None
+    if args.broker:
+        host, _, port = args.broker.rpartition(":")
+        from feddrift_tpu.comm.netbroker import NetworkBrokerClient
+        from feddrift_tpu.resilience import (ReconnectingBrokerClient,
+                                             RetryPolicy)
+        broker = ReconnectingBrokerClient(
+            lambda: NetworkBrokerClient(host or "127.0.0.1", int(port)),
+            retry=RetryPolicy(base_delay=0.05, max_delay=0.25,
+                              max_attempts=400, deadline_s=120.0),
+            heartbeat_interval=0.1, heartbeat_timeout=0.4,
+            client_id="serve-frontend")
+        # cluster-event hot swaps reach EVERY replica (fanout subscribe);
+        # the NDJSON request plane + per-replica fleet lanes share the
+        # same connection
+        for eng in fe.replicas.engines:
+            eng.attach_broker(broker,
+                              topic=args.topic or serving.CLUSTER_TOPIC)
+        fe.attach_broker(broker)
+        fe.attach_ops(broker)
+    ops = None
+    if args.ops_port is not None:
+        from feddrift_tpu.obs import live
+        ops = live.OpsServer(port=args.ops_port).start()
+    fe.start(port=args.listen)
+    print(json.dumps({"listening": fe.url,
+                      "replicas": fe.replicas.healthy_names()}))
+    try:
+        if args.requests > 0:
+            client = frontend_mod.FrontendClient(fe.url)
+            gen = serving.TrafficGenerator(
+                client, list(range(fe.replicas.population)),
+                seed=args.seed, concurrency=args.concurrency)
+            deadline_s = (args.deadline_ms / 1e3
+                          if args.deadline_ms > 0 else None)
+            if args.open_rps > 0:
+                stats = gen.run_open(args.requests, args.open_rps,
+                                     deadline_s=deadline_s)
+            else:
+                stats = gen.run(args.requests)
+            print(json.dumps({**stats, "frontend": fe.status()}, indent=2))
+        else:
+            while True:         # serve until interrupted
+                time.sleep(3600)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        fe.close()
+        if broker is not None:
+            broker.close()
+        if ops is not None:
+            ops.close()
+    return 0
+
+
 def _cfg_from_args(args: argparse.Namespace):
     from feddrift_tpu.config import ExperimentConfig
     known = {f.name for f in dataclasses.fields(ExperimentConfig)}
@@ -226,6 +295,40 @@ def main(argv: list[str] | None = None) -> int:
                             "fraction of affected traffic before "
                             "committing the swap (0 = swap immediately; "
                             "docs/SERVING.md Canarying hot swaps)")
+    srv_p.add_argument("--listen", type=int, default=None,
+                       help="deploy the socket frontend on this HTTP port "
+                            "(0 = ephemeral): POST /v1/submit + /healthz "
+                            "/metrics /status, admission control, replica "
+                            "failover (platform/frontend.py; docs/"
+                            "SERVING.md Deployment). Traffic (--requests"
+                            "/--open_rps) then drives the SOCKET path; "
+                            "--requests 0 serves until interrupted")
+    srv_p.add_argument("--replicas", type=int, default=2,
+                       help="engine replicas behind the frontend "
+                            "(--listen only; default %(default)s)")
+    srv_p.add_argument("--max_pending", type=int, default=64,
+                       help="frontend admission window: pending requests "
+                            "beyond this shed with 503 (default "
+                            "%(default)s)")
+    srv_p.add_argument("--max_queue", type=int, default=64,
+                       help="per-replica engine queue bound; 0 = "
+                            "unbounded (default %(default)s with "
+                            "--listen, 0 otherwise)")
+    srv_p.add_argument("--rate_rps", type=float, default=0.0,
+                       help="token-bucket admission rate limit, "
+                            "requests/s (0 = off)")
+    srv_p.add_argument("--slo_p99_ms", type=float, default=0.0,
+                       help="request-latency p99 objective in ms: burn "
+                            "on it shrinks the admit window "
+                            "(backpressure; 0 = off)")
+    srv_p.add_argument("--open_rps", type=float, default=0.0,
+                       help="drive OPEN-LOOP traffic at this fixed "
+                            "offered rate instead of the closed loop "
+                            "(measures saturation without coordinated "
+                            "omission; 0 = closed loop)")
+    srv_p.add_argument("--deadline_ms", type=float, default=0.0,
+                       help="per-request propagated deadline for "
+                            "generated traffic (0 = none)")
     srv_p.add_argument("--platform", type=str, default="",
                        help="force a JAX platform (e.g. 'cpu')")
 
@@ -317,6 +420,10 @@ def main(argv: list[str] | None = None) -> int:
     if args.cmd == "serve":
         from feddrift_tpu.platform import serving
         buckets = tuple(int(b) for b in args.buckets.split(",") if b.strip())
+
+        if args.listen is not None:
+            return _serve_listen(args, buckets)
+
         engine = serving.load_engine(args.run_dir, buckets=buckets,
                                      max_wait_s=args.max_wait_ms / 1e3)
         ops = None
@@ -352,7 +459,13 @@ def main(argv: list[str] | None = None) -> int:
             gen = serving.TrafficGenerator(
                 engine, list(range(engine.population)), seed=args.seed,
                 concurrency=args.concurrency)
-            stats = gen.run(args.requests)
+            if args.open_rps > 0:
+                stats = gen.run_open(
+                    args.requests, args.open_rps,
+                    deadline_s=(args.deadline_ms / 1e3
+                                if args.deadline_ms > 0 else None))
+            else:
+                stats = gen.run(args.requests)
             print(json.dumps({**stats, **engine.stats()}, indent=2))
         finally:
             engine.close()

@@ -111,6 +111,11 @@ EVENT_KINDS = frozenset({
     # serving read path (platform/serving.py)
     "request_served",       # one inference request answered (routing + latency)
     "pool_swapped",         # engine published a new pool/routing generation
+    # serving frontend / replica plane (platform/frontend.py,
+    # platform/serving.py)
+    "frontend_shed",        # admission refused a request (queue/rate/backpressure)
+    "replica_failed",       # a replica's dispatcher died mid-batch
+    "replica_drained",      # frontend removed a replica from rotation
     # model-quality plane (obs/quality.py, platform/canary.py)
     "model_quality",        # windowed per-model live accuracy/confidence/ECE
     "serve_drift_suspected",  # read-path entropy-distribution shift detected

@@ -443,9 +443,12 @@ _METRIC_PREFIXES = (
     "dispatch_gap_seconds_q", "num_models", "alerts_raised", "slo_burns",
     "heartbeats_missed", "edge_", "publish_retries",
     # serving read path + model-quality plane (platform/serving.py,
-    # obs/quality.py, platform/canary.py)
-    "requests_served", "serve_", "pool_version", "pool_swaps",
+    # obs/quality.py, platform/canary.py) — "requests_" also covers the
+    # shed/expired/abandoned overload counters; "frontend_"/"replica_"
+    # are the admission + failover plane (platform/frontend.py)
+    "requests_", "serve_", "pool_version", "pool_swaps",
     "request_latency_seconds_q", "model_accuracy_q", "canary_",
+    "frontend_", "replica_",
 )
 
 

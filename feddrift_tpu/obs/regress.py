@@ -377,7 +377,10 @@ def compare(candidate: dict, baseline: dict,
         skip("precision", "candidate lacks the precision axis")
 
     # serving read-path axis (bench.py --serve; SERVE artifacts): one row
-    # per (mode, max-bucket) point from the closed-loop traffic generator.
+    # per (mode, max-bucket) point — in-process closed-loop rows plus the
+    # mode="socket" frontend row (HTTP plane, 2 replicas, bounded
+    # admission; carries the open-loop knee ladder and its gated
+    # shed-rate bound).
     # requests/s under the throughput tolerance, request p99 under the
     # tail-latency tolerance, steady-state recompiles as an ABSOLUTE zero
     # gate (buckets are compiled in warm-up; mixed-cluster traffic must
@@ -423,6 +426,17 @@ def compare(candidate: dict, baseline: dict,
                                 rec > 0,
                                 note="program invariance under "
                                      "mixed-cluster traffic"))
+            sr = e.get("shed_rate")
+            if mode == "socket" and sr is not None:
+                # ABSOLUTE bound on the sub-knee open-loop point: a
+                # frontend shedding comfortably below its own measured
+                # capacity is misconfigured admission, regardless of
+                # what the baseline did
+                rows.append(row(f"{name}.shed_rate",
+                                be.get("shed_rate"), sr, "<= 0.05",
+                                sr > 0.05,
+                                note="open-loop shed rate at 0.5x "
+                                     "measured capacity"))
         if best_speedup is not None:
             bbest = [e.get("speedup_vs_unbatched") for e in bsv
                      if isinstance(e, dict)

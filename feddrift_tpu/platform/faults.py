@@ -34,6 +34,9 @@ participation mask used by client subsampling
 
 from __future__ import annotations
 
+import threading
+import time
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -416,6 +419,99 @@ class EdgeFaultInjector:
             obs.registry().counter("edge_faults", reason="corrupt").inc(
                 int(corrupt.sum()))
         return modes
+
+
+class ReplicaFaultInjector:
+    """Seeded crash / stall / slow injection for SERVING replicas
+    (platform/frontend.py failover chaos).
+
+    Client/edge injectors above schedule faults per round; a serving
+    replica's failure domain is its dispatcher loop, so this one wraps
+    the replica engine's compiled forward — the fault fires exactly
+    where a real device loss (crash), wedged host transfer (stall) or
+    degraded host (slow) lands, and the engine's own containment
+    (``_dispatcher_died`` -> ``EngineStopped`` -> frontend failover, or
+    the ``ReplicaSet`` stall detector) has to survive it, not a
+    test-only shim.
+
+    Deterministic like every injector here: the fault fires at batch
+    ``after_batches`` (+ a seeded jitter draw when ``jitter`` > 0), a
+    pure function of ``(seed, after_batches)``.
+
+    - ``crash``: raise on the firing batch — the dispatcher dies, its
+      in-flight/queued requests fail with ``EngineStopped``;
+    - ``stall``: every batch from the firing one blocks ``stall_s`` —
+      progress collapses while the thread stays alive (the failure shape
+      liveness checks miss and the stall detector exists for);
+    - ``slow``: every batch from the firing one adds ``slow_s`` — tail
+      degradation that should burn the latency SLO, not kill anything.
+    """
+
+    PRIME = 9_000_011
+    MODES = ("crash", "stall", "slow")
+
+    def __init__(self, mode: str = "crash", after_batches: int = 8,
+                 slow_s: float = 0.02, stall_s: float = 5.0,
+                 jitter: int = 0, seed: int = 0) -> None:
+        if mode not in self.MODES:
+            raise ValueError(f"unknown replica fault mode {mode!r}; "
+                             f"available: {self.MODES}")
+        if after_batches < 1:
+            raise ValueError("after_batches must be >= 1")
+        self.mode = mode
+        self.slow_s = float(slow_s)
+        self.stall_s = float(stall_s)
+        rng = np.random.RandomState(
+            (seed * self.PRIME + after_batches) % (2 ** 31 - 1))
+        self.fire_at = int(after_batches) + \
+            (int(rng.randint(0, jitter + 1)) if jitter > 0 else 0)
+        self._lock = threading.Lock()
+        self.calls = 0
+        self.fired = False
+        self._engine = None
+        self._inner = None
+
+    def arm(self, engine) -> "ReplicaFaultInjector":
+        """Wrap ``engine.step.forward``; ``disarm()`` restores it."""
+        if self._engine is not None:
+            raise RuntimeError("injector already armed")
+        self._engine = engine
+        self._inner = engine.step.forward
+        replica = engine.name or "engine"
+        inner = self._inner
+
+        def wrapped(params, x, midx):
+            with self._lock:
+                self.calls += 1
+                calls = self.calls
+                first = calls == self.fire_at and not self.fired
+                if first:
+                    self.fired = True
+            if first:
+                obs.emit("chaos_injected", target="replica",
+                         replica=replica, fault=self.mode,
+                         at_batch=calls)
+                obs.registry().counter("replica_faults_injected",
+                                       mode=self.mode).inc()
+                if self.mode == "crash":
+                    raise RuntimeError(
+                        f"injected replica crash ({replica} at batch "
+                        f"{calls})")
+            if calls >= self.fire_at:
+                if self.mode == "stall":
+                    time.sleep(self.stall_s)
+                elif self.mode == "slow":
+                    time.sleep(self.slow_s)
+            return inner(params, x, midx)
+
+        engine.step.forward = wrapped
+        return self
+
+    def disarm(self) -> None:
+        if self._engine is not None:
+            self._engine.step.forward = self._inner
+            self._engine = None
+            self._inner = None
 
 
 def apply_byzantine_updates(client_params, global_params, modes,

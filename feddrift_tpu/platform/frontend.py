@@ -1,0 +1,935 @@
+"""Deployable serving frontend: socket request plane with admission
+control, deadline propagation, and replica failover.
+
+PR 14's ``InferenceEngine`` is a Python call behind an in-process queue —
+one replica, no sockets, and (before this layer) no overload story: the
+admission deque grew without bound and p99 collapsed. This module turns
+the engine into a deployable service with overload and replica death as
+first-class, survivable events:
+
+- **admission control** (``AdmissionController``): every request passes a
+  token bucket (``TokenBucket``, sustained-rate + burst), then a bounded
+  pending-count window. Refusals are EXPLICIT — ``EngineOverloaded`` with
+  a ``retry_after_s`` hint in-process, HTTP 503 + ``Retry-After`` on the
+  wire, a ``frontend_shed`` event and a ``frontend_sheds{reason=...}``
+  counter either way. The window itself breathes: a
+  ``BackpressureController`` taps the PR 11 SLO burn-rate engine and
+  multiplicatively shrinks the admit window while
+  ``request_latency_seconds_q``-style burn is active (shed a little
+  early, before the tail collapses for everyone), recovering on a timer.
+
+- **deadline propagation**: requests carry ``deadline_ms`` from the wire;
+  the engine's batch formation drops requests that expire while queued
+  (``DeadlineExceededError`` / HTTP 504) instead of wasting a forward
+  pass on answers nobody is waiting for.
+
+- **replica management** (``ReplicaSet``): N engine replicas behind one
+  frontend with health-gated round-robin routing. A replica whose
+  dispatcher dies (``engine.failed``, thread liveness) or that stops
+  making batch progress with work queued is DRAINED from rotation
+  (``replica_drained`` event); a request in flight on a dying replica
+  gets the explicit ``EngineStopped`` and is retried ONCE on a survivor
+  (``request_retries`` counter). Per-replica latency sketches
+  (``request_latency_seconds_q{replica=...}``) publish per-replica fleet
+  lanes (``attach_ops``) the existing ``FleetCollector`` merges.
+
+Two request planes share one ``submit()`` core:
+
+- HTTP (``start()``): ``POST /v1/submit`` plus the ops trio
+  ``/healthz`` ``/metrics`` ``/status``, on the same stdlib
+  ``ThreadingHTTPServer`` plumbing as ``obs.live.OpsServer``;
+- NDJSON broker (``attach_broker()``): request docs on a broker topic
+  with ``reply_to`` reply routing, so training-side processes already
+  speaking broker can read the pool without HTTP.
+
+``FrontendClient`` is the engine-shaped HTTP client: it raises the same
+exception taxonomy ``InferenceEngine.submit`` does, so a
+``TrafficGenerator`` (closed- or open-loop) drives a socket deployment
+unchanged — that is how ``bench.py --serve`` measures the socket path's
+saturation knee and how ``chaos_smoke.sh`` kills a replica mid-stream.
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import logging
+import queue as queue_mod
+import socket
+import threading
+import time
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+
+from feddrift_tpu.platform.serving import (
+    DeadlineExceededError,
+    EngineOverloaded,
+    EngineStopped,
+    MalformedRequestError,
+    ServeResult,
+    UnknownClientError,
+)
+
+log = logging.getLogger("feddrift_tpu")
+
+# broker topic the NDJSON request plane consumes
+REQUEST_TOPIC = "serve/requests"
+
+
+# ----------------------------------------------------------------------
+# admission control
+class TokenBucket:
+    """Thread-safe token bucket: sustained ``rate_rps`` with ``burst``
+    capacity. ``try_acquire`` never blocks — the frontend sheds instead
+    of queueing, that is the whole point."""
+
+    def __init__(self, rate_rps: float, burst: float | None = None,
+                 time_fn=time.monotonic) -> None:
+        if rate_rps <= 0:
+            raise ValueError(f"rate_rps must be positive, got {rate_rps}")
+        self.rate = float(rate_rps)
+        self.burst = float(burst) if burst is not None \
+            else max(self.rate, 1.0)
+        self._time = time_fn
+        self._tokens = self.burst
+        self._last = time_fn()
+        self._lock = threading.Lock()
+
+    def try_acquire(self, n: float = 1.0) -> bool:
+        with self._lock:
+            now = self._time()
+            self._tokens = min(self.burst,
+                               self._tokens + (now - self._last) * self.rate)
+            self._last = now
+            if self._tokens >= n:
+                self._tokens -= n
+                return True
+            return False
+
+    def retry_after_s(self) -> float:
+        """Seconds until one token refills — the shed response's hint."""
+        with self._lock:
+            return max((1.0 - self._tokens) / self.rate, 0.001)
+
+
+class BackpressureController:
+    """Shrinks the admit window while the SLO burn-rate engine reports
+    latency burn; heals it on a timer.
+
+    Tap it on the event bus next to an ``SLOEngine`` carrying the
+    ``frontend_slos`` objective: every ``slo_burn`` for a watched
+    objective halves (``shrink``) the factor the ``AdmissionController``
+    scales its pending bound by, down to ``floor``. After ``recovery_s``
+    without a burn the factor steps back up one shrink at a time —
+    multiplicative decrease, slow additive-style recovery, the classic
+    congestion-control shape. Shedding a slice of traffic EARLY is what
+    keeps the admitted requests' p99 bounded; the alternative is every
+    request slow."""
+
+    def __init__(self, slo_names=("serve_p99_latency",),
+                 shrink: float = 0.5, floor: float = 0.125,
+                 recovery_s: float = 5.0, time_fn=time.monotonic) -> None:
+        if not 0.0 < shrink < 1.0:
+            raise ValueError(f"shrink must be in (0, 1), got {shrink}")
+        if not 0.0 < floor <= 1.0:
+            raise ValueError(f"floor must be in (0, 1], got {floor}")
+        self.slo_names = frozenset(slo_names)
+        self.shrink = float(shrink)
+        self.floor = float(floor)
+        self.recovery_s = float(recovery_s)
+        self._time = time_fn
+        self._lock = threading.Lock()
+        self._factor = 1.0
+        self._last_burn: float | None = None
+        self._bus = None
+
+    def attach(self, bus) -> "BackpressureController":
+        self._bus = bus
+        bus.add_tap(self.observe)
+        return self
+
+    def detach(self) -> None:
+        if self._bus is not None:
+            self._bus.remove_tap(self.observe)
+            self._bus = None
+
+    def observe(self, rec: dict) -> None:
+        if rec.get("kind") != "slo_burn" \
+                or rec.get("slo") not in self.slo_names:
+            return
+        from feddrift_tpu import obs
+        with self._lock:
+            self._factor = max(self.floor, self._factor * self.shrink)
+            self._last_burn = self._time()
+            factor = self._factor
+        obs.registry().gauge("frontend_backpressure_factor").set(factor)
+        log.warning("frontend: backpressure engaged on %s burn "
+                    "(admit factor -> %.3f)", rec.get("slo"), factor)
+
+    def current(self) -> float:
+        """The live admit factor in [floor, 1]; recovery is evaluated
+        lazily here so the controller needs no thread of its own."""
+        with self._lock:
+            if self._last_burn is None:
+                return self._factor
+            while (self._factor < 1.0
+                   and self._time() - self._last_burn >= self.recovery_s):
+                self._factor = min(1.0, self._factor / self.shrink)
+                self._last_burn += self.recovery_s
+            if self._factor >= 1.0:
+                self._last_burn = None
+            return self._factor
+
+
+class AdmissionController:
+    """One admit decision for both request planes: rate limit first,
+    then the backpressure-scaled pending window. Returns
+    ``(admitted, reason, retry_after_s)`` — reasons are the
+    ``frontend_sheds{reason=...}`` label values."""
+
+    def __init__(self, max_pending: int = 64,
+                 bucket: TokenBucket | None = None,
+                 backpressure: BackpressureController | None = None)\
+            -> None:
+        if max_pending < 1:
+            raise ValueError(f"max_pending must be >= 1, got {max_pending}")
+        self.max_pending = int(max_pending)
+        self.bucket = bucket
+        self.backpressure = backpressure
+        self._pending = 0
+        self._lock = threading.Lock()
+
+    def try_admit(self) -> tuple[bool, str | None, float]:
+        if self.bucket is not None and not self.bucket.try_acquire():
+            return False, "rate_limited", self.bucket.retry_after_s()
+        limit = self.max_pending
+        if self.backpressure is not None:
+            limit = max(1, int(self.max_pending
+                               * self.backpressure.current()))
+        with self._lock:
+            if self._pending >= limit:
+                reason = ("backpressure" if limit < self.max_pending
+                          else "queue_full")
+                return False, reason, 0.05
+            self._pending += 1
+        return True, None, 0.0
+
+    def release(self) -> None:
+        with self._lock:
+            self._pending = max(0, self._pending - 1)
+
+    @property
+    def pending(self) -> int:
+        with self._lock:
+            return self._pending
+
+
+# ----------------------------------------------------------------------
+# replica management
+class ReplicaSet:
+    """Health-gated round-robin over N named ``InferenceEngine``
+    replicas, with one-shot failover.
+
+    The health gate drains a replica on either signal: the dispatcher
+    died (``engine.failed`` set, or its thread is gone) or the replica
+    stopped making batch progress with work queued for
+    ``stall_after_s`` (a stalled forward — the dispatcher is alive but
+    wedged). A drained replica leaves rotation and emits
+    ``replica_drained``; requests that were in flight on it fail with
+    the explicit ``EngineStopped`` and are retried ONCE on a survivor."""
+
+    def __init__(self, engines, health_interval_s: float = 0.1,
+                 stall_after_s: float = 2.0) -> None:
+        engines = list(engines)
+        if not engines:
+            raise ValueError("need at least one replica engine")
+        names = [e.name for e in engines]
+        if None in names or len(set(names)) != len(names):
+            raise ValueError(
+                f"every replica engine needs a unique name, got {names}")
+        from feddrift_tpu import obs
+        self.engines = engines
+        self.health_interval_s = float(health_interval_s)
+        self.stall_after_s = float(stall_after_s)
+        self._lock = threading.RLock()
+        self._healthy: dict[str, object] = {e.name: e for e in engines}
+        self._drained: dict[str, str] = {}      # name -> drain reason
+        self._rr = itertools.count()
+        self._stall_mark: dict[str, tuple[int, float]] = {}
+        self._stop = threading.Event()
+        self._mon: threading.Thread | None = None
+        self._retries = obs.registry().counter("request_retries")
+        self._healthy_gauge = obs.registry().gauge("replicas_healthy")
+        self._healthy_gauge.set(len(self._healthy))
+
+    # TrafficGenerator (and FrontendClient construction) read the example
+    # geometry off whatever they drive; delegate to the first replica.
+    @property
+    def _example_shape(self):
+        return self.engines[0]._example_shape
+
+    @property
+    def _example_dtype(self):
+        return self.engines[0]._example_dtype
+
+    @property
+    def population(self) -> int:
+        return self.engines[0].population
+
+    def start(self) -> "ReplicaSet":
+        """Start the health monitor (the engines themselves are expected
+        started + warmed by the builder)."""
+        if self._mon is None:
+            self._mon = threading.Thread(target=self._monitor_loop,
+                                         daemon=True,
+                                         name="replica-health")
+            self._mon.start()
+        return self
+
+    def healthy_names(self) -> list[str]:
+        with self._lock:
+            return sorted(self._healthy)
+
+    def drained_names(self) -> dict[str, str]:
+        with self._lock:
+            return dict(self._drained)
+
+    # -- health gate ----------------------------------------------------
+    @staticmethod
+    def _alive(eng) -> bool:
+        return (eng.failed is None and not eng._stop
+                and eng._thread is not None and eng._thread.is_alive())
+
+    def _monitor_loop(self) -> None:
+        while not self._stop.wait(self.health_interval_s):
+            with self._lock:
+                current = list(self._healthy.values())
+            now = time.monotonic()
+            for eng in current:
+                if not self._alive(eng):
+                    self.drain(eng.name, reason="dispatcher_dead")
+                    continue
+                batches = int(eng._batches.value)
+                queued = len(eng._queue)
+                mark = self._stall_mark.get(eng.name)
+                if queued == 0 or mark is None or mark[0] != batches:
+                    self._stall_mark[eng.name] = (batches, now)
+                elif now - mark[1] >= self.stall_after_s:
+                    self.drain(eng.name, reason="stalled")
+
+    def drain(self, name: str, reason: str = "manual") -> bool:
+        """Remove a replica from rotation; idempotent. Returns True when
+        this call performed the drain."""
+        from feddrift_tpu import obs
+        with self._lock:
+            eng = self._healthy.pop(name, None)
+            if eng is None:
+                return False
+            self._drained[name] = reason
+            remaining = sorted(self._healthy)
+        self._healthy_gauge.set(len(remaining))
+        obs.registry().counter("replica_drains", reason=reason).inc()
+        obs.emit("replica_drained", replica=name, reason=reason,
+                 remaining=remaining)
+        log.warning("frontend: drained replica %s (%s), %d remaining",
+                    name, reason, len(remaining))
+        return True
+
+    def pick(self, exclude: frozenset | set = frozenset()):
+        with self._lock:
+            names = [n for n in sorted(self._healthy) if n not in exclude]
+            if not names:
+                raise EngineStopped(
+                    "no healthy replicas"
+                    + (f" (excluding {sorted(exclude)})" if exclude else ""))
+            return self._healthy[names[next(self._rr) % len(names)]]
+
+    # -- read path ------------------------------------------------------
+    def submit(self, client_id, x, timeout: float = 30.0,
+               trace: dict | None = None,
+               deadline_s: float | None = None) -> ServeResult:
+        """Engine-shaped submit with one-shot failover: a replica that
+        dies under the request (explicit ``EngineStopped``) is drained
+        and the request retried once on a survivor; a replica whose OWN
+        queue is full is retried once on another replica before the
+        overload propagates. Everything else propagates untouched —
+        the caller's timeout/deadline semantics are the engine's."""
+        eng = self.pick()
+        try:
+            return eng.submit(client_id, x, timeout=timeout, trace=trace,
+                              deadline_s=deadline_s)
+        except EngineStopped:
+            self.drain(eng.name, reason="dispatcher_dead")
+            self._retries.inc()
+            survivor = self.pick(exclude={eng.name})
+            return survivor.submit(client_id, x, timeout=timeout,
+                                   trace=trace, deadline_s=deadline_s)
+        except EngineOverloaded as overload:
+            try:
+                other = self.pick(exclude={eng.name})
+            except EngineStopped:
+                # single healthy replica: its overload is THE answer (a
+                # bare raise here would surface pick()'s EngineStopped
+                # and read as a dead fleet to the failover layer)
+                raise overload from None
+            self._retries.inc()
+            return other.submit(client_id, x, timeout=timeout, trace=trace,
+                                deadline_s=deadline_s)
+
+    # -- lifecycle / diagnostics ---------------------------------------
+    def close(self) -> None:
+        self._stop.set()
+        if self._mon is not None:
+            self._mon.join(timeout=5)
+            self._mon = None
+        for eng in self.engines:
+            try:
+                eng.close()
+            except Exception:   # noqa: BLE001 — close every replica
+                log.warning("frontend: replica %s close failed", eng.name,
+                            exc_info=True)
+
+    def stats(self) -> dict:
+        with self._lock:
+            healthy = sorted(self._healthy)
+            drained = dict(self._drained)
+        per = {}
+        for eng in self.engines:
+            per[eng.name] = {
+                "healthy": eng.name in healthy,
+                "served": int(eng._served.value),
+                "queued": len(eng._queue),
+                "version": eng.version,
+                "failed": repr(eng.failed) if eng.failed is not None
+                else None,
+            }
+        return {"healthy": healthy, "drained": drained,
+                "retries": int(self._retries.value), "replicas": per}
+
+
+# ----------------------------------------------------------------------
+# SLO wiring
+def frontend_slos(p99_ms: float) -> list:
+    """The serving-side objective set: request latency tail over
+    ``request_served`` events. Feed these to an ``SLOEngine`` tapped on
+    the bus and point a ``BackpressureController`` at the same name —
+    burn on the latency tail then shrinks the admit window."""
+    from feddrift_tpu.obs.live import SLObjective
+    if p99_ms <= 0:
+        return []
+    return [SLObjective(
+        "serve_p99_latency", ("request_served",),
+        lambda r: r.get("latency_ms"),
+        objective=float(p99_ms), direction="max", window=64,
+        budget_frac=0.01, burn_rate=5.0, min_samples=8, cooldown_s=2.0,
+        severity="crit",
+        description="serving request latency tail above the p99 "
+                    "objective (frontend backpressure input)")]
+
+
+# ----------------------------------------------------------------------
+# the HTTP request plane
+class _FrontendHandler(BaseHTTPRequestHandler):
+    server_version = "feddrift-frontend/1"
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, fmt, *args):  # noqa: N802 - stdlib API
+        log.debug("frontend %s " + fmt, self.client_address[0], *args)
+
+    def _send(self, code: int, body: bytes,
+              ctype: str = "application/json",
+              headers: dict | None = None) -> None:
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        for k, v in (headers or {}).items():
+            self.send_header(k, v)
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _send_json(self, code: int, doc: dict,
+                   headers: dict | None = None) -> None:
+        self._send(code, json.dumps(doc).encode(), headers=headers)
+
+    def do_GET(self):  # noqa: N802 - stdlib API
+        fe = self.server.frontend            # type: ignore[attr-defined]
+        path = self.path.split("?", 1)[0].rstrip("/") or "/"
+        try:
+            if path == "/metrics":
+                from feddrift_tpu import obs
+                self._send(200, obs.registry().to_prometheus_text().encode(),
+                           "text/plain; version=0.0.4; charset=utf-8")
+            elif path == "/healthz":
+                doc = fe.healthz()
+                self._send_json(200 if doc["status"] == "ok" else 503, doc)
+            elif path in ("/", "/status"):
+                self._send_json(200, fe.status())
+            else:
+                self._send_json(404, {"error": "not found"})
+        except (BrokenPipeError, ConnectionResetError):
+            pass
+        except Exception as exc:    # never let a scrape kill the thread
+            try:
+                self._send_json(500, {"error": str(exc)})
+            except OSError:
+                pass
+
+    def do_POST(self):  # noqa: N802 - stdlib API
+        fe = self.server.frontend            # type: ignore[attr-defined]
+        path = self.path.split("?", 1)[0].rstrip("/")
+        if path != "/v1/submit":
+            self._send_json(404, {"error": "not found"})
+            return
+        try:
+            n = int(self.headers.get("Content-Length") or 0)
+            doc = json.loads(self.rfile.read(n) or b"{}")
+            if not isinstance(doc, dict) or "client" not in doc \
+                    or "x" not in doc:
+                raise MalformedRequestError(
+                    'body must be a JSON object with "client" and "x"')
+            deadline_ms = doc.get("deadline_ms")
+            deadline_s = (float(deadline_ms) / 1e3
+                          if deadline_ms is not None else None)
+            res = fe.submit(doc["client"], doc["x"],
+                            timeout=fe.default_timeout_s,
+                            deadline_s=deadline_s,
+                            trace=doc.get("trace"))
+        except EngineOverloaded as e:
+            # Retry-After is integer-seconds per RFC; the sub-second hint
+            # rides in the body (and as a decimal header extension)
+            self._send_json(503, {"error": "overloaded", "detail": str(e),
+                                  "retry_after_s": e.retry_after_s},
+                            headers={"Retry-After":
+                                     f"{e.retry_after_s:.3f}"})
+        except DeadlineExceededError as e:
+            self._send_json(504, {"error": "deadline_exceeded",
+                                  "detail": str(e)})
+        except EngineStopped as e:
+            self._send_json(503, {"error": "unavailable", "detail": str(e)})
+        except TimeoutError as e:
+            self._send_json(504, {"error": "timeout", "detail": str(e)})
+        except (MalformedRequestError, UnknownClientError, ValueError,
+                TypeError, KeyError) as e:
+            kind = ("unknown_client" if isinstance(e, UnknownClientError)
+                    else "malformed")
+            self._send_json(400, {"error": kind, "detail": str(e)})
+        except (BrokenPipeError, ConnectionResetError):
+            return
+        except Exception as exc:    # noqa: BLE001 — keep the plane up
+            log.warning("frontend: request failed", exc_info=True)
+            try:
+                self._send_json(500, {"error": "internal",
+                                      "detail": str(exc)})
+            except OSError:
+                return
+        else:
+            self._send_json(200, {
+                "logits": np.asarray(res.logits).tolist(),
+                "model": res.model, "version": res.version,
+                "request_id": res.request_id})
+
+
+class _FrontendServer(ThreadingHTTPServer):
+    daemon_threads = True
+    # stdlib default listen backlog is 5: a closed-loop client pool
+    # opening N fresh connections at once overflows it, and the kernel's
+    # SYN retransmit turns every overflowed connect into a ~1s latency
+    # cliff (or a reset) that reads as a server-side tail. Admission
+    # control is the frontend's job — the accept queue must not preempt
+    # it with its own invisible shed.
+    request_queue_size = 128
+
+
+class ServingFrontend:
+    """One admission-controlled request plane over a ``ReplicaSet``.
+
+    ``submit()`` is the core both planes share: admit (shed explicitly
+    with reason + retry-after), route to a healthy replica, fail over
+    once. ``start()`` raises the HTTP plane; ``attach_broker()`` the
+    NDJSON one; ``attach_ops()`` publishes per-replica fleet lanes."""
+
+    def __init__(self, replicas: ReplicaSet,
+                 admission: AdmissionController | None = None,
+                 default_timeout_s: float = 30.0) -> None:
+        from feddrift_tpu import obs
+        self.replicas = replicas
+        self.admission = admission if admission is not None \
+            else AdmissionController()
+        self.default_timeout_s = float(default_timeout_s)
+        self._reg = obs.registry()
+        self._admitted = self._reg.counter("frontend_admitted")
+        self._httpd: ThreadingHTTPServer | None = None
+        self._http_thread: threading.Thread | None = None
+        self.host = "127.0.0.1"
+        self.port: int | None = None
+        self._broker_stop = threading.Event()
+        self._broker_threads: list[threading.Thread] = []
+
+    # -- the shared core ------------------------------------------------
+    def submit(self, client_id, x, timeout: float | None = None,
+               trace: dict | None = None,
+               deadline_s: float | None = None) -> ServeResult:
+        ok, reason, retry_after = self.admission.try_admit()
+        if not ok:
+            self._shed(reason, retry_after)
+            raise EngineOverloaded(f"frontend shed request ({reason})",
+                                   retry_after_s=retry_after)
+        self._admitted.inc()
+        try:
+            try:
+                return self.replicas.submit(
+                    client_id, x,
+                    timeout=timeout if timeout is not None
+                    else self.default_timeout_s,
+                    trace=trace, deadline_s=deadline_s)
+            except EngineOverloaded as e:
+                # every healthy replica's own queue was full: count the
+                # shed at the frontend too so one counter tells the
+                # whole overload story
+                self._shed("replica_queue", e.retry_after_s)
+                raise
+        finally:
+            self.admission.release()
+
+    def _shed(self, reason: str, retry_after: float) -> None:
+        from feddrift_tpu import obs
+        self._reg.counter("frontend_sheds", reason=reason).inc()
+        obs.emit("frontend_shed", reason=reason,
+                 retry_after_s=round(float(retry_after), 4))
+
+    # engine-shaped geometry: TrafficGenerator drives the frontend
+    # in-process exactly like an engine or a FrontendClient
+    @property
+    def _example_shape(self):
+        return self.replicas._example_shape
+
+    @property
+    def _example_dtype(self):
+        return self.replicas._example_dtype
+
+    @property
+    def population(self) -> int:
+        return self.replicas.population
+
+    # -- documents ------------------------------------------------------
+    def healthz(self) -> dict:
+        healthy = self.replicas.healthy_names()
+        drained = self.replicas.drained_names()
+        factor = (self.admission.backpressure.current()
+                  if self.admission.backpressure is not None else 1.0)
+        degraded = []
+        if not healthy:
+            degraded.append("no_replicas")
+        elif drained:
+            degraded.append("replicas_down")
+        if factor < 1.0:
+            degraded.append("backpressure")
+        return {
+            # only ZERO healthy replicas is hard-down (503); a drained
+            # replica or active backpressure degrades but still serves
+            "status": "down" if not healthy else
+                      ("degraded" if degraded else "ok"),
+            "degraded": degraded,
+            "replicas_healthy": healthy,
+            "replicas_drained": drained,
+            "backpressure_factor": round(factor, 4),
+            "pending": self.admission.pending,
+        }
+
+    def status(self) -> dict:
+        snap = self._reg.snapshot()
+        sheds = {k: v for k, v in snap.items()
+                 if k.startswith("frontend_sheds")}
+        return {
+            "example_shape": list(self.replicas._example_shape),
+            "example_dtype": str(np.dtype(self.replicas._example_dtype)),
+            "population": self.replicas.population,
+            "admitted": int(self._admitted.value),
+            "sheds": sheds,
+            "admission": {"max_pending": self.admission.max_pending,
+                          "pending": self.admission.pending},
+            "replicas": self.replicas.stats(),
+            "health": self.healthz(),
+        }
+
+    # -- HTTP plane -----------------------------------------------------
+    def start(self, port: int = 0,
+              host: str = "127.0.0.1") -> "ServingFrontend":
+        if self._httpd is not None:
+            return self
+        self.replicas.start()
+        self._httpd = _FrontendServer((host, port), _FrontendHandler)
+        self._httpd.frontend = self      # type: ignore[attr-defined]
+        self.host = host
+        self.port = self._httpd.server_address[1]
+        # long poll interval + close-time socket poke, exactly the
+        # OpsServer arrangement: select() wakes instantly for requests,
+        # the interval only bounds shutdown latency (which the poke
+        # removes), and idle wakeups stop preempting the dispatchers
+        self._http_thread = threading.Thread(
+            target=self._httpd.serve_forever,
+            kwargs={"poll_interval": 30.0},
+            daemon=True, name=f"serve-frontend:{self.port}")
+        self._http_thread.start()
+        log.info("serving frontend listening on http://%s:%d "
+                 "(/v1/submit /metrics /healthz /status), replicas: %s",
+                 self.host, self.port,
+                 ", ".join(self.replicas.healthy_names()))
+        return self
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    # -- NDJSON broker plane --------------------------------------------
+    def attach_broker(self, client, topic: str = REQUEST_TOPIC,
+                      workers: int = 2) -> "ServingFrontend":
+        """Consume request docs from a broker topic. Each message is a
+        JSON object ``{"client": int, "x": [...], "rid": any,
+        "reply_to": topic, "deadline_ms": optional}``; the reply —
+        ``{"rid", "ok", ...}`` with either the answer or the mapped
+        error + ``retry_after_s`` — publishes to its ``reply_to``."""
+        self.replicas.start()
+        q = client.subscribe(topic)
+
+        def worker() -> None:
+            while not self._broker_stop.is_set():
+                try:
+                    payload = q.get(timeout=0.25)
+                except queue_mod.Empty:
+                    continue
+                self._serve_broker_request(client, payload)
+
+        for i in range(max(1, int(workers))):
+            t = threading.Thread(target=worker, daemon=True,
+                                 name=f"frontend-broker:{i}")
+            t.start()
+            self._broker_threads.append(t)
+        return self
+
+    def _serve_broker_request(self, client, payload) -> None:
+        try:
+            doc = json.loads(payload) \
+                if isinstance(payload, (str, bytes)) else payload
+            reply_to = doc.get("reply_to")
+            rid = doc.get("rid")
+        except Exception:   # noqa: BLE001 — one bad frame != outage
+            log.warning("frontend: dropped malformed broker request",
+                        exc_info=True)
+            return
+        reply: dict = {"rid": rid}
+        try:
+            deadline_ms = doc.get("deadline_ms")
+            res = self.submit(
+                doc["client"], doc["x"],
+                deadline_s=(float(deadline_ms) / 1e3
+                            if deadline_ms is not None else None),
+                trace=doc.get("trace"))
+            reply.update(ok=True,
+                         logits=np.asarray(res.logits).tolist(),
+                         model=res.model, version=res.version,
+                         request_id=res.request_id)
+        except EngineOverloaded as e:
+            reply.update(ok=False, error="overloaded",
+                         retry_after_s=e.retry_after_s)
+        except DeadlineExceededError:
+            reply.update(ok=False, error="deadline_exceeded")
+        except EngineStopped as e:
+            reply.update(ok=False, error="unavailable", detail=str(e))
+        except TimeoutError:
+            reply.update(ok=False, error="timeout")
+        except Exception as e:      # noqa: BLE001 — reply, don't die
+            reply.update(ok=False, error="malformed", detail=str(e))
+        if not reply_to:
+            return
+        try:
+            client.publish(reply_to, json.dumps(reply))
+        except Exception:   # noqa: BLE001 — a dead requester is its problem
+            log.debug("frontend: reply publish to %r failed", reply_to,
+                      exc_info=True)
+
+    # -- fleet plane ----------------------------------------------------
+    def attach_ops(self, client, interval_s: float = 2.0,
+                   lane_prefix: str = "serve") -> "ServingFrontend":
+        """One fleet lane PER replica (``serve/<replica>``), so the
+        merged ``fleet`` table shows each replica's REQ/S and P99-REQ —
+        and a killed replica's lane going stale while the survivor's
+        keeps ticking is the failover story told live."""
+        for eng in self.replicas.engines:
+            eng.attach_ops(client, lane=f"{lane_prefix}/{eng.name}",
+                           interval_s=interval_s)
+        return self
+
+    # -- lifecycle ------------------------------------------------------
+    def close(self, close_replicas: bool = True) -> None:
+        self._broker_stop.set()
+        for t in self._broker_threads:
+            t.join(timeout=2)
+        self._broker_threads.clear()
+        if self._httpd is not None:
+            stopper = threading.Thread(target=self._httpd.shutdown,
+                                       daemon=True)
+            stopper.start()
+            deadline = time.time() + 5.0
+            while stopper.is_alive() and time.time() < deadline:
+                try:
+                    socket.create_connection(
+                        (self.host, self.port), timeout=0.2).close()
+                except OSError:
+                    pass
+                stopper.join(timeout=0.1)
+            stopper.join(timeout=1.0)
+            if self._http_thread is not None:
+                self._http_thread.join(timeout=2)
+                self._http_thread = None
+            self._httpd.server_close()
+            self._httpd = None
+        if self.admission.backpressure is not None:
+            self.admission.backpressure.detach()
+        if close_replicas:
+            self.replicas.close()
+
+
+# ----------------------------------------------------------------------
+# the engine-shaped HTTP client
+class FrontendClient:
+    """Drives a ``ServingFrontend`` over its socket with the engine's
+    exception taxonomy: 503-overloaded raises ``EngineOverloaded`` (with
+    the body's ``retry_after_s``), 503-unavailable ``EngineStopped``,
+    504 ``DeadlineExceededError``/``TimeoutError``, 400
+    ``UnknownClientError``/``MalformedRequestError``. Exposes the
+    example geometry read from ``/status``, so ``TrafficGenerator``
+    accepts it wherever an engine goes."""
+
+    def __init__(self, base_url: str, timeout: float = 30.0) -> None:
+        self.base_url = base_url.rstrip("/")
+        self.timeout = float(timeout)
+        with urllib.request.urlopen(self.base_url + "/status",
+                                    timeout=self.timeout) as resp:
+            doc = json.load(resp)
+        self._example_shape = tuple(doc["example_shape"])
+        self._example_dtype = np.dtype(doc["example_dtype"])
+        self.population = int(doc["population"])
+
+    def submit(self, client_id, x, timeout: float | None = None,
+               trace: dict | None = None,
+               deadline_s: float | None = None) -> ServeResult:
+        doc: dict = {"client": int(client_id),
+                     "x": np.asarray(x).tolist()}
+        if deadline_s is not None:
+            doc["deadline_ms"] = float(deadline_s) * 1e3
+        if trace is not None:
+            doc["trace"] = trace
+        req = urllib.request.Request(
+            self.base_url + "/v1/submit",
+            data=json.dumps(doc).encode(),
+            headers={"Content-Type": "application/json"}, method="POST")
+        try:
+            with urllib.request.urlopen(
+                    req, timeout=timeout if timeout is not None
+                    else self.timeout) as resp:
+                out = json.load(resp)
+        except urllib.error.HTTPError as e:
+            try:
+                body = json.load(e)
+            except Exception:   # noqa: BLE001 — non-JSON error body
+                body = {}
+            err = body.get("error")
+            detail = body.get("detail") or f"HTTP {e.code}"
+            if e.code == 503 and err == "overloaded":
+                raise EngineOverloaded(
+                    detail, retry_after_s=float(
+                        body.get("retry_after_s") or 0.05)) from None
+            if e.code == 503:
+                raise EngineStopped(detail) from None
+            if e.code == 504 and err == "deadline_exceeded":
+                raise DeadlineExceededError(detail) from None
+            if e.code == 504:
+                raise TimeoutError(detail) from None
+            if e.code == 400 and err == "unknown_client":
+                raise UnknownClientError(detail) from None
+            if e.code == 400:
+                raise MalformedRequestError(detail) from None
+            raise
+        except (TimeoutError, socket.timeout) as e:
+            raise TimeoutError(f"frontend socket timeout: {e}") from None
+        except urllib.error.URLError as e:
+            raise EngineStopped(f"frontend unreachable: {e}") from None
+        return ServeResult(
+            logits=np.asarray(out["logits"]), model=int(out["model"]),
+            version=int(out["version"]),
+            request_id=int(out["request_id"]))
+
+    def healthz(self) -> dict:
+        req = urllib.request.Request(self.base_url + "/healthz")
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+                return json.load(resp)
+        except urllib.error.HTTPError as e:
+            try:
+                return json.load(e)
+            except Exception:   # noqa: BLE001
+                return {"status": "down"}
+
+
+# ----------------------------------------------------------------------
+# builders
+def build_replica_set(pool, routing, n: int = 2, mesh=None,
+                      buckets=None, max_wait_s: float = 0.002,
+                      max_queue: int = 64, name_prefix: str = "r",
+                      start: bool = True, warmup: bool = True,
+                      stall_after_s: float = 2.0,
+                      health_interval_s: float = 0.1, **engine_kw)\
+        -> ReplicaSet:
+    """N named engine replicas over ONE shared pool/routing (the pool
+    params are read-only on the read path; each replica owns its
+    dispatcher, queue, and compiled programs). Every replica gets the
+    bounded admission queue — a frontend without a bounded engine queue
+    is an unbounded queue with extra steps."""
+    from feddrift_tpu.platform.serving import SERVE_BUCKETS, InferenceEngine
+    engines = []
+    for i in range(int(n)):
+        eng = InferenceEngine(
+            pool, routing, mesh=mesh,
+            buckets=buckets if buckets is not None else SERVE_BUCKETS,
+            max_wait_s=max_wait_s, max_queue=max_queue,
+            name=f"{name_prefix}{i}", **engine_kw)
+        if start:
+            eng.start()
+        if warmup:
+            eng.warmup()
+        engines.append(eng)
+    return ReplicaSet(engines, health_interval_s=health_interval_s,
+                      stall_after_s=stall_after_s)
+
+
+def build_frontend(run_dir: str, replicas: int = 2, max_pending: int = 64,
+                   rate_rps: float = 0.0, slo_p99_ms: float = 0.0,
+                   max_queue: int = 64, buckets=None,
+                   max_wait_s: float = 0.002) -> ServingFrontend:
+    """CLI-shaped builder: load the run's pool once, replicate the
+    engine N ways, and wire admission + (optionally) the SLO-driven
+    backpressure loop onto the process event bus."""
+    from feddrift_tpu import obs
+    from feddrift_tpu.obs.live import SLOEngine
+    from feddrift_tpu.platform.serving import load_engine
+    # load_engine does the checkpoint + registry reconstruction once; the
+    # loader engine is never started — its pool/routing seed the replicas
+    loader = load_engine(run_dir, buckets=buckets or (1, 2, 4, 8, 16, 32),
+                         max_wait_s=max_wait_s)
+    replica_set = build_replica_set(
+        loader.pool, loader._gen.routing, n=replicas, mesh=loader.mesh,
+        buckets=loader.buckets, max_wait_s=loader.max_wait_s,
+        max_queue=max_queue)
+    backpressure = None
+    if slo_p99_ms > 0:
+        SLOEngine(frontend_slos(slo_p99_ms)).attach(obs.get_bus())
+        backpressure = BackpressureController().attach(obs.get_bus())
+    bucket = TokenBucket(rate_rps) if rate_rps > 0 else None
+    admission = AdmissionController(max_pending=max_pending,
+                                    bucket=bucket,
+                                    backpressure=backpressure)
+    return ServingFrontend(replica_set, admission=admission)

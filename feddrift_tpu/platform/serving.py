@@ -86,6 +86,30 @@ class MalformedRequestError(ValueError):
     input geometry."""
 
 
+class EngineOverloaded(RuntimeError):
+    """Admission refused: the bounded request queue is full (or the
+    frontend's rate/backpressure controller shed the request). Explicit
+    shed is the overload contract — callers get this instead of unbounded
+    queue growth and a collapsing p99. Carries a ``retry_after_s`` hint."""
+
+    def __init__(self, msg: str, retry_after_s: float = 0.05) -> None:
+        super().__init__(msg)
+        self.retry_after_s = float(retry_after_s)
+
+
+class EngineStopped(RuntimeError):
+    """The engine shut down (or its dispatcher died) while the request
+    was queued or in flight. Distinct from ``TimeoutError`` so callers —
+    the replica-failover frontend above all — can tell "this replica is
+    gone, retry on a survivor" from "the caller's own deadline passed"."""
+
+
+class DeadlineExceededError(TimeoutError):
+    """The request's propagated deadline expired before dispatch; batch
+    formation dropped it instead of wasting a forward pass on an answer
+    nobody is waiting for."""
+
+
 # ======================================================================
 # Legacy round-lockstep executor (reference mobile backend)
 # ======================================================================
@@ -336,10 +360,10 @@ class ServeResult:
 
 class _Request:
     __slots__ = ("client", "x", "ctx", "rid", "t0", "ts", "done", "result",
-                 "error")
+                 "error", "deadline", "abandoned")
 
     def __init__(self, client: int, x: np.ndarray, ctx: dict,
-                 rid: int) -> None:
+                 rid: int, deadline: float | None = None) -> None:
         self.client = client
         self.x = x
         self.ctx = ctx
@@ -349,6 +373,12 @@ class _Request:
         self.done = threading.Event()
         self.result: ServeResult | None = None
         self.error: Exception | None = None
+        # absolute perf_counter deadline (None = no wire deadline); batch
+        # formation drops expired entries instead of running them
+        self.deadline = deadline
+        # the submitter timed out and stopped waiting: dead work — batch
+        # formation skips it so the forward program never pays for it
+        self.abandoned = False
 
 
 class InferenceEngine:
@@ -364,12 +394,21 @@ class InferenceEngine:
     def __init__(self, pool, routing: RoutingTable, mesh=None,
                  buckets=SERVE_BUCKETS, max_wait_s: float = 0.002,
                  cost_capture: str = "off", quality_window: int = 0,
-                 quality_ttl_s: float = 60.0) -> None:
+                 quality_ttl_s: float = 60.0, max_queue: int = 0,
+                 name: str | None = None) -> None:
         from feddrift_tpu.core.step import ForwardStep
         from feddrift_tpu.parallel.mesh import place_pool
 
         self.pool = pool
         self.mesh = mesh
+        # replica identity: labels this engine's latency sketch/counters so
+        # N in-process replicas behind one frontend stay distinguishable
+        # (request_latency_seconds_q{replica=...} aggregates through the
+        # fleet plane); None keeps the historical unlabeled series
+        self.name = name
+        # admission bound: 0 = unbounded (in-process library callers);
+        # a frontend always sets it so overload sheds instead of queueing
+        self.max_queue = int(max_queue)
         self.buckets = tuple(sorted({int(b) for b in buckets}))
         if not self.buckets or self.buckets[0] < 1:
             raise ValueError(f"buckets must be positive ints, got {buckets}")
@@ -388,6 +427,10 @@ class InferenceEngine:
         self._queue: deque[_Request] = deque()
         self._cond = threading.Condition()
         self._stop = False
+        # set to the crashing exception when the dispatcher dies on an
+        # error: submit() fails fast with EngineStopped, and a frontend's
+        # health gate reads it as "replica dead, fail over"
+        self.failed: BaseException | None = None
         self._thread: threading.Thread | None = None
         self._sub_thread: threading.Thread | None = None
         # RLock: commit_cluster_event plans + swaps under one hold
@@ -407,9 +450,14 @@ class InferenceEngine:
 
         from feddrift_tpu import obs
         reg = obs.registry()
-        self._lat = reg.quantile_sketch("request_latency_seconds_q")
-        self._served = reg.counter("requests_served")
-        self._batches = reg.counter("serve_batches")
+        labels = {"replica": name} if name else {}
+        self._lat = reg.quantile_sketch("request_latency_seconds_q",
+                                        **labels)
+        self._served = reg.counter("requests_served", **labels)
+        self._batches = reg.counter("serve_batches", **labels)
+        self._shed = reg.counter("requests_shed", **labels)
+        self._expired = reg.counter("requests_expired", **labels)
+        self._abandoned = reg.counter("requests_abandoned", **labels)
         reg.gauge("pool_version").set(self._gen.version)
 
     # -- lifecycle ------------------------------------------------------
@@ -435,10 +483,12 @@ class InferenceEngine:
         if self._sub_thread is not None:
             self._sub_thread.join(timeout=2)
             self._sub_thread = None
-        # fail whatever the dispatcher left behind
+        # fail whatever the dispatcher left behind — with the EXPLICIT
+        # shutdown error, so a caller (or failover layer) can tell
+        # "engine went away, retry elsewhere" from its own timeout
         while self._queue:
             r = self._queue.popleft()
-            r.error = RuntimeError("engine closed")
+            r.error = EngineStopped("engine stopped with request queued")
             r.done.set()
 
     def warmup(self) -> None:
@@ -464,13 +514,29 @@ class InferenceEngine:
 
     # -- read path ------------------------------------------------------
     def submit(self, client_id, x, timeout: float = 30.0,
-               trace: dict | None = None) -> ServeResult:
+               trace: dict | None = None,
+               deadline_s: float | None = None) -> ServeResult:
         """Route + answer one request; blocks until its micro-batch lands.
+
+        ``deadline_s`` is the request's remaining wire-propagated budget:
+        the wait is capped by it, and batch formation drops the request
+        with ``DeadlineExceededError`` if it expires while queued —
+        expired work never reaches the forward program.
 
         Raises ``MalformedRequestError`` on bad inputs,
         ``UnknownClientError`` on unroutable clients, ``TimeoutError``
-        past ``timeout``.
+        past ``timeout``, ``EngineOverloaded`` when the bounded queue is
+        full, ``EngineStopped`` when the engine shut down underneath the
+        request.
         """
+        if self.failed is not None:
+            raise EngineStopped(
+                f"engine dispatcher died: {self.failed!r}")
+        if self._stop:
+            # checked BEFORE the started check: close() nulls _thread, and
+            # a closed replica must fail over (EngineStopped), not crash
+            # the caller with a usage error
+            raise EngineStopped("engine is shutting down")
         if self._thread is None:
             raise RuntimeError("engine not started (call start())")
         try:
@@ -496,12 +562,29 @@ class InferenceEngine:
         from feddrift_tpu.obs import spans
         ctx = spans.child_of(trace) if trace else spans.new_trace()
         req = _Request(client, xa, ctx, next(self._rid))
+        wait = timeout
+        if deadline_s is not None:
+            req.deadline = req.t0 + float(deadline_s)
+            wait = min(wait, float(deadline_s))
         with self._cond:
+            if self.max_queue > 0 and len(self._queue) >= self.max_queue:
+                self._shed.inc()
+                raise EngineOverloaded(
+                    f"admission queue full ({self.max_queue} pending)",
+                    retry_after_s=max(self.max_wait_s * 2, 0.01))
             self._queue.append(req)
             self._cond.notify()
-        if not req.done.wait(timeout):
-            raise TimeoutError(f"request for client {client} timed out "
-                               f"after {timeout}s")
+        if not req.done.wait(wait):
+            # mark BEFORE raising: if the dispatcher has not picked the
+            # request up yet, batch formation skips it — a timed-out
+            # caller must never cost a forward-program row. The mark
+            # races a concurrent dispatch benignly: at worst the answer
+            # is computed and dropped, exactly the pre-fix behavior.
+            req.abandoned = True
+            if not req.done.is_set():   # completed in the race window?
+                raise TimeoutError(
+                    f"request for client {client} timed out after "
+                    f"{wait}s")
         if req.error is not None:
             raise req.error
         return req.result
@@ -536,7 +619,34 @@ class InferenceEngine:
                     if remaining <= 0 or self._stop:
                         break
                     self._cond.wait(remaining)
-            self._serve_batch(batch)
+            try:
+                self._serve_batch(batch)
+            except Exception as exc:  # noqa: BLE001 — contain the crash
+                self._dispatcher_died(exc, batch)
+                return
+
+    def _dispatcher_died(self, exc: BaseException,
+                         batch: list[_Request]) -> None:
+        """A batch blew up the dispatcher (bad params, fault injection,
+        device loss). Mark the engine dead, fail every in-flight and
+        queued request with the EXPLICIT replica-death error, and emit
+        the failure — hanging callers until their timeouts is how
+        single-replica outages become fleet-wide p99 collapses."""
+        from feddrift_tpu import obs
+        self.failed = exc
+        log.error("serving: dispatcher died on %r", exc, exc_info=exc)
+        err = EngineStopped(f"engine dispatcher died: {exc!r}")
+        with self._cond:
+            leftovers = list(self._queue)
+            self._queue.clear()
+        for r in batch + leftovers:
+            if not r.done.is_set():
+                r.error = err
+                r.done.set()
+        obs.emit("replica_failed", replica=self.name or "engine",
+                 reason="dispatcher_crash", error=repr(exc))
+        obs.registry().counter("replica_failures",
+                               reason="dispatcher_crash").inc()
 
     def _serve_batch(self, batch: list[_Request]) -> None:
         import jax.numpy as jnp
@@ -547,7 +657,23 @@ class InferenceEngine:
         gen = self._gen      # ONE reference read: params+routing coherent
         live: list[_Request] = []
         routes: list[int] = []
+        now = time.perf_counter()
         for r in batch:
+            if r.abandoned:
+                # caller already timed out and walked away — a forward-
+                # program row for it is pure waste
+                self._abandoned.inc()
+                r.done.set()
+                continue
+            if r.deadline is not None and now >= r.deadline:
+                # expired on the wire: nobody is waiting for this answer
+                self._expired.inc()
+                r.error = DeadlineExceededError(
+                    f"request for client {r.client} expired "
+                    f"{now - r.deadline:.3f}s past its deadline "
+                    f"before dispatch")
+                r.done.set()
+                continue
             try:
                 routes.append(gen.routing.route(r.client))
                 live.append(r)
@@ -918,10 +1044,21 @@ class ClusterEventRelay:
 
 
 class TrafficGenerator:
-    """Seeded closed-loop load: N workers each submit back-to-back
-    requests for seeded-random clients with seeded-random examples. Pure
-    function of (seed, clients, num_requests), so bench runs and the CI
-    smoke replay identical traffic."""
+    """Seeded load generator over anything with an engine-shaped
+    ``submit`` (the in-process engine, a ``ReplicaSet``, or a frontend
+    client). Two modes:
+
+    - ``run``: closed loop — N workers submit back-to-back. Simple, but
+      under overload every worker slows down with the server, so the
+      OFFERED rate silently sags to whatever the server can absorb
+      (coordinated omission) and saturation never shows in the numbers.
+    - ``run_open``: open loop — request ``k`` is due at ``t0 + k/rate``
+      no matter how the server is doing, and latency is measured from
+      that scheduled instant. This is the mode that can actually see a
+      saturation knee, sheds, and queueing delay.
+
+    Pure function of (seed, clients, num_requests), so bench runs and
+    the CI smoke replay identical traffic."""
 
     def __init__(self, engine: InferenceEngine, clients, seed: int = 0,
                  concurrency: int = 8, make_x=None) -> None:
@@ -974,6 +1111,81 @@ class TrafficGenerator:
                "errors": int(sum(errors)),
                "duration_s": round(wall, 4),
                "requests_per_s": round(ok / wall, 2) if wall > 0 else 0.0,
+               "concurrency": self.concurrency}
+        if ok:
+            for q, name in ((50, "p50_ms"), (95, "p95_ms"), (99, "p99_ms")):
+                out[name] = round(float(np.percentile(flat, q)) * 1e3, 3)
+        return out
+
+    def run_open(self, num_requests: int, rate_rps: float,
+                 timeout: float = 10.0,
+                 deadline_s: float | None = None) -> dict:
+        """Open-loop fixed-rate load (see class docstring): offers
+        ``rate_rps`` regardless of server state and classifies every
+        outcome — completed / shed / expired / timed out / errored —
+        with latencies measured from each request's SCHEDULED send time
+        so server-side queueing under overload is charged to the server,
+        not silently absorbed by a slowing client."""
+        num_requests = int(num_requests)
+        if rate_rps <= 0:
+            raise ValueError("rate_rps must be positive")
+        period = 1.0 / float(rate_rps)
+        lats: list[list[float]] = [[] for _ in range(self.concurrency)]
+        sheds = [0] * self.concurrency
+        timeouts = [0] * self.concurrency
+        expired = [0] * self.concurrency
+        errors = [0] * self.concurrency
+        # small lead so slot 0 isn't already late at thread start
+        start = time.perf_counter() + 0.05
+
+        def worker(w: int) -> None:
+            rng = np.random.RandomState(
+                (self.seed * 1_000_003 + w * 7_919 + 5) % (2**31 - 1))
+            kw = {} if deadline_s is None else {"deadline_s": deadline_s}
+            for k in range(w, num_requests, self.concurrency):
+                due = start + k * period
+                delay = due - time.perf_counter()
+                if delay > 0:
+                    time.sleep(delay)
+                c = self.clients[rng.randint(len(self.clients))]
+                x = self.make_x(rng)
+                try:
+                    self.engine.submit(c, x, timeout=timeout, **kw)
+                except EngineOverloaded:
+                    sheds[w] += 1
+                    continue
+                except DeadlineExceededError:
+                    expired[w] += 1
+                    continue
+                except TimeoutError:
+                    timeouts[w] += 1
+                    continue
+                except Exception:   # noqa: BLE001 — keep offering load
+                    errors[w] += 1
+                    continue
+                lats[w].append(time.perf_counter() - due)
+
+        threads = [threading.Thread(target=worker, args=(w,), daemon=True)
+                   for w in range(self.concurrency)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        wall = time.perf_counter() - start
+        flat = np.asarray([v for ws in lats for v in ws], dtype=np.float64)
+        ok = int(flat.size)
+        shed = int(sum(sheds))
+        out = {"mode": "open", "requests": num_requests,
+               "offered_rps": round(float(rate_rps), 2),
+               "completed": ok,
+               "sheds": shed,
+               "expired": int(sum(expired)),
+               "timeouts": int(sum(timeouts)),
+               "errors": int(sum(errors)),
+               "duration_s": round(wall, 4),
+               "achieved_rps": round(ok / wall, 2) if wall > 0 else 0.0,
+               "shed_rate": (round(shed / num_requests, 4)
+                             if num_requests else 0.0),
                "concurrency": self.concurrency}
         if ok:
             for q, name in ((50, "p50_ms"), (95, "p95_ms"), (99, "p99_ms")):

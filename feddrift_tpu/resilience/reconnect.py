@@ -50,9 +50,10 @@ log = logging.getLogger("feddrift_tpu")
 
 class _Pending:
     __slots__ = ("topic", "payload", "attempts", "last_send", "inner_seq",
-                 "session", "trace")
+                 "session", "trace", "pub_id")
 
-    def __init__(self, topic: str, payload: str, trace=None) -> None:
+    def __init__(self, topic: str, payload: str, trace=None,
+                 pub_id: int = 0) -> None:
         self.topic = topic
         self.payload = payload
         self.attempts = 0
@@ -60,6 +61,7 @@ class _Pending:
         self.inner_seq: Optional[int] = None
         self.session = -1          # session generation of the last send
         self.trace = trace         # causal context; survives resends
+        self.pub_id = pub_id       # global publish order; keys replay order
 
 
 class ReconnectingBrokerClient:
@@ -207,6 +209,12 @@ class ReconnectingBrokerClient:
             stale = list(self._pending.values())
             cutoff = time.monotonic() - self._redeliver_window
             stale += [p for ts, p in self._recent if ts >= cutoff]
+            # replay in ORIGINAL publish order: a recent (acked-then-
+            # crashed) publish is older than anything still pending, and
+            # replaying it after a newer unconfirmed publish to the same
+            # topic reorders the stream — an order-sensitive consumer
+            # (serving cluster events) would end on the stale state
+            stale.sort(key=lambda p: p.pub_id)
         self.reconnects += 1
         self._hb_last_rx = time.monotonic()  # fresh grace period
         for topic, qs in topics.items():     # subscription replay
@@ -232,9 +240,9 @@ class ReconnectingBrokerClient:
         reconnect resends — trace continuity across a broker restart."""
         if self._closed:
             raise RuntimeError("publish on closed client")
-        p = _Pending(topic, payload, trace)
         with self._lock:
             self._next_id += 1
+            p = _Pending(topic, payload, trace, pub_id=self._next_id)
             self._pending[self._next_id] = p
             while len(self._pending) > self._pending_max:
                 self._pending.popitem(last=False)   # evict oldest

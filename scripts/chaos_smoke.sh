@@ -70,12 +70,12 @@ OUT=$(mktemp -d)
 trap 'rm -rf "$OUT"' EXIT
 RUN="$OUT/run"
 
-echo "== [1/12] chaos transport e2e (drop_prob=0.2 + broker kill/restart) =="
+echo "== [1/13] chaos transport e2e (drop_prob=0.2 + broker kill/restart) =="
 timeout -k 10 300 python -m pytest tests/test_resilience.py -q \
     -p no:cacheprovider -p no:randomly \
     -k "ChaosEndToEnd or survives_broker_kill or heartbeat_missed"
 
-echo "== [2/12] preemption: SIGTERM a real run, then --auto_resume =="
+echo "== [2/13] preemption: SIGTERM a real run, then --auto_resume =="
 ARGS=(--dataset sine --model fnn --concept_drift_algo win-1
       --concept_num 2 --client_num_in_total 4 --client_num_per_round 4
       --train_iterations 6 --comm_round 8 --epochs 2
@@ -112,15 +112,15 @@ print(f"resume OK: {len(rows)} metric rows, final Test/Acc="
       f"{rows[-1]['Test/Acc']:.4f}")
 EOF
 
-echo "== [3/12] event taxonomy consistency (strict: no dead kinds) =="
+echo "== [3/13] event taxonomy consistency (strict: no dead kinds) =="
 python scripts/check_events_schema.py --strict
 
-echo "== [4/12] byzantine smoke: trimmed_mean defends where mean fails =="
+echo "== [4/13] byzantine smoke: trimmed_mean defends where mean fails =="
 timeout -k 10 300 python -m pytest tests/test_robust_agg.py -q \
     -p no:cacheprovider -p no:randomly \
     -k "trimmed_mean_defends_where_mean_fails"
 
-echo "== [5/12] decision observability: kill clients -> alerts + lineage =="
+echo "== [5/13] decision observability: kill clients -> alerts + lineage =="
 LRUN="$OUT/lineage-run"
 timeout -k 10 300 python - "$LRUN" <<'EOF'
 import sys
@@ -154,7 +154,7 @@ python -m feddrift_tpu report "$LRUN" > "$OUT/report.txt"
 grep -q "alerts:" "$OUT/report.txt" \
     || { echo "report missing alerts section"; exit 1; }
 
-echo "== [6/12] participation: 10^3 population, 20% stragglers + churn =="
+echo "== [6/13] participation: 10^3 population, 20% stragglers + churn =="
 PRUN="$OUT/population-run"
 timeout -k 10 300 python -m feddrift_tpu run \
     --dataset sea --model fnn --concept_drift_algo softcluster \
@@ -173,7 +173,7 @@ python -m feddrift_tpu report "$PRUN" > "$OUT/preport.txt"
 grep -q "participation:" "$OUT/preport.txt" \
     || { echo "report missing participation section"; exit 1; }
 
-echo "== [7/12] fused participation: megastep_k=4 kill -> resume, same cohorts =="
+echo "== [7/13] fused participation: megastep_k=4 kill -> resume, same cohorts =="
 FREF="$OUT/fused-ref"
 FRUN="$OUT/fused-run"
 FARGS=(--dataset sea --model fnn --concept_drift_algo oblivious
@@ -231,7 +231,7 @@ print(f"fused resume OK: {len(c_ref)} iterations, identical cohort "
       f"schedule, {len(rows)} metric rows")
 EOF
 
-echo "== [8/12] hierarchy: 10^3 population, kill edge 0 mid-run =="
+echo "== [8/13] hierarchy: 10^3 population, kill edge 0 mid-run =="
 HRUN="$OUT/hierarchy-run"
 timeout -k 10 300 python -m feddrift_tpu run \
     --dataset sea --model fnn --concept_drift_algo softcluster \
@@ -269,12 +269,12 @@ grep -q "hierarchy:" "$OUT/hreport.txt" \
 grep -q "re-homed:" "$OUT/hreport.txt" \
     || { echo "report missing re-homed line"; exit 1; }
 
-echo "== [9/12] causal trace continuity across broker reconnect =="
+echo "== [9/13] causal trace continuity across broker reconnect =="
 timeout -k 10 300 python -m pytest tests/test_causal_trace.py -q \
     -p no:cacheprovider -p no:randomly \
     -k "trace_survives_broker_reconnect"
 
-echo "== [10/12] live ops plane: broker kill -> /healthz 503 + slo_burn -> recovery =="
+echo "== [10/13] live ops plane: broker kill -> /healthz 503 + slo_burn -> recovery =="
 ORUN="$OUT/ops-run"
 mkdir -p "$ORUN"
 timeout -k 10 300 python - "$ORUN" <<'EOF'
@@ -342,7 +342,7 @@ print(f"  recovery OK: /healthz {code} {doc['status']}, "
 client.close(); srv.close(); broker2.close()
 EOF
 
-echo "== [11/12] serving: broker kill mid-traffic -> degrade, swaps resume =="
+echo "== [11/13] serving: broker kill mid-traffic -> degrade, swaps resume =="
 SRUN="$OUT/serve-run"
 mkdir -p "$SRUN"
 timeout -k 10 300 python - "$SRUN" <<'EOF'
@@ -466,7 +466,7 @@ print(f"  recovery OK: {stats['served']} served total, 0 errors, "
       f"pool version {stats['version']}")
 EOF
 
-echo "== [12/12] canary: corrupt candidate mid-swap -> rollback + crit alert, 0 errors =="
+echo "== [12/13] canary: corrupt candidate mid-swap -> rollback + crit alert, 0 errors =="
 CRUN="$OUT/canary-run"
 mkdir -p "$CRUN"
 timeout -k 10 300 python - "$CRUN" <<'EOF'
@@ -550,6 +550,125 @@ assert "canary_started" in kinds and "canary_verdict" in kinds
 print(f"  rollback OK: shadow_acc={v['shadow_acc']} vs "
       f"live_acc={v['live_acc']} over {v['samples']} labels, "
       f"{served[0]} requests served, 0 errors")
+EOF
+
+echo "== [13/13] frontend: kill 1 of 2 replicas mid-traffic -> 0 admitted failures, survivor lane lives =="
+FRUN="$OUT/frontend-run"
+mkdir -p "$FRUN"
+timeout -k 10 300 python - "$FRUN" <<'EOF'
+import json, os, sys, threading, time
+import numpy as np
+import jax.numpy as jnp
+from feddrift_tpu import obs
+from feddrift_tpu.comm.netbroker import NetworkBroker, NetworkBrokerClient
+from feddrift_tpu.config import ExperimentConfig
+from feddrift_tpu.core.pool import ModelPool
+from feddrift_tpu.data.registry import make_dataset
+from feddrift_tpu.models import create_model
+from feddrift_tpu.obs.live import FleetCollector
+from feddrift_tpu.platform.faults import ReplicaFaultInjector
+from feddrift_tpu.platform.frontend import (AdmissionController,
+                                            FrontendClient, ServingFrontend,
+                                            build_replica_set)
+from feddrift_tpu.platform.serving import EngineOverloaded, RoutingTable
+
+out = sys.argv[1]
+obs.configure(os.path.join(out, "events.jsonl"))
+
+cfg = ExperimentConfig(dataset="sea", train_iterations=2, sample_num=16)
+ds = make_dataset(cfg)
+pool = ModelPool.create(create_model("fnn", ds, cfg),
+                        jnp.asarray(ds.x[0, 0, :2]), 2, seed=7,
+                        identical=False)
+rs = build_replica_set(pool, RoutingTable([0] * 8), n=2, buckets=(1, 2, 4),
+                       max_queue=64, stall_after_s=2.0,
+                       health_interval_s=0.05)
+# arm AFTER warmup (the builder warmed both replicas) so the warmup
+# forwards don't count toward the fuse: r0's dispatcher will crash
+# inside a forward ~12 batches into live traffic
+inj = ReplicaFaultInjector(mode="crash", after_batches=12, seed=3)
+inj.arm(rs.engines[0])
+
+fe = ServingFrontend(rs, admission=AdmissionController(max_pending=64))
+broker = NetworkBroker()
+fe.attach_ops(NetworkBrokerClient(broker.host, broker.port, timeout=2.0),
+              interval_s=0.2)
+fleet = FleetCollector(
+    NetworkBrokerClient(broker.host, broker.port, timeout=2.0))
+fe.start(port=0)
+cli = FrontendClient(f"http://{fe.host}:{fe.port}", timeout=10.0)
+
+# closed-loop socket traffic for the WHOLE scenario: an explicit shed
+# (503 + retry-after) is admission control doing its job; ANY other
+# failure of an admitted request across the crash fails the stage
+stop = threading.Event()
+lock = threading.Lock()
+served, sheds, failures = [0], [0], []
+def pump(w):
+    rng = np.random.RandomState(w)
+    while not stop.is_set():
+        try:
+            cli.submit(int(rng.randint(8)),
+                       rng.standard_normal(3).astype(np.float32))
+            with lock:
+                served[0] += 1
+        except EngineOverloaded:
+            with lock:
+                sheds[0] += 1
+            time.sleep(0.01)
+        except Exception as e:
+            with lock:
+                failures.append(repr(e))
+pumps = [threading.Thread(target=pump, args=(w,), daemon=True)
+         for w in range(4)]
+for t in pumps:
+    t.start()
+
+def wait_for(pred, what, timeout_s=60.0):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if pred():
+            return
+        time.sleep(0.05)
+    raise AssertionError(f"timed out waiting for {what}")
+
+wait_for(lambda: rs.engines[0].failed is not None, "armed crash to fire")
+wait_for(lambda: rs.healthy_names() == ["r1"], "health gate to drain r0")
+before = served[0]
+wait_for(lambda: served[0] >= before + 100,
+         "survivor to carry traffic after the kill")
+stop.set()
+for t in pumps:
+    t.join(timeout=10)
+
+assert not failures, \
+    f"{len(failures)} admitted requests failed across the crash: " \
+    f"{failures[:5]}"
+st = rs.stats()
+assert st["drained"].get("r0") == "dispatcher_dead", st["drained"]
+assert st["healthy"] == ["r1"], st["healthy"]
+# one-shot failover: every request caught in flight on r0 retried at
+# most ONCE (bounded by the admission window — no retry storm)
+assert 1 <= st["retries"] <= 64, st["retries"]
+hc = fe.healthz()
+assert hc["status"] == "degraded" and "replicas_down" in hc["degraded"], hc
+
+# fleet plane: the survivor's per-replica lane keeps ticking
+lanes = fleet.collect(duration_s=20.0, poll_s=0.2, min_lanes=2)
+assert "serve/r1" in lanes, sorted(lanes)
+seq1 = lanes["serve/r1"]["seq"]
+time.sleep(0.6)
+assert fleet.poll()["serve/r1"]["seq"] > seq1, \
+    "survivor lane went stale after the kill"
+
+fe.close()
+broker.close()
+kinds = {json.loads(l)["kind"]
+         for l in open(os.path.join(out, "events.jsonl"))}
+for k in ("chaos_injected", "replica_failed", "replica_drained"):
+    assert k in kinds, f"missing {k} in {sorted(kinds)}"
+print(f"  failover OK: {served[0]} served ({sheds[0]} explicit sheds), "
+      f"0 admitted failures, retries={st['retries']}, survivor r1")
 EOF
 
 echo "chaos_smoke: ALL OK"

@@ -1,0 +1,504 @@
+"""Serving frontend tests (platform/frontend.py + the read-path
+robustness satellites in platform/serving.py).
+
+The open-socket frontend has three load-bearing behaviors, each pinned
+here:
+
+- admission control sheds EXPLICITLY: refusal is an `EngineOverloaded`
+  with a reason + retry-after hint (and an HTTP 503 with `Retry-After`),
+  never a silent queue into the void — under a saturating OPEN-loop
+  storm the bounded frontend keeps the admitted requests' tail bounded,
+  never deadlocks, and recovers the moment the storm passes;
+- replica management is health-gated with ONE-shot failover: a replica
+  whose dispatcher died (or whose forward wedged) is drained from
+  rotation, requests caught in flight on it get the explicit
+  ``EngineStopped`` and are retried exactly once on a survivor;
+- both request planes (in-process / HTTP) speak the same exception
+  taxonomy in both directions.
+"""
+
+import threading
+import time
+import types
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from feddrift_tpu import obs
+from feddrift_tpu.config import ExperimentConfig
+from feddrift_tpu.core.pool import ModelPool
+from feddrift_tpu.data.registry import make_dataset
+from feddrift_tpu.models import create_model
+from feddrift_tpu.platform.faults import ReplicaFaultInjector
+from feddrift_tpu.platform.frontend import (
+    AdmissionController, BackpressureController, FrontendClient,
+    ReplicaSet, ServingFrontend, TokenBucket, build_replica_set,
+    frontend_slos)
+from feddrift_tpu.platform.serving import (
+    DeadlineExceededError, EngineOverloaded, EngineStopped,
+    MalformedRequestError, RoutingTable, TrafficGenerator,
+    UnknownClientError)
+
+
+def _pool(M=2):
+    cfg = ExperimentConfig(dataset="sea", train_iterations=2, sample_num=16)
+    ds = make_dataset(cfg)
+    mod = create_model("fnn", ds, cfg)
+    return ModelPool.create(mod, jnp.zeros((2, 3)), M, seed=7,
+                            identical=False)
+
+
+def _replicas(pool, table, n=1, **kw):
+    kw.setdefault("buckets", (1, 2))
+    kw.setdefault("max_wait_s", 0.002)
+    kw.setdefault("health_interval_s", 0.02)
+    return build_replica_set(pool, RoutingTable(table), n=n, **kw)
+
+
+def _wait_for(pred, what, timeout_s=10.0):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if pred():
+            return
+        time.sleep(0.01)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+# ----------------------------------------------------------------------
+# admission control units (no engine, fake clocks)
+class TestTokenBucket:
+    def test_burst_then_refill(self):
+        t = [0.0]
+        tb = TokenBucket(10.0, burst=2, time_fn=lambda: t[0])
+        assert tb.try_acquire()
+        assert tb.try_acquire()
+        assert not tb.try_acquire()          # burst exhausted
+        assert 0.0 < tb.retry_after_s() <= 0.1
+        t[0] += 0.1                          # exactly one token refills
+        assert tb.try_acquire()
+        assert not tb.try_acquire()
+
+    def test_refill_caps_at_burst(self):
+        t = [0.0]
+        tb = TokenBucket(100.0, burst=3, time_fn=lambda: t[0])
+        t[0] += 60.0                         # idle forever != infinite burst
+        got = sum(tb.try_acquire() for _ in range(10))
+        assert got == 3
+
+
+class TestBackpressure:
+    def test_multiplicative_shrink_floor_and_stepwise_recovery(self):
+        t = [0.0]
+        bp = BackpressureController(shrink=0.5, floor=0.25, recovery_s=1.0,
+                                    time_fn=lambda: t[0])
+        assert bp.current() == 1.0
+        burn = {"kind": "slo_burn", "slo": "serve_p99_latency"}
+        bp.observe(burn)
+        assert bp.current() == 0.5
+        bp.observe(burn)
+        bp.observe(burn)                     # floor-clamped
+        assert bp.current() == 0.25
+        bp.observe({"kind": "slo_burn", "slo": "other_objective"})
+        bp.observe({"kind": "request_served", "slo": "serve_p99_latency"})
+        assert bp.current() == 0.25          # unwatched records ignored
+        t[0] += 1.0
+        assert bp.current() == 0.5           # one shrink healed per window
+        t[0] += 1.0
+        assert bp.current() == 1.0
+        t[0] += 10.0
+        assert bp.current() == 1.0           # never overshoots
+
+    def test_slo_burn_on_bus_drives_the_factor(self):
+        from feddrift_tpu.obs.live import SLOEngine
+        bus = obs.get_bus()
+        slo = SLOEngine(frontend_slos(1.0)).attach(bus)
+        bp = BackpressureController().attach(bus)
+        try:
+            # objective: p99 <= 1ms; every observation violates -> the
+            # burn-rate rule fires once min_samples is reached
+            for _ in range(16):
+                obs.emit("request_served", client=0, model=0, version=1,
+                         batch=1, latency_ms=500.0)
+            assert bp.current() < 1.0
+        finally:
+            bp.detach()
+            bus.remove_tap(slo.observe)
+
+
+class TestAdmissionController:
+    def test_window_and_release(self):
+        adm = AdmissionController(max_pending=2)
+        assert adm.try_admit() == (True, None, 0.0)
+        assert adm.try_admit()[0]
+        ok, reason, retry_after = adm.try_admit()
+        assert (ok, reason) == (False, "queue_full")
+        assert retry_after > 0
+        adm.release()
+        assert adm.try_admit()[0]
+        assert adm.pending == 2
+
+    def test_rate_limit_checked_first(self):
+        t = [0.0]
+        tb = TokenBucket(1.0, burst=1, time_fn=lambda: t[0])
+        adm = AdmissionController(max_pending=8, bucket=tb)
+        assert adm.try_admit()[0]
+        ok, reason, retry_after = adm.try_admit()
+        assert (ok, reason) == (False, "rate_limited")
+        assert retry_after > 0
+        assert adm.pending == 1              # the refusal held no slot
+
+    def test_backpressure_scales_window_and_names_the_reason(self):
+        t = [0.0]
+        bp = BackpressureController(shrink=0.5, floor=0.25, recovery_s=60.0,
+                                    time_fn=lambda: t[0])
+        adm = AdmissionController(max_pending=4, backpressure=bp)
+        bp.observe({"kind": "slo_burn", "slo": "serve_p99_latency"})
+        assert adm.try_admit()[0]
+        assert adm.try_admit()[0]            # scaled window: 4 * 0.5 = 2
+        ok, reason, _ = adm.try_admit()
+        assert (ok, reason) == (False, "backpressure")
+
+    def test_max_pending_must_be_positive(self):
+        with pytest.raises(ValueError):
+            AdmissionController(max_pending=0)
+
+
+# ----------------------------------------------------------------------
+# replica management over engine-shaped fakes (failover logic isolated
+# from JAX)
+class _FakeEngine:
+    def __init__(self, name, behavior=None):
+        self.name = name
+        self.failed = None
+        self._stop = False
+        self._thread = None
+        self._queue = []
+        self._batches = types.SimpleNamespace(value=0)
+        self.calls = 0
+        self.behavior = behavior
+
+    def submit(self, client_id, x, timeout=30.0, trace=None,
+               deadline_s=None):
+        self.calls += 1
+        if self.behavior is not None:
+            return self.behavior(self)
+        return f"ok:{self.name}"
+
+    def close(self):
+        self._stop = True
+
+
+class TestReplicaSetFailover:
+    def test_unique_names_required(self):
+        with pytest.raises(ValueError):
+            ReplicaSet([_FakeEngine("a"), _FakeEngine("a")])
+        with pytest.raises(ValueError):
+            ReplicaSet([_FakeEngine(None)])
+        with pytest.raises(ValueError):
+            ReplicaSet([])
+
+    def test_round_robin_over_healthy(self):
+        fakes = [_FakeEngine(f"r{i}") for i in range(3)]
+        rs = ReplicaSet(fakes)
+        for _ in range(6):
+            rs.submit(0, [0.0])
+        assert [f.calls for f in fakes] == [2, 2, 2]
+
+    def test_engine_stopped_drains_and_retries_once(self):
+        def die(eng):
+            raise EngineStopped("dispatcher died")
+        dead = _FakeEngine("r0", behavior=die)
+        live = _FakeEngine("r1")
+        rs = ReplicaSet([dead, live])
+        before = obs.registry().counter("request_retries").value
+        results = [rs.submit(0, [0.0]) for _ in range(4)]
+        assert all(r == "ok:r1" for r in results)
+        assert dead.calls == 1               # drained after the first death
+        assert rs.drained_names() == {"r0": "dispatcher_dead"}
+        assert rs.healthy_names() == ["r1"]
+        assert obs.registry().counter("request_retries").value == before + 1
+
+    def test_overload_on_sole_replica_propagates(self):
+        def full(eng):
+            raise EngineOverloaded("queue full", retry_after_s=0.02)
+        rs = ReplicaSet([_FakeEngine("r0", behavior=full)])
+        with pytest.raises(EngineOverloaded):
+            rs.submit(0, [0.0])
+        assert rs.healthy_names() == ["r0"]  # overload is NOT a death
+
+    def test_overload_retries_another_replica(self):
+        def full(eng):
+            raise EngineOverloaded("queue full", retry_after_s=0.02)
+        busy = _FakeEngine("r0", behavior=full)
+        idle = _FakeEngine("r1")
+        rs = ReplicaSet([busy, idle])
+        assert rs.submit(0, [0.0]) == "ok:r1"
+        assert rs.healthy_names() == ["r0", "r1"]
+
+    def test_all_drained_raises_engine_stopped(self):
+        rs = ReplicaSet([_FakeEngine("r0")])
+        rs.drain("r0", reason="manual")
+        with pytest.raises(EngineStopped, match="no healthy"):
+            rs.submit(0, [0.0])
+
+    def test_monitor_drains_dead_dispatcher(self):
+        dead = _FakeEngine("r0")             # _thread is None -> not alive
+        live = _FakeEngine("r1")
+        live._thread = threading.Thread(target=lambda: time.sleep(30),
+                                        daemon=True)
+        live._thread.start()
+        rs = ReplicaSet([dead, live], health_interval_s=0.01).start()
+        try:
+            _wait_for(lambda: rs.drained_names().get("r0")
+                      == "dispatcher_dead", "monitor to drain r0")
+            assert rs.healthy_names() == ["r1"]
+        finally:
+            rs._stop.set()
+
+    def test_monitor_drains_stalled_replica(self):
+        # alive thread, work queued, batch counter frozen = a wedged
+        # forward; liveness checks can't see it, the stall detector must
+        stalled = _FakeEngine("r0")
+        stalled._thread = threading.Thread(target=lambda: time.sleep(30),
+                                           daemon=True)
+        stalled._thread.start()
+        stalled._queue = [object()]
+        rs = ReplicaSet([stalled], health_interval_s=0.01,
+                        stall_after_s=0.05).start()
+        try:
+            _wait_for(lambda: rs.drained_names().get("r0") == "stalled",
+                      "stall detector to drain r0")
+        finally:
+            rs._stop.set()
+
+
+# ----------------------------------------------------------------------
+# frontend shed semantics (fake replicas)
+class TestFrontendShed:
+    def test_shed_is_explicit_with_reason_and_hint(self):
+        rs = ReplicaSet([_FakeEngine("r0")])
+        fe = ServingFrontend(rs, admission=AdmissionController(max_pending=1))
+        shed_before = obs.registry().counter(
+            "frontend_sheds", reason="queue_full").value
+        assert fe.admission.try_admit()[0]   # occupy the only slot
+        with pytest.raises(EngineOverloaded) as ei:
+            fe.submit(0, [0.0])
+        assert ei.value.retry_after_s > 0
+        assert obs.registry().counter(
+            "frontend_sheds", reason="queue_full").value == shed_before + 1
+        fe.admission.release()
+        assert fe.submit(0, [0.0]) == "ok:r0"
+
+    def test_replica_queue_overload_counts_at_the_frontend(self):
+        def full(eng):
+            raise EngineOverloaded("queue full", retry_after_s=0.02)
+        rs = ReplicaSet([_FakeEngine("r0", behavior=full)])
+        fe = ServingFrontend(rs)
+        before = obs.registry().counter(
+            "frontend_sheds", reason="replica_queue").value
+        with pytest.raises(EngineOverloaded):
+            fe.submit(0, [0.0])
+        assert obs.registry().counter(
+            "frontend_sheds", reason="replica_queue").value == before + 1
+        assert fe.admission.pending == 0     # slot released on the way out
+
+    def test_healthz_degrades_and_downs(self):
+        fakes = [_FakeEngine("r0"), _FakeEngine("r1")]
+        rs = ReplicaSet(fakes)
+        fe = ServingFrontend(rs)
+        assert fe.healthz()["status"] == "ok"
+        rs.drain("r0", reason="manual")
+        hc = fe.healthz()
+        assert hc["status"] == "degraded"
+        assert "replicas_down" in hc["degraded"]
+        rs.drain("r1", reason="manual")
+        assert fe.healthz()["status"] == "down"
+
+
+# ----------------------------------------------------------------------
+# replica fault injection (wraps step.forward; no JAX needed here)
+class TestReplicaFaultInjector:
+    def _engine_shell(self, name="rX"):
+        calls = []
+
+        def forward(params, x, midx):
+            calls.append(1)
+            return "logits"
+
+        return types.SimpleNamespace(
+            name=name, step=types.SimpleNamespace(forward=forward)), calls
+
+    def test_crash_fires_once_at_the_seeded_batch(self):
+        eng, calls = self._engine_shell()
+        inj = ReplicaFaultInjector(mode="crash", after_batches=3, seed=0)
+        inj.arm(eng)
+        assert eng.step.forward(None, None, None) == "logits"
+        assert eng.step.forward(None, None, None) == "logits"
+        with pytest.raises(RuntimeError, match="injected replica crash"):
+            eng.step.forward(None, None, None)
+        assert inj.fired and len(calls) == 2     # the crash batch never ran
+
+    def test_slow_delays_every_batch_from_fire_at(self):
+        eng, _ = self._engine_shell()
+        inj = ReplicaFaultInjector(mode="slow", after_batches=2,
+                                   slow_s=0.05, seed=0)
+        inj.arm(eng)
+        t0 = time.perf_counter()
+        eng.step.forward(None, None, None)
+        assert time.perf_counter() - t0 < 0.04   # before fire_at: untouched
+        t0 = time.perf_counter()
+        eng.step.forward(None, None, None)
+        eng.step.forward(None, None, None)
+        assert time.perf_counter() - t0 >= 0.1   # every batch after: +slow_s
+
+    def test_disarm_restores_and_double_arm_rejected(self):
+        eng, _ = self._engine_shell()
+        original = eng.step.forward
+        inj = ReplicaFaultInjector(mode="crash", after_batches=1, seed=0)
+        inj.arm(eng)
+        with pytest.raises(RuntimeError, match="already armed"):
+            inj.arm(eng)
+        inj.disarm()
+        assert eng.step.forward is original
+
+    def test_jitter_is_seed_deterministic(self):
+        a = ReplicaFaultInjector(mode="crash", after_batches=5, jitter=4,
+                                 seed=11)
+        b = ReplicaFaultInjector(mode="crash", after_batches=5, jitter=4,
+                                 seed=11)
+        assert a.fire_at == b.fire_at
+        assert 5 <= a.fire_at <= 9
+
+
+# ----------------------------------------------------------------------
+# overload semantics end-to-end: saturating OPEN-loop storm against a
+# bounded frontend over a real (deliberately slowed) engine
+class TestOverloadSemantics:
+    def test_open_loop_storm_sheds_explicitly_and_recovers(self):
+        pool = _pool(M=2)
+        rs = _replicas(pool, [0, 1] * 4, n=1, max_queue=8)
+        # slow every forward: capacity collapses far below the offered
+        # rate, so the bounded frontend MUST shed
+        inj = ReplicaFaultInjector(mode="slow", after_batches=1,
+                                   slow_s=0.02, seed=0)
+        inj.arm(rs.engines[0])
+        fe = ServingFrontend(rs, admission=AdmissionController(max_pending=4))
+        try:
+            gen = TrafficGenerator(fe, clients=range(8), seed=3,
+                                   concurrency=16)
+            stats = gen.run_open(150, rate_rps=300.0, timeout=2.0)
+            # every request is accounted for: no deadlock, nothing lost
+            assert (stats["completed"] + stats["sheds"] + stats["expired"]
+                    + stats["timeouts"] + stats["errors"]) == 150
+            assert stats["errors"] == 0
+            assert stats["sheds"] > 0, stats
+            assert stats["completed"] > 0, stats
+            # the admitted requests' tail stays bounded by the admit
+            # window x service time, NOT by the storm's queueing
+            assert stats["p99_ms"] < 1500.0, stats
+            # recovery: the moment the storm passes, admission is open
+            res = fe.submit(0, np.zeros(3, np.float32), timeout=10.0)
+            assert res.model == 0
+        finally:
+            fe.close()
+
+    def test_closed_loop_hides_what_open_loop_sees(self):
+        # the satellite's reason to exist: a closed loop against the
+        # same saturated server simply slows down with it (coordinated
+        # omission) and reports ZERO sheds
+        pool = _pool(M=2)
+        rs = _replicas(pool, [0, 1] * 4, n=1, max_queue=8)
+        inj = ReplicaFaultInjector(mode="slow", after_batches=1,
+                                   slow_s=0.02, seed=0)
+        inj.arm(rs.engines[0])
+        fe = ServingFrontend(rs, admission=AdmissionController(max_pending=4))
+        try:
+            gen = TrafficGenerator(fe, clients=range(8), seed=3,
+                                   concurrency=2)
+            stats = gen.run(30, timeout=10.0)
+            assert stats["errors"] == 0      # nobody shed: workers just wait
+            assert stats["requests_per_s"] < 300.0
+        finally:
+            fe.close()
+
+
+# ----------------------------------------------------------------------
+# crash failover end-to-end over real engines
+class TestCrashFailover:
+    def test_admitted_requests_survive_a_replica_crash(self):
+        pool = _pool(M=2)
+        rs = _replicas(pool, [0, 1] * 4, n=2, max_queue=64)
+        ReplicaFaultInjector(mode="crash", after_batches=3, seed=1)\
+            .arm(rs.engines[0])
+        fe = ServingFrontend(rs)
+        failures = []
+        lock = threading.Lock()
+
+        def pump(w):
+            rng = np.random.RandomState(w)
+            for _ in range(40):
+                try:
+                    fe.submit(int(rng.randint(8)),
+                              rng.standard_normal(3).astype(np.float32),
+                              timeout=10.0)
+                except EngineOverloaded:
+                    time.sleep(0.005)
+                except Exception as e:       # noqa: BLE001 — the assert
+                    with lock:
+                        failures.append(repr(e))
+
+        threads = [threading.Thread(target=pump, args=(w,)) for w in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30)
+        try:
+            assert not failures, failures[:5]
+            _wait_for(lambda: rs.drained_names().get("r0")
+                      == "dispatcher_dead", "r0 to drain")
+            assert rs.healthy_names() == ["r1"]
+            assert rs.engines[0].failed is not None
+            hc = fe.healthz()
+            assert hc["status"] == "degraded"
+            assert "replicas_down" in hc["degraded"]
+        finally:
+            fe.close()
+
+
+# ----------------------------------------------------------------------
+# the HTTP plane: taxonomy over the wire, both directions
+class TestHttpPlane:
+    def test_submit_errors_and_healthz_roundtrip(self):
+        pool = _pool(M=2)
+        rs = _replicas(pool, [0, 1] * 4, n=1)
+        fe = ServingFrontend(rs).start(port=0)
+        try:
+            cli = FrontendClient(f"http://{fe.host}:{fe.port}", timeout=10.0)
+            # geometry read off /status so TrafficGenerator can drive it
+            assert cli._example_shape == (3,)
+            assert cli.population == 8
+            res = cli.submit(3, np.zeros(3, np.float32))
+            assert res.model == 1
+            assert np.asarray(res.logits).shape[-1] >= 2
+            with pytest.raises(UnknownClientError):
+                cli.submit(99, np.zeros(3, np.float32))
+            with pytest.raises(MalformedRequestError):
+                cli.submit(0, [1.0, 2.0])    # wrong example shape
+            assert cli.healthz()["status"] == "ok"
+            # drain the bucket -> 503 overloaded + retry hint on the wire
+            bucket = TokenBucket(0.5, burst=1)
+            assert bucket.try_acquire()
+            fe.admission.bucket = bucket
+            with pytest.raises(EngineOverloaded) as ei:
+                cli.submit(0, np.zeros(3, np.float32))
+            assert ei.value.retry_after_s > 0
+            fe.admission.bucket = None
+            # traffic generator drives the socket exactly like an engine
+            gen = TrafficGenerator(cli, clients=range(8), seed=5,
+                                   concurrency=4)
+            stats = gen.run(24, timeout=10.0)
+            assert stats["errors"] == 0
+        finally:
+            fe.close()

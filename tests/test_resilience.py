@@ -220,6 +220,55 @@ class TestReconnectingClient:
             if broker2 is not None:
                 broker2.close()
 
+    def test_crash_replay_preserves_publish_order(self, bus):
+        # the redeliver window replays an acked-then-crashed publish; it
+        # must land BEFORE anything published later (while the broker was
+        # down), or an order-sensitive consumer (serving cluster events)
+        # ends on the stale state. Force the race deterministically: wait
+        # for the ack reap to move "old" into the recent-replay buffer,
+        # THEN kill the broker with "new" still unconfirmed.
+        broker = NetworkBroker()
+        host, port = broker.host, broker.port
+        cli = _reconnecting(host, port)
+        broker2 = None
+        try:
+            q = cli.subscribe("t")
+            cli.publish("t", "old")
+            assert _drain_until(q, 1, 5.0) == ["old"]
+            end = time.monotonic() + 5
+            while cli.pending_count and time.monotonic() < end:
+                time.sleep(0.02)         # ack reaped -> "old" now in _recent
+            assert cli.pending_count == 0
+            broker.close()
+            time.sleep(0.2)
+            cli.publish("t", "new")      # unconfirmed, queued for replay
+            broker2 = NetworkBroker(host=host, port=port)
+            got = []
+            end = time.monotonic() + E2E_DEADLINE
+            while "new" not in got and time.monotonic() < end:
+                try:
+                    got.append(q.get(timeout=0.25))
+                except queue.Empty:
+                    pass
+            # drain the tail: nothing may arrive AFTER the newest publish
+            while True:
+                try:
+                    got.append(q.get(timeout=0.5))
+                except queue.Empty:
+                    break
+            assert "new" in got
+            assert cli.reconnects >= 1
+            # at-least-once allows duplicates of "old", but every one of
+            # them must precede the final "new"
+            assert got.index("new") > max(
+                i for i, p in enumerate(got) if p == "old")
+            assert got[-1] == "new"
+        finally:
+            cli.close()
+            broker.close()
+            if broker2 is not None:
+                broker2.close()
+
     def test_publish_never_raises_on_dead_broker(self, bus):
         broker = NetworkBroker()
         cli = _reconnecting(broker.host, broker.port,

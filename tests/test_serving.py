@@ -18,6 +18,7 @@ The serving read path has four load-bearing invariants, each pinned here:
 
 import json
 import threading
+import time
 from concurrent.futures import ThreadPoolExecutor
 
 import jax
@@ -30,6 +31,7 @@ from feddrift_tpu.core.pool import ModelPool
 from feddrift_tpu.data.registry import make_dataset
 from feddrift_tpu.models import create_model
 from feddrift_tpu.platform.serving import (
+    DeadlineExceededError, EngineOverloaded, EngineStopped,
     InferenceEngine, MalformedRequestError, RoutingTable,
     UnknownClientError)
 
@@ -416,3 +418,111 @@ class TestLatencyExemplar:
             assert eng._lat_p99_exemplar == (0.0, None, None, 0.0)
         finally:
             eng.close()
+
+
+class TestShutdownAndAbandonment:
+    """The two queue-lifecycle bugfixes: stop() must FAIL queued requests
+    (explicitly, so a failover layer can react), and a timed-out caller's
+    request must never reach the forward program."""
+
+    @staticmethod
+    def _stub_dispatcher(eng):
+        # a finished-but-started thread passes the "engine started" check
+        # without ever draining the queue — requests sit exactly where a
+        # wedged dispatcher would leave them
+        t = threading.Thread(target=lambda: None)
+        t.start()
+        t.join()
+        eng._thread = t
+
+    def test_close_fails_queued_requests_with_engine_stopped(self):
+        eng = _engine(_pool(M=2), [0, 1])
+        self._stub_dispatcher(eng)
+        caught = {}
+
+        def call():
+            try:
+                eng.submit(0, np.zeros(3, np.float32), timeout=10.0)
+            except BaseException as e:       # noqa: BLE001 — the assert
+                caught["e"] = e
+
+        th = threading.Thread(target=call)
+        th.start()
+        deadline = time.perf_counter() + 5.0
+        while not eng._queue and time.perf_counter() < deadline:
+            time.sleep(0.005)
+        assert eng._queue, "request never queued"
+        eng.close()
+        th.join(timeout=5)
+        # the caller got the EXPLICIT shutdown error, not its own timeout
+        assert isinstance(caught.get("e"), EngineStopped)
+        # and post-stop submits fast-fail the same way
+        with pytest.raises(EngineStopped):
+            eng.submit(0, np.zeros(3, np.float32))
+
+    def test_timed_out_caller_is_skipped_at_batch_formation(self):
+        eng = _engine(_pool(M=2), [0, 1])
+        # unnamed engines share the process-global registry counters:
+        # assert DELTAS, not absolutes
+        abandoned0 = int(eng._abandoned.value)
+        served0 = int(eng._served.value)
+        self._stub_dispatcher(eng)
+        with pytest.raises(TimeoutError):
+            eng.submit(0, np.zeros(3, np.float32), timeout=0.05)
+        assert len(eng._queue) == 1
+        assert eng._queue[0].abandoned       # marked, still queued
+        # now let a REAL dispatcher at the queue: the abandoned request
+        # must be skipped (counted), never served
+        eng._thread = None
+        eng.start()
+        try:
+            deadline = time.perf_counter() + 10.0
+            while int(eng._abandoned.value) < abandoned0 + 1 \
+                    and time.perf_counter() < deadline:
+                time.sleep(0.01)
+            assert int(eng._abandoned.value) == abandoned0 + 1
+            assert int(eng._served.value) == served0
+            # the engine is healthy for live callers afterwards
+            assert eng.submit(1, np.zeros(3, np.float32)).model == 1
+        finally:
+            eng.close()
+
+    def test_expired_deadline_dropped_at_batch_formation(self):
+        from feddrift_tpu.obs import spans
+        from feddrift_tpu.platform.serving import _Request
+        eng = _engine(_pool(M=2), [0, 1]).start()
+        expired0 = int(eng._expired.value)
+        try:
+            eng.warmup()
+            req = _Request(0, np.zeros(3, np.float32), spans.new_trace(),
+                           rid=10**9, deadline=time.perf_counter() - 1.0)
+            with eng._cond:
+                eng._queue.append(req)
+                eng._cond.notify()
+            assert req.done.wait(10.0)
+            assert isinstance(req.error, DeadlineExceededError)
+            assert req.result is None        # never reached the forward
+            assert int(eng._expired.value) == expired0 + 1
+        finally:
+            eng.close()
+
+    def test_bounded_queue_sheds_with_retry_hint(self):
+        eng = _engine(_pool(M=2), [0, 1], max_queue=2)
+        self._stub_dispatcher(eng)
+        callers = []
+        for _ in range(2):
+            th = threading.Thread(
+                target=lambda: pytest.raises(
+                    EngineStopped,
+                    eng.submit, 0, np.zeros(3, np.float32), 10.0))
+            th.start()
+            callers.append(th)
+        deadline = time.perf_counter() + 5.0
+        while len(eng._queue) < 2 and time.perf_counter() < deadline:
+            time.sleep(0.005)
+        with pytest.raises(EngineOverloaded) as ei:
+            eng.submit(0, np.zeros(3, np.float32))
+        assert ei.value.retry_after_s > 0
+        eng.close()                          # releases the queued callers
+        for th in callers:
+            th.join(timeout=5)
