@@ -571,6 +571,173 @@ def _hierarchy_bench(smoke: bool) -> list:
     return out
 
 
+def _secure_bench(smoke: bool) -> list:
+    """Secure-aggregation axis (ISSUE 18): bytes/round + wall overhead vs
+    plaintext for both masked round modes (shamir, turbo) at two cohort
+    sizes, plus a short real training run per mode proving the secure
+    round mode leaves the train program untouched (the share protocol is
+    host-side; substitution happens after the device round).
+
+    Per (mode, cohort) row: shamir bytes are measured over the real TCP
+    NetworkBroker (share + ack + sum frames of the wire protocol, read
+    off the broker_bytes_out counter delta, same idiom as the hierarchy
+    axis); turbo has no wire path, so its bytes are static accounting —
+    the ring's frame count (C*n contribution shares + (groups-1)*n
+    handoffs + T+1 opens) times one actually-encoded frame of the same
+    dim.  The plaintext baseline is one quantized frame per client over
+    the same transport.  Wall overhead is the in-process engine vs a
+    plain numpy sum on identical payloads.
+
+    The SECAGG artifact the `regress` gate checks: bytes_per_round and
+    engine wall/round within tolerance per point, and steady-state
+    recompiles EXACTLY ZERO on the train rows — secure_agg must never
+    mint a new XLA signature."""
+    import threading
+
+    import numpy as np
+
+    from feddrift_tpu import obs
+    from feddrift_tpu.comm.netbroker import NetworkBroker, NetworkBrokerClient
+    from feddrift_tpu.obs.regress import _compile_counts
+    from feddrift_tpu.platform.secure_agg import P_DEFAULT, quantize
+    from feddrift_tpu.resilience.secure_round import (SecureAggregator,
+                                                      SecureShareHolder,
+                                                      encode_share_frame,
+                                                      run_secure_wire_round)
+
+    dim = 2048 if smoke else 16384
+    rounds = 3 if smoke else 5
+    scale = 2 ** 16
+
+    def plain_tcp_bytes(pay):
+        """One quantized upload frame per client over the real broker."""
+        obs.configure(None)
+        ctr = obs.registry().counter("broker_bytes_out",
+                                     transport="netbroker")
+        before = ctr.value
+        broker = NetworkBroker()
+        try:
+            tx = NetworkBrokerClient(broker.host, broker.port, timeout=10.0)
+            rx = NetworkBrokerClient(broker.host, broker.port, timeout=10.0)
+            q = rx.subscribe("secure-bench/plain")
+            s = rx.subscribe("__sync__")
+            rx.publish("__sync__", "ready")      # sub-then-pub is ordered
+            assert s.get(timeout=10) == "ready"
+            for c in range(pay.shape[0]):
+                tx.publish("secure-bench/plain", encode_share_frame(
+                    quantize(pay[c], scale), sender=c))
+            for _ in range(pay.shape[0]):
+                assert q.get(timeout=10) is not None
+            tx.close(); rx.close()
+        finally:
+            broker.close()
+        return ctr.value - before
+
+    def shamir_tcp_bytes(pay):
+        """The full wire protocol (shares, acks, masked sums) over TCP:
+        C clients x C holders, holders running in threads on their own
+        broker connections."""
+        obs.configure(None)
+        ctr = obs.registry().counter("broker_bytes_out",
+                                     transport="netbroker")
+        before = ctr.value
+        C = pay.shape[0]
+        broker = NetworkBroker()
+        try:
+            clients = [NetworkBrokerClient(broker.host, broker.port,
+                                           timeout=10.0) for _ in range(C)]
+            holders = [SecureShareHolder(cli, h)
+                       for h, cli in enumerate(clients)]
+            for h, cli in enumerate(clients):
+                q = cli.subscribe(f"__sync__/{h}")
+                cli.publish(f"__sync__/{h}", "ready")
+                assert q.get(timeout=10) == "ready"
+            threads = [threading.Thread(target=hold.run,
+                                        kwargs={"timeout": 60.0},
+                                        daemon=True) for hold in holders]
+            for t in threads:
+                t.start()
+            server = NetworkBrokerClient(broker.host, broker.port,
+                                         timeout=10.0)
+            res = run_secure_wire_round(server, pay, threshold=1,
+                                        num_holders=C, deadline=30.0,
+                                        scale=scale)
+            assert not res.degraded, res.reason
+            for t in threads:
+                t.join(timeout=10)
+            server.close()
+            for cli in clients:
+                cli.close()
+        finally:
+            broker.close()
+        return ctr.value - before
+
+    def turbo_frame_bytes(engine, C):
+        """Static accounting: the ring's frame count times one encoded
+        frame (all frames carry the same dim-D field vector)."""
+        cfg = engine._ring.cfg
+        frame = len(encode_share_frame(
+            np.zeros(dim, np.int64), sender=0, holder=0, p=P_DEFAULT))
+        n_frames = (C * cfg.group_size
+                    + (cfg.num_groups - 1) * cfg.group_size
+                    + cfg.privacy_t + 1)
+        return n_frames * frame
+
+    out = []
+    rng = np.random.RandomState(18)
+    for mode in ("shamir", "turbo"):
+        for cohort in (4, 8):
+            pay = rng.randn(cohort, dim).astype(np.float64)
+            eng = SecureAggregator(mode, cohort, threshold=1, scale=scale,
+                                   seed=18)
+            obs.configure(None)
+            t0 = time.time()
+            for r in range(rounds):
+                res = eng.secure_masked_sum(pay, round_idx=r)
+                assert not res.degraded
+            wall_sec = (time.time() - t0) / rounds
+            t0 = time.time()
+            for _ in range(rounds):
+                pay.sum(axis=0)
+            wall_plain = (time.time() - t0) / rounds
+            plain_b = plain_tcp_bytes(pay)
+            if mode == "shamir":
+                sec_b, transport = shamir_tcp_bytes(pay), "tcp"
+            else:
+                sec_b, transport = turbo_frame_bytes(eng, cohort), "frames"
+            out.append({
+                "mode": mode, "point": f"c{cohort}", "cohort": cohort,
+                "dim": dim, "rounds": rounds, "transport": transport,
+                "bytes_per_round": int(sec_b),
+                "plain_bytes_per_round": int(plain_b),
+                "bytes_overhead_vs_plain": round(sec_b / plain_b, 2),
+                "wall_s_secure_per_round": round(wall_sec, 5),
+                "wall_s_plain_per_round": round(wall_plain, 6),
+                "wall_overhead_vs_plain": round(
+                    wall_sec / max(wall_plain, 1e-9), 1),
+                "max_abs_err": res.max_abs_err,
+            })
+            print(json.dumps({"partial": f"secure@{mode}:c{cohort}",
+                              **out[-1]}), file=sys.stderr)
+        # Train row: the real runner with secure_agg on — the gate is
+        # steady_recompiles == 0 (host-side protocol, untouched program).
+        cfg = _canonical_cfg(True, secure_agg=mode, comm_round=5,
+                             sample_num=50, batch_size=50,
+                             cost_model="lowered")
+        r = _measure(cfg, "cpu")
+        _, recompiles = _compile_counts(r)
+        out.append({
+            "mode": mode, "point": "train",
+            "rounds_per_sec": r.get("value"),
+            "wall_s": r.get("wall_s"),
+            "final_test_acc": r.get("final_test_acc"),
+            "steady_recompiles": recompiles,
+        })
+        print(json.dumps({"partial": f"secure@{mode}:train", **out[-1]}),
+              file=sys.stderr)
+    return out
+
+
 def _serve_bench(smoke: bool) -> list:
     """Serving read-path axis (ISSUE 14): requests/s + latency quantiles
     across micro-batch buckets over the canonical SEA-4 pool geometry.
@@ -1475,6 +1642,13 @@ def main() -> None:
         # ceiling, batched >= 3x unbatched, zero steady recompiles)
         "serve": (_serve_bench(smoke)
                   if "--serve" in sys.argv else None),
+        # secure-aggregation axis (opt-in: masked round modes vs
+        # plaintext — wire bytes over the real TCP broker + engine wall
+        # overhead at 2 cohort sizes, and a train run per mode);
+        # committed as SECAGG_r1*.json and gated by `regress`
+        # (bytes/wall tolerance per point, zero steady recompiles)
+        "secure": (_secure_bench(smoke)
+                   if "--secure" in sys.argv else None),
         # model-quality plane axis (opt-in: labeled drifting-traffic
         # serve bench with canaried swaps); committed as QUALITY_r1*.json
         # and gated by `regress` (live-vs-oracle accuracy gap, canary

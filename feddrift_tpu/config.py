@@ -256,6 +256,28 @@ class ExperimentConfig:
     compress_codec: str = "none"       # none | int8 | topk | delta
     compress_topk_frac: float = 0.4    # fraction of coordinates kept by topk
 
+    # --- secure aggregation (resilience/secure_round.py; docs/RESILIENCE.md
+    # "Secure aggregation"). secure_agg != "off" replaces the per-round
+    # server aggregation with a masked secure sum: each cohort client's
+    # quantized weighted delta is degree-T Shamir-shared across the cohort
+    # (shamir) or pushed through the Turbo-Aggregate multi-group ring
+    # (turbo); the server only ever opens the sum. A share-holder past the
+    # round_deadline is masked out (>= T+1 survivors reconstruct), a
+    # below-threshold round keeps prev params with secure_degraded
+    # evidence. Requires the flat per-round path: robust_agg == "mean",
+    # hierarchy_edges == 0, megastep_k == 1, stream_data off.
+    secure_agg: str = "off"            # off | shamir | turbo
+    secure_threshold_t: int = 1        # T: tolerated holder dropouts / collusion
+    secure_scale_bits: int = 16        # fixed-point scale = 2**bits
+    secure_group_size: int = 0         # turbo ring stage width (0 = auto)
+    # Seeded per-share fault injection (platform/faults.py::ShareDropInjector):
+    # drop/delay/corrupt one share, or stall a whole share-holder.
+    secure_drop_prob: float = 0.0
+    secure_delay_prob: float = 0.0
+    secure_corrupt_prob: float = 0.0
+    secure_holder_stall_prob: float = 0.0
+    secure_fault_seed: int = 0
+
     # --- decision observability (obs/alerts.py; docs/OBSERVABILITY.md) --
     # Live rule-based health monitor tapping the event bus: cluster-count
     # churn, oracle-ARI collapse, divergence+Byzantine co-occurrence,
@@ -434,6 +456,39 @@ class ExperimentConfig:
             raise ValueError(f"unknown compress_codec {self.compress_codec!r}")
         if not 0.0 < self.compress_topk_frac <= 1.0:
             raise ValueError("compress_topk_frac must be in (0, 1]")
+        if self.secure_agg not in ("off", "shamir", "turbo"):
+            raise ValueError(f"unknown secure_agg {self.secure_agg!r}")
+        if self.secure_agg != "off":
+            # reconstruction-possibility bound (platform/secure_agg.py:
+            # validate_threshold): N cohort share-holders tolerating T
+            # dropouts need N >= 2T+1
+            if self.secure_threshold_t < 1:
+                raise ValueError("secure_threshold_t must be >= 1")
+            if self.device_clients < 2 * self.secure_threshold_t + 1:
+                raise ValueError(
+                    f"secure_agg needs a cohort of >= 2T+1 = "
+                    f"{2 * self.secure_threshold_t + 1} clients to tolerate "
+                    f"T={self.secure_threshold_t} dropped share-holders; "
+                    f"got {self.device_clients}")
+            if not 1 <= self.secure_scale_bits <= 24:
+                raise ValueError("secure_scale_bits must be in [1, 24]")
+            for p in (self.secure_drop_prob, self.secure_delay_prob,
+                      self.secure_corrupt_prob,
+                      self.secure_holder_stall_prob):
+                if not 0.0 <= p < 1.0:
+                    raise ValueError(
+                        "secure fault probabilities must be in [0, 1)")
+            # the secure path recomputes the flat weighted mean from the
+            # per-client stack each round; fused/hierarchical/robust
+            # variants would silently bypass the protocol
+            if self.robust_agg != "mean":
+                raise ValueError("secure_agg requires robust_agg == 'mean'")
+            if self.hierarchy_edges > 0:
+                raise ValueError("secure_agg requires hierarchy_edges == 0")
+            if self.megastep_k != 1:
+                raise ValueError("secure_agg requires megastep_k == 1")
+            if self.stream_data:
+                raise ValueError("secure_agg requires stream_data off")
         if self.precision not in ("auto", "f32", "bf16_mixed", "bf16_pure"):
             raise ValueError(f"unknown precision {self.precision!r}")
         for name in ("dtype", "compute_dtype"):

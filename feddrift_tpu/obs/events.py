@@ -121,6 +121,14 @@ EVENT_KINDS = frozenset({
     "serve_drift_suspected",  # read-path entropy-distribution shift detected
     "canary_started",       # cluster event intercepted -> shadow canary open
     "canary_verdict",       # canary decided: commit (swap) or rollback
+    # secure aggregation (resilience/secure_round.py,
+    # platform/faults.py::ShareDropInjector)
+    "secure_round_started",  # protocol round opened: mode, cohort, threshold
+    "share_sent",           # one secret share left for a holder (digest, bytes)
+    "share_received",       # a holder acked a share intact
+    "share_dropped",        # share lost/late/corrupt -> contributor/holder masked
+    "secure_reconstructed",  # masked sum decoded from surviving shares
+    "secure_degraded",      # survivors below threshold: prev params kept
 })
 
 RING_SIZE = 4096

@@ -43,6 +43,9 @@ DEFAULT_TOL = {
     "quality_acc": 0.05,     # fail if the streaming live-accuracy estimate
                              # drifts further than this from the offline
                              # oracle on the same labeled stream
+    "secure_wall": 1.0,      # fail if the secure-agg engine wall/round >
+                             # baseline * (1 + tol) — host-side numpy on
+                             # shared CI, so the ceiling is generous
 }
 
 
@@ -513,6 +516,58 @@ def compare(candidate: dict, baseline: dict,
                             f">= {floor:.1f}", cv < floor))
     elif isinstance(bq, dict):
         skip("quality", "candidate lacks the quality axis")
+
+    # secure-aggregation axis (bench.py --secure; SECAGG artifacts): rows
+    # keyed secure[{mode}:{point}]. bytes_per_round under the bytes
+    # ceiling (shamir is a real TCP wire measurement, turbo static frame
+    # accounting — both deterministic for a fixed cohort/dim), engine
+    # wall/round under the secure_wall ceiling (host-side numpy on shared
+    # CI, hence the generous tolerance), and on the train rows an
+    # ABSOLUTE zero gate on steady-state recompiles — the share protocol
+    # runs on the host and must never mint a new XLA signature on the
+    # otherwise-unchanged train program.
+    cs, bs = candidate.get("secure"), baseline.get("secure")
+    if isinstance(cs, list) and isinstance(bs, list):
+        def _mp(e):
+            return (e.get("mode"), e.get("point"))
+
+        by_mp = {_mp(e): e for e in bs if isinstance(e, dict)}
+        for e in cs:
+            if not isinstance(e, dict):
+                continue
+            mode, point = _mp(e)
+            name = f"secure[{mode}:{point}]"
+            be = by_mp.get((mode, point))
+            if be is None:
+                skip(name, "mode/point missing in baseline")
+                continue
+            if point == "train":
+                rec = e.get("steady_recompiles")
+                if rec is not None:
+                    rows.append(row(f"{name}.steady_recompiles",
+                                    be.get("steady_recompiles"), rec,
+                                    "== 0", rec > 0,
+                                    note="secure round mode is host-side"))
+                bv, cv = be.get("rounds_per_sec"), e.get("rounds_per_sec")
+                if bv and cv:
+                    floor = bv * (1.0 - tol["rounds"])
+                    rows.append(row(f"{name}.rounds_per_sec", bv, cv,
+                                    f">= {floor:.1f}", cv < floor))
+                continue
+            bv, cv = be.get("bytes_per_round"), e.get("bytes_per_round")
+            if bv and cv:
+                ceil = bv * (1.0 + tol["bytes"])
+                rows.append(row(f"{name}.bytes_per_round", bv, cv,
+                                f"<= {ceil:.0f}", cv > ceil))
+            bw, cw = (be.get("wall_s_secure_per_round"),
+                      e.get("wall_s_secure_per_round"))
+            if bw and cw:
+                ceil = bw * (1.0 + tol["secure_wall"])
+                rows.append(row(f"{name}.wall_s_secure_per_round", bw, cw,
+                                f"<= {ceil:.4g}", cw > ceil,
+                                note="engine overhead ceiling"))
+    elif isinstance(bs, list):
+        skip("secure", "candidate lacks the secure axis")
     return rows
 
 
@@ -584,6 +639,10 @@ def main(argv: list[str] | None = None) -> int:
                     help="absolute gap tolerated between the streaming "
                          "live-accuracy estimate and the offline oracle "
                          "on the same labeled stream (default %(default)s)")
+    ap.add_argument("--tol-secure-wall", type=float,
+                    default=DEFAULT_TOL["secure_wall"],
+                    help="relative secure-agg engine wall/round growth "
+                         "tolerated (default %(default)s)")
     ap.add_argument("--json", action="store_true", help="machine-readable")
     args = ap.parse_args(argv)
 
@@ -601,7 +660,8 @@ def main(argv: list[str] | None = None) -> int:
                         "host_overhead": args.tol_host_overhead,
                         "p99": args.tol_p99,
                         "precision_acc": args.tol_precision_acc,
-                        "quality_acc": args.tol_quality_acc})
+                        "quality_acc": args.tol_quality_acc,
+                        "secure_wall": args.tol_secure_wall})
     regressed = any(r["status"] == "regress" for r in rows)
     if args.json:
         print(json.dumps({"regressed": regressed, "rows": rows,

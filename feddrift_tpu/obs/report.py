@@ -369,6 +369,42 @@ def summarize(run_dir: str) -> dict[str, Any]:
             hier["corrupt_frames"] = len(corrupt)
         out["hierarchy"] = hier
 
+    # -- secure aggregation (resilience/secure_round.py) ------------------
+    sec_started = [e for e in events if e["kind"] == "secure_round_started"]
+    sec_rec = [e for e in events if e["kind"] == "secure_reconstructed"]
+    sec_deg = [e for e in events if e["kind"] == "secure_degraded"]
+    sec_drop = [e for e in events if e["kind"] == "share_dropped"]
+    if sec_started or sec_rec or sec_deg:
+        modes = sorted({e.get("mode", "?") for e in sec_started})
+        drop_by_reason: dict[str, int] = {}
+        for e in sec_drop:
+            r = e.get("reason", "?")
+            drop_by_reason[r] = drop_by_reason.get(r, 0) + int(
+                e.get("count", 1))
+        sec: dict[str, Any] = {
+            "rounds": len(sec_started),
+            "modes": modes,
+            "reconstructed": len(sec_rec),
+            "degraded": len(sec_deg),
+        }
+        if sec_started:
+            sec["threshold"] = sec_started[-1].get("threshold")
+            sec["holders"] = sec_started[-1].get("holders")
+        if sec_rec:
+            sec["max_abs_err"] = max(e.get("max_abs_err", 0.0)
+                                     for e in sec_rec)
+            sec["min_holders_alive"] = min(e.get("holders_alive", 0)
+                                           for e in sec_rec)
+        if drop_by_reason:
+            sec["shares_dropped"] = drop_by_reason
+        if sec_deg:
+            deg_reasons: dict[str, int] = {}
+            for e in sec_deg:
+                r = e.get("reason", "?")
+                deg_reasons[r] = deg_reasons.get(r, 0) + 1
+            sec["degrade_reasons"] = deg_reasons
+        out["secure_agg"] = sec
+
     # -- cost model (obs/costmodel.py) -----------------------------------
     # XLA's own accounting per compiled program + live HBM watermarks
     prog_costs = [e for e in events if e["kind"] == "program_cost"]
@@ -675,6 +711,29 @@ def render(summary: dict[str, Any]) -> str:
         if hier.get("corrupt_frames"):
             L.append(f"  corrupt frames detected: {hier['corrupt_frames']} "
                      "(nacked, re-sent uncompressed)")
+
+    sec = summary.get("secure_agg")
+    if sec:
+        L.append("")
+        L.append("secure_agg:")
+        L.append(f"  {sec['rounds']} secure rounds "
+                 f"({', '.join(sec['modes'])}): "
+                 f"{sec['reconstructed']} reconstructed, "
+                 f"{sec['degraded']} degraded "
+                 f"(T={sec.get('threshold', '?')}, "
+                 f"holders={sec.get('holders', '?')})")
+        if "max_abs_err" in sec:
+            L.append(f"  quantization err vs plaintext: "
+                     f"max {sec['max_abs_err']:.3g}; min holders alive "
+                     f"{sec['min_holders_alive']}")
+        if sec.get("shares_dropped"):
+            reasons = ", ".join(
+                f"{r}×{n}" for r, n in sorted(sec["shares_dropped"].items()))
+            L.append(f"  shares dropped: {reasons}")
+        if sec.get("degrade_reasons"):
+            reasons = ", ".join(
+                f"{r}×{n}" for r, n in sorted(sec["degrade_reasons"].items()))
+            L.append(f"  degrade reasons: {reasons} (prev params kept)")
 
     al = summary.get("alerts")
     if al:

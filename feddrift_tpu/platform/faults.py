@@ -421,6 +421,82 @@ class EdgeFaultInjector:
         return modes
 
 
+class ShareDropInjector:
+    """Deterministic per-share fault draws for the secure-aggregation
+    protocol (resilience/secure_round.py).
+
+    A secure round moves C*N individual secret shares (contributor c ->
+    share-holder h); each share is its own failure domain, so faults are
+    drawn per (round, contributor, holder) cell: *drop* (the frame never
+    arrives), *delay* (it arrives past the ParticipationPolicy deadline —
+    indistinguishable from a drop to the protocol), *corrupt* (payload
+    bytes flipped in transit; the sha256 digest catches it and the
+    receiver nacks — excluded exactly like a dropout). Holders
+    additionally stall as whole processes (``holder_latencies``) or die
+    permanently (``kill_holder``), the SIGKILL-mid-protocol case chaos
+    stage [14/14] drives.
+
+    Draws are a pure function of ``(seed, round)`` like every injector
+    here; evidence (``share_dropped`` events + counters) is emitted by
+    the protocol at the point each fate is applied, so event context
+    (round, phase) is accurate.
+    """
+
+    PRIME = 10_000_019
+    # fate codes for share_fates cells
+    OK, DROP, DELAY, CORRUPT = 0, 1, 2, 3
+    FATE_NAMES = {0: "ok", 1: "drop", 2: "delay", 3: "corrupt"}
+
+    def __init__(self, num_contributors: int, num_holders: int,
+                 drop_prob: float = 0.0, delay_prob: float = 0.0,
+                 corrupt_prob: float = 0.0, holder_stall_prob: float = 0.0,
+                 deadline: float = 1.0, seed: int = 0) -> None:
+        for p in (drop_prob, delay_prob, corrupt_prob, holder_stall_prob):
+            if not 0.0 <= p < 1.0:
+                raise ValueError(f"share fault prob must be in [0, 1), got {p}")
+        self.C = int(num_contributors)
+        self.N = int(num_holders)
+        self.drop_prob = drop_prob
+        self.delay_prob = delay_prob
+        self.corrupt_prob = corrupt_prob
+        self.holder_stall_prob = holder_stall_prob
+        self.deadline = float(deadline)
+        self.seed = seed
+        self.dead = np.zeros(self.N, dtype=bool)
+
+    def kill_holder(self, holder: int) -> None:
+        """Permanently fail a share-holder (not coming back): every
+        share routed to it is lost and its masked sum never arrives."""
+        self.dead[holder] = True
+
+    def _draws(self, round_idx: int):
+        rng = np.random.RandomState(
+            (self.seed * self.PRIME + round_idx) % (2 ** 31 - 1))
+        return rng.random_sample((3, self.C, self.N)), rng.random_sample(
+            (2, self.N))
+
+    def share_fates(self, round_idx: int) -> np.ndarray:
+        """[C, N] int codes: the fate of contributor c's share to holder
+        h this round (first matching of drop > delay > corrupt wins)."""
+        d, _ = self._draws(round_idx)
+        fates = np.full((self.C, self.N), self.OK, dtype=np.int32)
+        fates[d[2] < self.corrupt_prob] = self.CORRUPT
+        fates[d[1] < self.delay_prob] = self.DELAY
+        fates[d[0] < self.drop_prob] = self.DROP
+        # shares to a dead holder are all lost
+        fates[:, self.dead] = self.DROP
+        return fates
+
+    def holder_latencies(self, round_idx: int) -> np.ndarray:
+        """[N] simulated masked-sum report latencies: stalled or dead
+        holders land past the deadline, healthy ones well inside it."""
+        _, h = self._draws(round_idx)
+        stall = (h[0] < self.holder_stall_prob) | self.dead
+        on_time = 0.2 * self.deadline * (0.5 + h[1])
+        late = self.deadline * (1.5 + h[1])
+        return np.where(stall, late, on_time)
+
+
 class ReplicaFaultInjector:
     """Seeded crash / stall / slow injection for SERVING replicas
     (platform/frontend.py failover chaos).

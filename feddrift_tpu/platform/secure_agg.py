@@ -14,9 +14,29 @@ Field: default prime 2^31 - 1 (Mersenne), int64 accumulation on host.
 
 from __future__ import annotations
 
+import warnings
+
 import numpy as np
 
 P_DEFAULT = np.int64(2**31 - 1)
+
+
+def validate_threshold(N: int, T: int, what: str = "bgw_encode") -> None:
+    """Reject reconstruction-impossible (N, T) configurations up front.
+
+    Degree-T Shamir needs T+1 shares to decode; tolerating T dropped
+    share-holders therefore requires N - T >= T + 1, i.e. N >= 2T + 1.
+    Without this check a bad config only surfaces as a silently wrong
+    Lagrange interpolation deep inside decode.
+    """
+    N, T = int(N), int(T)
+    if T < 0:
+        raise ValueError(f"{what}: privacy threshold T must be >= 0, got T={T}")
+    if N < 2 * T + 1:
+        raise ValueError(
+            f"{what}: N={N} shares cannot tolerate T={T} dropouts and still "
+            f"reconstruct (need N >= 2T+1 = {2 * T + 1}: decode takes T+1 "
+            "shares, so N-T survivors must still hold at least T+1)")
 
 
 # ----------------------------------------------------------------------
@@ -77,6 +97,7 @@ def bgw_encode(X: np.ndarray, N: int, T: int, p: np.int64 = P_DEFAULT,
                rng: np.random.Generator | None = None) -> np.ndarray:
     """[m, d] secret -> [N, m, d] degree-T Shamir shares at alpha=1..N
     (BGW_encoding, mpc_function.py:62-76)."""
+    validate_threshold(N, T, "bgw_encode")
     rng = rng or np.random.default_rng()
     X = np.mod(np.asarray(X, np.int64), p)
     m, d = X.shape
@@ -152,10 +173,30 @@ def gen_additive_ss(d: int, n_out: int, p: np.int64 = P_DEFAULT,
 # ----------------------------------------------------------------------
 # fixed-point bridging (floats <-> field)
 def quantize(x: np.ndarray, scale: int = 2**16,
-             p: np.int64 = P_DEFAULT) -> np.ndarray:
-    """Map floats to field elements, negatives wrapped to [p/2, p)."""
-    q = np.round(np.asarray(x, np.float64) * scale).astype(np.int64)
-    return np.mod(q, p)
+             p: np.int64 = P_DEFAULT, strict: bool = False) -> np.ndarray:
+    """Map floats to field elements, negatives wrapped to [p/2, p).
+
+    The signed representable range is exactly [-(p//2), p//2] scaled
+    units (p odd): values beyond it used to wrap silently around the
+    field and dequantize to garbage of the opposite sign. Out-of-range
+    values now clamp to the boundary with a loud warning; ``strict=True``
+    (the --sanitize path) raises instead.
+    """
+    q = np.round(np.asarray(x, np.float64) * scale)
+    bound = float(int(p) // 2)
+    n_over = int(np.count_nonzero(~np.isfinite(q)) +
+                 np.count_nonzero(np.abs(q[np.isfinite(q)]) > bound))
+    if n_over:
+        msg = (f"quantize: {n_over} value(s) outside the representable "
+               f"range +-{bound / scale:.4g} (scale={scale}, p={int(p)}); "
+               "clamped to the field boundary -- the secure sum is lossy "
+               "for these entries")
+        if strict:
+            raise ValueError(msg)
+        warnings.warn(msg, RuntimeWarning, stacklevel=2)
+        q = np.nan_to_num(q, nan=0.0, posinf=bound, neginf=-bound)
+        q = np.clip(q, -bound, bound)
+    return np.mod(q.astype(np.int64), p)
 
 
 def dequantize(q: np.ndarray, scale: int = 2**16,
@@ -167,13 +208,17 @@ def dequantize(q: np.ndarray, scale: int = 2**16,
 
 def secure_sum(client_vectors: np.ndarray, T: int = 1,
                p: np.int64 = P_DEFAULT,
-               rng: np.random.Generator | None = None) -> np.ndarray:
+               rng: np.random.Generator | None = None,
+               N: int | None = None) -> np.ndarray:
     """End-to-end demo of the TurboAggregate flow for a float sum: quantize,
     BGW-share each client's vector, sum shares (the linear secure op),
-    reconstruct from T+1 shares, dequantize."""
+    reconstruct from T+1 shares, dequantize. ``N`` defaults to the
+    smallest cohort that tolerates T dropouts (2T+1); an explicit N is
+    validated against T."""
     rng = rng or np.random.default_rng(0)
     C, d = client_vectors.shape
-    N = max(2 * T + 1, 3)
+    N = max(2 * T + 1, 3) if N is None else int(N)
+    validate_threshold(N, T, "secure_sum")
     share_sum = np.zeros((N, 1, d), dtype=np.int64)
     for c in range(C):
         shares = bgw_encode(quantize(client_vectors[c])[None, :], N, T, p, rng)

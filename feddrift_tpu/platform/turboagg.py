@@ -49,6 +49,7 @@ from .secure_agg import (
     _matmul_mod,
     quantize,
     dequantize,
+    validate_threshold,
 )
 
 
@@ -74,6 +75,11 @@ class RingConfig:
             raise ValueError(
                 f"group_size={self.group_size} must exceed privacy_t+1="
                 f"{self.privacy_t + 1} to tolerate any dropout")
+        # Same reconstruction bound as secure_agg.validate_threshold: a
+        # group must keep >= T+1 alive positions after T dropouts, so the
+        # stage width must satisfy n >= 2T+1 (for T=1 this coincides with
+        # the bound above).
+        validate_threshold(self.group_size, self.privacy_t, "RingConfig")
         if self.num_clients < 1:
             raise ValueError("need at least one client")
 

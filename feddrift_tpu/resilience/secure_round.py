@@ -1,0 +1,649 @@
+"""Dropout-tolerant secure aggregation as a first-class round mode.
+
+Wires the dormant finite-field primitives (`platform/secure_agg.py`
+Shamir/BGW, `platform/turboagg.py` multi-group ring) into the round path
+as ``cfg.secure_agg = "shamir" | "turbo"``: client updates are
+fixed-point quantized, secret-shared across the cohort's share-holders,
+and reconstructed server-side so the server only ever opens the *sum* —
+with dropout recovery riding the same participation machinery as every
+other failure in this codebase (arXiv:2405.20431 treats exactly this
+overhead + dropout story as the deployability lever for secure agg).
+
+Three layers live here, innermost first:
+
+``SecureAggregator``
+    The in-process protocol engine: one call = one full
+    share -> masked-sum -> reconstruct round over ``[C, D]`` payload
+    vectors, with the fault surface injected by a seeded
+    ``ShareDropInjector`` (platform/faults.py) and share-holder
+    liveness closed by a dedicated ``ParticipationPolicy`` whose quorum
+    is the reconstruction threshold T+1:
+
+    - a holder past the deadline (stalled or SIGKILLed) is masked out;
+      its shares are dead but any T+1 surviving holders' masked sums
+      reconstruct the total (degree-T Shamir);
+    - a contributor is *included* iff every alive holder received its
+      share intact — a partially-delivered contributor would leave the
+      holders with inconsistent masked sums and poison the decode, so
+      it is excluded exactly like a deadline-masked straggler;
+    - a corrupt share is detected by digest and excluded like a drop;
+    - below T+1 alive holders the round degrades explicitly
+      (``secure_degraded`` + ``round_degraded{tier:secure_agg}``, caller
+      keeps prev params) — never a partial sum, never a deadlock.
+
+    The reconstructed sum equals the plaintext masked sum of the
+    included contributors bit-for-bit up to fixed-point quantization
+    (field arithmetic is exact; the only error is the per-element
+    round() at quantize time), and every round reports its measured
+    ``max_abs_err`` against that plaintext reference.
+
+``SecureRoundDriver``
+    The runner-facing adapter: turns one training round's
+    ``(prev_params, client_params [M, C, ...], n [M, C])`` into flat
+    per-client payloads ``[w~ * delta || w~]`` (weights normalized
+    before quantization so no field element can wrap), runs the engine,
+    and rebuilds the weighted-mean params — algebraically identical to
+    the plaintext ``robust_agg="mean"`` path on the same inclusion mask.
+
+wire layer (``SecureShareHolder`` + ``run_secure_wire_round``)
+    The same protocol over the NDJSON broker interface
+    (in-process ``comm/pubsub.Broker`` or TCP ``comm/netbroker``):
+    shares travel as sha256-digested frames (the compress.py frame
+    pattern applied to int64 field vectors), holders ack/nack each
+    share, the server derives the inclusion set from the acks, and a
+    killed holder process is just a silent topic — chaos stage [14/14]
+    SIGKILLs one mid-protocol and corrupts a share in transit.
+
+Event family: ``secure_round_started``, ``share_sent``,
+``share_received``, ``share_dropped``, ``secure_reconstructed``,
+``secure_degraded`` (docs/OBSERVABILITY.md taxonomy).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import queue
+import time
+from dataclasses import dataclass, field as dc_field
+
+import numpy as np
+
+from feddrift_tpu import obs
+from feddrift_tpu.comm.compress import CorruptFrameError, _b64, _unb64
+from feddrift_tpu.platform import secure_agg
+from feddrift_tpu.platform.faults import ShareDropInjector
+from feddrift_tpu.platform.turboagg import RingConfig, TurboAggregateRing
+from feddrift_tpu.resilience.participation import ParticipationPolicy
+
+SECURE_MODES = ("off", "shamir", "turbo")
+
+
+# ----------------------------------------------------------------------
+# share frames: sha256-digested JSON lines carrying int64 field vectors
+# (comm/compress.py's frame pattern; field elements ride as raw little-
+# endian int64 bytes in base64 — NOT through the float codecs, which
+# would destroy the exact field arithmetic).
+_FRAME_KEYS = ("v", "kind", "sender", "holder", "round", "p", "data")
+
+
+def _share_digest(frame: dict) -> str:
+    body = {k: frame[k] for k in _FRAME_KEYS}
+    blob = json.dumps(body, sort_keys=True, separators=(",", ":"))
+    return hashlib.sha256(blob.encode("utf-8")).hexdigest()
+
+
+def encode_share_frame(vec: np.ndarray, *, kind: str = "share",
+                       sender: int = 0, holder: int = 0, round_idx: int = 0,
+                       p: np.int64 = secure_agg.P_DEFAULT) -> str:
+    """One share (or masked-sum) vector -> one digested JSON wire line."""
+    vec = np.ascontiguousarray(np.asarray(vec, np.int64))
+    frame = {"v": 1, "kind": kind, "sender": int(sender),
+             "holder": int(holder), "round": int(round_idx), "p": int(p),
+             "data": _b64(vec.tobytes())}
+    frame["digest"] = _share_digest(frame)
+    return json.dumps(frame, sort_keys=True, separators=(",", ":"))
+
+
+def decode_share_frame(raw: str) -> dict:
+    """Parse + digest-verify a share frame; raises ``CorruptFrameError``
+    on any tampering (flipped payload bytes, truncation, bad JSON)."""
+    try:
+        frame = json.loads(raw)
+    except (ValueError, TypeError) as e:
+        raise CorruptFrameError(f"share frame is not JSON: {e}") from e
+    if not isinstance(frame, dict) or any(k not in frame
+                                          for k in _FRAME_KEYS + ("digest",)):
+        raise CorruptFrameError("share frame missing required keys")
+    if _share_digest(frame) != frame["digest"]:
+        raise CorruptFrameError("share frame digest mismatch")
+    vec = np.frombuffer(_unb64(frame["data"]), dtype=np.int64)
+    p = int(frame["p"])
+    if vec.size and (int(vec.min()) < 0 or int(vec.max()) >= p):
+        raise CorruptFrameError("share frame value outside the field")
+    out = dict(frame)
+    out["vec"] = vec
+    return out
+
+
+# ----------------------------------------------------------------------
+@dataclass
+class SecureRoundResult:
+    """Outcome of one secure round over [C, D] payloads."""
+    degraded: bool
+    reason: str | None          # degrade reason, None when reconstructed
+    total: np.ndarray | None    # dequantized masked sum [D] (None if degraded)
+    included: list[int]         # contributors whose updates entered the sum
+    holders_alive: int          # share-holders that made the deadline
+    max_abs_err: float = 0.0    # |secure - plaintext| on the same inclusion
+    shares_dropped: dict[str, int] = dc_field(default_factory=dict)
+
+
+class SecureAggregator:
+    """In-process share -> masked-sum -> reconstruct engine (one call =
+    one protocol round); see the module docstring for the semantics."""
+
+    def __init__(self, mode: str, num_contributors: int,
+                 num_holders: int | None = None, threshold: int = 1,
+                 scale: int = 2 ** 16,
+                 p: np.int64 = secure_agg.P_DEFAULT, seed: int = 0,
+                 deadline: float = 1.0,
+                 injector: ShareDropInjector | None = None,
+                 group_size: int | None = None,
+                 strict: bool = False) -> None:
+        if mode not in ("shamir", "turbo"):
+            raise ValueError(f"unknown secure_agg mode {mode!r}; "
+                             f"available: {SECURE_MODES}")
+        self.mode = mode
+        self.C = int(num_contributors)
+        self.N = int(num_holders) if num_holders is not None else self.C
+        self.T = int(threshold)
+        secure_agg.validate_threshold(self.N, self.T, "SecureAggregator")
+        self.scale = int(scale)
+        self.p = np.int64(p)
+        self.strict = bool(strict)
+        self.deadline = float(deadline)
+        self.injector = injector
+        self._rng = np.random.default_rng(seed)
+        # Holder liveness closes through the standard participation
+        # machinery with quorum = the reconstruction threshold T+1:
+        # ceil((T+1)/N * N) == T+1, so a below-threshold round is exactly
+        # a quorum-degraded round with tier "secure_agg".
+        self.policy = ParticipationPolicy(
+            deadline=self.deadline, quorum_frac=(self.T + 1) / self.N,
+            cohort_size=self.N)
+        if mode == "turbo":
+            gs = int(group_size) if group_size else min(
+                self.N, max(4, 2 * self.T + 1))
+            self._ring_cfg = RingConfig(
+                num_clients=self.C, group_size=gs, privacy_t=self.T,
+                scale=self.scale, p=self.p)
+            self._ring = TurboAggregateRing(self._ring_cfg, self._rng)
+
+    # -- fault application ---------------------------------------------
+    def _apply_faults(self, round_idx: int):
+        """-> (alive [N] bool, fates [C, N] int, dropped {reason: count},
+        degraded_reason or None). Emits the share-level evidence."""
+        if self.injector is not None:
+            fates = self.injector.share_fates(round_idx)
+            latencies = self.injector.holder_latencies(round_idx)
+        else:
+            fates = np.zeros((self.C, self.N), dtype=np.int32)
+            latencies = None
+        outcome = self.policy.close_round(
+            np.arange(self.N), latencies, round_idx, entity="secure_agg")
+        alive = outcome.on_time
+        dropped: dict[str, int] = {}
+        dead = np.flatnonzero(~alive)
+        if dead.size:
+            # every share routed to a dead/stalled holder is lost
+            n = int(dead.size) * self.C
+            dropped["holder_dropout"] = n
+            obs.emit("share_dropped", reason="holder_dropout",
+                     holders=dead.tolist(), count=n)
+            obs.registry().counter(
+                "secure_shares_dropped", reason="holder_dropout").inc(n)
+        for code, reason in ((ShareDropInjector.DROP, "drop"),
+                             (ShareDropInjector.DELAY, "delay"),
+                             (ShareDropInjector.CORRUPT, "corrupt")):
+            cells = np.argwhere((fates == code) & alive[None, :])
+            if cells.size:
+                n = int(cells.shape[0])
+                dropped[reason] = n
+                obs.emit("share_dropped", reason=reason,
+                         pairs=cells.tolist(), count=n)
+                obs.registry().counter(
+                    "secure_shares_dropped", reason=reason).inc(n)
+        reason = ("holders_below_threshold" if outcome.degraded else None)
+        return alive, fates, dropped, reason
+
+    # -- the protocol ---------------------------------------------------
+    def secure_masked_sum(self, payloads: np.ndarray,
+                          round_idx: int = 0) -> SecureRoundResult:
+        """One full protocol round over float ``payloads [C, D]``;
+        returns the dequantized masked sum of the included contributors
+        (or an explicit degraded result — never a partial sum)."""
+        payloads = np.asarray(payloads, np.float64)
+        C, D = payloads.shape
+        if C != self.C:
+            raise ValueError(f"expected {self.C} contributors, got {C}")
+        obs.emit("secure_round_started", mode=self.mode, contributors=C,
+                 holders=self.N, threshold=self.T, dim=D)
+        obs.registry().counter("secure_rounds", mode=self.mode).inc()
+
+        alive, fates, dropped, degrade = self._apply_faults(round_idx)
+        # share accounting (the in-process engine moves no real bytes;
+        # the wire layer emits per-frame versions of these)
+        obs.emit("share_sent", count=C * self.N, bytes=C * self.N * D * 8)
+        intact = int(((fates == ShareDropInjector.OK)
+                      & alive[None, :]).sum())
+        obs.emit("share_received", count=intact)
+
+        if degrade is not None:
+            return self._degrade(degrade, int(alive.sum()), dropped)
+
+        # inclusion: every alive holder must hold the contributor's
+        # share intact, or the holders' masked sums disagree
+        ok = np.all((fates[:, alive] == ShareDropInjector.OK), axis=1)
+        included = np.flatnonzero(ok).tolist()
+        if not included:
+            return self._degrade("no_intact_contributors",
+                                 int(alive.sum()), dropped)
+
+        if self.mode == "shamir":
+            total = self._shamir_sum(payloads, included, alive)
+        else:
+            total, included, err = self._turbo_sum(payloads, included,
+                                                   alive, fates)
+            if err is not None:
+                return self._degrade(err, int(alive.sum()), dropped)
+
+        plain = payloads[included].sum(axis=0)
+        max_abs_err = float(np.max(np.abs(total - plain))) if D else 0.0
+        obs.emit("secure_reconstructed", mode=self.mode,
+                 included=len(included), holders_alive=int(alive.sum()),
+                 max_abs_err=max_abs_err)
+        return SecureRoundResult(
+            degraded=False, reason=None, total=total, included=included,
+            holders_alive=int(alive.sum()), max_abs_err=max_abs_err,
+            shares_dropped=dropped)
+
+    def _degrade(self, reason: str, holders_alive: int,
+                 dropped: dict[str, int]) -> SecureRoundResult:
+        obs.emit("secure_degraded", mode=self.mode, reason=reason,
+                 holders_alive=holders_alive, threshold=self.T)
+        obs.registry().counter("secure_degraded_rounds").inc()
+        return SecureRoundResult(
+            degraded=True, reason=reason, total=None, included=[],
+            holders_alive=holders_alive, shares_dropped=dropped)
+
+    def _shamir_sum(self, payloads: np.ndarray, included: list[int],
+                    alive: np.ndarray) -> np.ndarray:
+        """Shamir-share each included payload to the N holders, sum the
+        shares per holder (the linear secure op), reconstruct from T+1
+        surviving holders."""
+        D = payloads.shape[1]
+        holder_sums = np.zeros((self.N, D), dtype=np.int64)
+        # one encode per contributor: keeps peak memory at [N, D] instead
+        # of a batched [N, k, D] share tensor for wide model payloads
+        for c in included:
+            q = secure_agg.quantize(payloads[c][None, :], self.scale,
+                                    self.p, strict=self.strict)
+            shares = secure_agg.bgw_encode(q, self.N, self.T, self.p,
+                                           self._rng)        # [N, 1, D]
+            holder_sums = np.mod(holder_sums + shares[:, 0, :], self.p)
+        use = np.flatnonzero(alive)[: self.T + 1]
+        total_q = secure_agg.bgw_decode(holder_sums[use], use, self.p)
+        return secure_agg.dequantize(total_q[0], self.scale, self.p)
+
+    def _turbo_sum(self, payloads: np.ndarray, included: list[int],
+                   alive: np.ndarray, fates: np.ndarray):
+        """Map the fault surface onto the Turbo-Aggregate ring: a
+        contributor with any lost share never enters (``before_send``), a
+        stalled/dead holder position drops ``after_send`` (its relay
+        duties are coded-recovered); an unrecoverable stage degrades."""
+        inc = set(included)
+        dropped_stages: dict[int, str] = {
+            c: "before_send" for c in range(self.C) if c not in inc}
+        for h in np.flatnonzero(~alive):
+            if h < self.C and int(h) not in dropped_stages:
+                dropped_stages[int(h)] = "after_send"
+        try:
+            total, contributors = self._ring.aggregate(
+                payloads, dropped_stages)
+        except RuntimeError as e:
+            return None, included, f"turbo_unrecoverable: {e}"
+        return total, sorted(contributors), None
+
+    # -- weighted-mean convenience (tests / standalone use) -------------
+    def secure_weighted_mean(self, vectors: np.ndarray, weights: np.ndarray,
+                             round_idx: int = 0):
+        """Weighted FedAvg through the protocol: payload = [w~ * v || w~]
+        with weights normalized before quantization (raw sample counts
+        would wrap the field), mean = opened vec-sum / opened w-sum over
+        whatever inclusion set survived. -> (mean or None, result)."""
+        vectors = np.asarray(vectors, np.float64)
+        weights = np.asarray(weights, np.float64)
+        if weights.min() < 0 or weights.sum() <= 0:
+            raise ValueError("weights must be non-negative with positive sum")
+        w = weights / weights.sum()
+        payload = np.concatenate([vectors * w[:, None], w[:, None]], axis=1)
+        res = self.secure_masked_sum(payload, round_idx)
+        if res.degraded:
+            return None, res
+        wsum = float(res.total[-1])
+        if wsum <= 1.0 / self.scale:
+            return None, self._degrade("zero_weight_sum", res.holders_alive,
+                                       res.shares_dropped)
+        return res.total[:-1] / wsum, res
+
+
+# ----------------------------------------------------------------------
+class SecureRoundDriver:
+    """Runner-facing adapter: one training round's client params + sample
+    weights -> securely aggregated pool params (or prev params on a
+    degraded round)."""
+
+    # a model whose opened weight-sum is below this is treated as
+    # untrained this round (quantization noise floor, not a real weight)
+    W_MIN = 1e-3
+
+    def __init__(self, mode: str, num_clients: int, threshold: int = 1,
+                 scale_bits: int = 16, seed: int = 0, deadline: float = 1.0,
+                 drop_prob: float = 0.0, delay_prob: float = 0.0,
+                 corrupt_prob: float = 0.0, holder_stall_prob: float = 0.0,
+                 group_size: int | None = None, strict: bool = False) -> None:
+        self.C = int(num_clients)
+        injector = ShareDropInjector(
+            num_contributors=self.C, num_holders=self.C,
+            drop_prob=drop_prob, delay_prob=delay_prob,
+            corrupt_prob=corrupt_prob, holder_stall_prob=holder_stall_prob,
+            deadline=deadline, seed=seed)
+        self.injector = injector
+        self.engine = SecureAggregator(
+            mode, num_contributors=self.C, num_holders=self.C,
+            threshold=threshold, scale=2 ** int(scale_bits), seed=seed,
+            deadline=deadline, injector=injector, group_size=group_size,
+            strict=strict)
+
+    def aggregate_params(self, prev_params, client_params, n,
+                         round_idx: int):
+        """Recompute the round's aggregation through the secure protocol.
+
+        prev_params: pytree, leaves [M, ...] (host numpy).
+        client_params: same pytree, leaves [M, C, ...].
+        n: [M, C] per-(model, client) sample weights.
+        -> (new_params or None-if-degraded, SecureRoundResult).
+
+        Payload per client c: concat_m [w~[m,c] * (cp[m,c] - prev[m])]
+        ++ [w~[m,c]]_m with w~ normalized per model — so the opened sums
+        give exactly the plaintext weighted mean sum(n*cp)/sum(n) on the
+        included set, and nothing any individual client sent is opened.
+        """
+        import jax  # tree utilities only; no device math on this path
+
+        leaves, treedef = jax.tree_util.tree_flatten(prev_params)
+        cp_leaves = jax.tree_util.tree_flatten(client_params)[0]
+        n = np.asarray(n, np.float64)
+        M, C = n.shape
+        if C != self.C:
+            raise ValueError(f"driver built for {self.C} clients, got {C}")
+        nsum = n.sum(axis=1, keepdims=True)                   # [M, 1]
+        wt = np.where(nsum > 0, n / np.maximum(nsum, 1e-12), 0.0)  # [M, C]
+
+        flats, dims = [], []
+        for pl, cp in zip(leaves, cp_leaves):
+            d = (np.asarray(cp, np.float64)
+                 - np.asarray(pl, np.float64)[:, None])       # [M, C, ...]
+            flats.append(d.reshape(M, C, -1))
+            dims.append(flats[-1].shape[2])
+        deltas = np.concatenate(flats, axis=2)                # [M, C, P]
+        P = deltas.shape[2]
+        payload = (wt[:, :, None] * deltas).transpose(1, 0, 2).reshape(
+            C, M * P)
+        payload = np.concatenate([payload, wt.T], axis=1)     # [C, M*P + M]
+
+        res = self.engine.secure_masked_sum(payload, round_idx)
+        if res.degraded:
+            return None, res
+
+        vec = res.total[: M * P].reshape(M, P)
+        wsum = res.total[M * P:]                              # [M]
+        trained = wsum > self.W_MIN
+        mean = np.where(trained[:, None],
+                        vec / np.maximum(wsum[:, None], self.W_MIN), 0.0)
+
+        new_leaves, off = [], 0
+        for pl, dim in zip(leaves, dims):
+            pl = np.asarray(pl)
+            upd = mean[:, off:off + dim].reshape(pl.shape)
+            new_leaves.append((pl.astype(np.float64) + upd).astype(pl.dtype))
+            off += dim
+        return treedef.unflatten(new_leaves), res
+
+
+# ----------------------------------------------------------------------
+# wire layer: the same protocol as NDJSON frames over a Broker transport
+def _topics(prefix: str):
+    return (f"{prefix}/ctl", f"{prefix}/ack", f"{prefix}/sum")
+
+
+class SecureShareHolder:
+    """One share-holder endpoint over a ``Broker``-interface transport.
+
+    Subscribes ``{prefix}/share/{holder_id}`` and ``{prefix}/ctl`` into a
+    single inbox; acks (or digest-nacks) every share on ``{prefix}/ack``;
+    on the server's ``close`` control message sums exactly the *included*
+    senders' shares in the field and publishes the masked sum on
+    ``{prefix}/sum``. Holds nothing but field elements — a holder (or any
+    T colluding holders) learns nothing about an individual update.
+    """
+
+    def __init__(self, broker, holder_id: int, prefix: str = "secure",
+                 p: np.int64 = secure_agg.P_DEFAULT) -> None:
+        self.broker = broker
+        self.holder_id = int(holder_id)
+        self.prefix = prefix
+        self.p = np.int64(p)
+        self.shares: dict[int, np.ndarray] = {}
+        self._inbox: queue.Queue = broker.subscribe(
+            f"{prefix}/share/{holder_id}")
+        broker.subscribe(f"{prefix}/ctl", sink=self._inbox)
+
+    def _ack(self, sender: int, ok: bool) -> None:
+        self.broker.publish(f"{self.prefix}/ack", json.dumps(
+            {"holder": self.holder_id, "sender": int(sender), "ok": ok}))
+
+    def handle(self, raw: str) -> bool:
+        """Process one inbox line; returns False on the stop command."""
+        try:
+            msg = json.loads(raw)
+        except (ValueError, TypeError):
+            return True
+        if msg.get("cmd") == "stop":
+            return False
+        if msg.get("cmd") == "close":
+            inc = [int(c) for c in msg["included"]]
+            dim = int(msg["dim"])
+            total = np.zeros(dim, dtype=np.int64)
+            for c in inc:
+                if c in self.shares:
+                    total = np.mod(total + self.shares[c], self.p)
+            self.broker.publish(f"{self.prefix}/sum", encode_share_frame(
+                total, kind="sum", sender=self.holder_id,
+                holder=self.holder_id, round_idx=int(msg["round"]),
+                p=self.p))
+            self.shares.clear()
+            return True
+        # otherwise: a share frame (digest-verified)
+        try:
+            frame = decode_share_frame(raw)
+        except CorruptFrameError:
+            # sender id is best-effort on a corrupt frame: the nack must
+            # still name a sender so the server can exclude it
+            try:
+                sender = int(json.loads(raw).get("sender", -1))
+            except (ValueError, TypeError):
+                sender = -1
+            self._ack(sender, ok=False)
+            return True
+        if frame["kind"] != "share" or frame["holder"] != self.holder_id:
+            return True
+        self.shares[int(frame["sender"])] = frame["vec"]
+        obs.emit("share_received", holder=self.holder_id,
+                 sender=int(frame["sender"]), count=1)
+        self._ack(int(frame["sender"]), ok=True)
+        return True
+
+    def run(self, timeout: float = 60.0) -> None:
+        """Blocking serve loop (holder subprocesses in the chaos stage)."""
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            try:
+                raw = self._inbox.get(timeout=0.25)
+            except queue.Empty:
+                continue
+            if not self.handle(raw):
+                return
+
+
+def run_secure_wire_round(broker, payloads: np.ndarray, *, threshold: int,
+                          num_holders: int, prefix: str = "secure",
+                          round_idx: int = 0, deadline: float = 5.0,
+                          scale: int = 2 ** 16,
+                          p: np.int64 = secure_agg.P_DEFAULT,
+                          strict: bool = False,
+                          tamper=None) -> SecureRoundResult:
+    """Drive one server-side secure round over live holder endpoints.
+
+    Publishes every contributor's share frame, derives the inclusion set
+    from the holders' acks (a silent holder past the deadline is dead; a
+    nacked or undelivered share excludes its contributor — every alive
+    holder must hold every included share or their sums disagree), closes
+    the round, and reconstructs from >= T+1 arriving masked sums.
+
+    ``tamper(wire, sender, holder) -> wire`` optionally corrupts a frame
+    in transit (the chaos stage flips payload bytes with it).
+    """
+    payloads = np.asarray(payloads, np.float64)
+    C, D = payloads.shape
+    N, T = int(num_holders), int(threshold)
+    secure_agg.validate_threshold(N, T, "run_secure_wire_round")
+    obs.emit("secure_round_started", mode="shamir", contributors=C,
+             holders=N, threshold=T, dim=D + 1, transport="broker")
+    obs.registry().counter("secure_rounds", mode="shamir").inc()
+
+    ctl_topic, ack_topic, sum_topic = _topics(prefix)
+    ack_q = broker.subscribe(ack_topic)
+    sum_q = broker.subscribe(sum_topic)
+
+    # weighted-sum payload shape: [v || 1] so the opened last element
+    # counts the included contributors (callers divide for the mean)
+    ext = np.concatenate([payloads, np.ones((C, 1))], axis=1)
+    rng = np.random.default_rng(round_idx)
+    bytes_out = 0
+    for c in range(C):
+        q = secure_agg.quantize(ext[c][None, :], scale, p, strict=strict)
+        shares = secure_agg.bgw_encode(q, N, T, p, rng)       # [N, 1, D+1]
+        for h in range(N):
+            wire = encode_share_frame(shares[h, 0], kind="share", sender=c,
+                                      holder=h, round_idx=round_idx, p=p)
+            if tamper is not None:
+                wire = tamper(wire, c, h)
+            bytes_out += len(wire)
+            broker.publish(f"{prefix}/share/{h}", wire)
+            obs.emit("share_sent", sender=c, holder=h, count=1,
+                     bytes=len(wire))
+
+    # ack phase: ok[c, h] until every cell reports or the deadline hits
+    ok = np.zeros((C, N), dtype=bool)
+    seen = np.zeros((C, N), dtype=bool)
+    t_end = time.time() + deadline
+    while not seen.all() and time.time() < t_end:
+        try:
+            msg = json.loads(ack_q.get(timeout=min(
+                0.25, max(0.01, t_end - time.time()))))
+        except queue.Empty:
+            continue
+        c, h = int(msg.get("sender", -1)), int(msg["holder"])
+        if 0 <= h < N:
+            if 0 <= c < C:
+                seen[c, h] = True
+                ok[c, h] = bool(msg["ok"])
+            elif not msg["ok"]:
+                # corrupt frame whose sender field was also mangled:
+                # the holder could not name it, exclude nothing specific
+                pass
+
+    alive = seen.any(axis=0)                     # holders that responded
+    dropped: dict[str, int] = {}
+    dead = np.flatnonzero(~alive)
+    if dead.size:
+        n_lost = int(dead.size) * C
+        dropped["holder_dropout"] = n_lost
+        obs.emit("share_dropped", reason="holder_dropout",
+                 holders=dead.tolist(), count=n_lost)
+        obs.registry().counter("secure_shares_dropped",
+                               reason="holder_dropout").inc(n_lost)
+    for mask, reason in (((seen & ~ok) & alive[None, :], "corrupt"),
+                         ((~seen) & alive[None, :], "lost")):
+        cells = np.argwhere(mask)
+        if cells.size:
+            nb = int(cells.shape[0])
+            dropped[reason] = nb
+            obs.emit("share_dropped", reason=reason, pairs=cells.tolist(),
+                     count=nb)
+            obs.registry().counter("secure_shares_dropped",
+                                   reason=reason).inc(nb)
+
+    def _degrade(reason: str) -> SecureRoundResult:
+        obs.emit("secure_degraded", mode="shamir", reason=reason,
+                 holders_alive=int(alive.sum()), threshold=T)
+        obs.registry().counter("secure_degraded_rounds").inc()
+        broker.publish(ctl_topic, json.dumps({"cmd": "stop"}))
+        return SecureRoundResult(degraded=True, reason=reason, total=None,
+                                 included=[], holders_alive=int(alive.sum()),
+                                 shares_dropped=dropped)
+
+    if int(alive.sum()) < T + 1:
+        return _degrade("holders_below_threshold")
+    included = np.flatnonzero(ok[:, alive].all(axis=1)).tolist()
+    if not included:
+        return _degrade("no_intact_contributors")
+
+    broker.publish(ctl_topic, json.dumps(
+        {"cmd": "close", "round": int(round_idx), "included": included,
+         "dim": D + 1}))
+
+    sums: dict[int, np.ndarray] = {}
+    t_end = time.time() + deadline
+    alive_set = set(np.flatnonzero(alive).tolist())
+    while len(sums) < len(alive_set) and time.time() < t_end:
+        try:
+            raw = sum_q.get(timeout=min(0.25, max(0.01,
+                                                  t_end - time.time())))
+        except queue.Empty:
+            continue
+        try:
+            frame = decode_share_frame(raw)
+        except CorruptFrameError:
+            continue
+        if frame["kind"] == "sum" and int(frame["sender"]) in alive_set:
+            sums[int(frame["sender"])] = frame["vec"]
+    if len(sums) < T + 1:
+        return _degrade("sums_below_threshold")
+
+    use = np.array(sorted(sums)[: T + 1])
+    f_eval = np.stack([sums[h] for h in use.tolist()])
+    total_q = secure_agg.bgw_decode(f_eval, use, p)
+    total = secure_agg.dequantize(total_q[0], scale, p)
+    plain = ext[included].sum(axis=0)
+    max_abs_err = float(np.max(np.abs(total - plain)))
+    obs.emit("secure_reconstructed", mode="shamir", included=len(included),
+             holders_alive=int(alive.sum()), max_abs_err=max_abs_err,
+             bytes=bytes_out)
+    broker.publish(ctl_topic, json.dumps({"cmd": "stop"}))
+    return SecureRoundResult(
+        degraded=False, reason=None, total=total, included=included,
+        holders_alive=int(alive.sum()), max_abs_err=max_abs_err,
+        shares_dropped=dropped)

@@ -331,6 +331,26 @@ class Experiment:
                     seed=cfg.edge_fault_seed)
                 self.edge_participation = ParticipationPolicy(
                     cfg.round_deadline, cfg.edge_quorum_frac, E)
+        # Secure aggregation (resilience/secure_round.py): the cohort's
+        # clients double as share-holders; the per-round path recomputes
+        # the flat weighted mean through the masked protocol and a
+        # degraded round keeps prev params (config validation pins the
+        # flat mean/megastep_k=1 path this substitution is exact for).
+        self.secure_driver = None
+        if cfg.secure_agg != "off":
+            from feddrift_tpu.resilience.secure_round import \
+                SecureRoundDriver
+            self.secure_driver = SecureRoundDriver(
+                cfg.secure_agg, num_clients=self.C_,
+                threshold=cfg.secure_threshold_t,
+                scale_bits=cfg.secure_scale_bits,
+                seed=cfg.secure_fault_seed, deadline=cfg.round_deadline,
+                drop_prob=cfg.secure_drop_prob,
+                delay_prob=cfg.secure_delay_prob,
+                corrupt_prob=cfg.secure_corrupt_prob,
+                holder_stall_prob=cfg.secure_holder_stall_prob,
+                group_size=cfg.secure_group_size or None,
+                strict=cfg.sanitize)
         # robust_agg_applied events only when a defense is actually on —
         # plain "mean" runs keep their historical event stream.
         self._robust_active = (
@@ -763,10 +783,13 @@ class Experiment:
                 raise ValueError("stream_data requires a chunkable algorithm "
                                  "with a non-ensemble test path")
             self._run_iteration_fused(t, opt_states, stream=True)
-        elif (cfg.chunk_rounds and self.algo.chunkable(t)
+        elif (cfg.chunk_rounds and self.secure_driver is None
+                and self.algo.chunkable(t)
                 and self.algo.ensemble_spec(t) is None):
             self._run_iteration_fused(t, opt_states)
         else:
+            # secure_agg always lands here: the protocol needs the
+            # per-round client stack on host, so rounds cannot fuse
             self._run_rounds(t, opt_states)
 
         with self.tracer.phase("cluster"), \
@@ -1040,7 +1063,8 @@ class Experiment:
                                     l.dtype),
                 self.pool.params)
         keep_cp = self.algo.needs_client_params or (
-            byz is not None and byz.has_stale)
+            byz is not None and byz.has_stale) or (
+            self.secure_driver is not None)
         # lint: hot-path-begin (per-round dispatch loop — every host sync
         # here serializes all comm_round dispatches)
         for r in range(cfg.comm_round):
@@ -1106,6 +1130,9 @@ class Experiment:
                     self.global_round += 1
                     continue
                 wb0 = time.perf_counter()
+                if self.secure_driver is not None:
+                    new_params = self._secure_substitute(
+                        prev_params, new_params, client_params, n)
                 self.pool.params = self.algo.after_round(
                     t, r, prev_params, new_params, client_params, n)
                 self._seg_add("writeback", time.perf_counter() - wb0)
@@ -1116,6 +1143,28 @@ class Experiment:
                 self._seg_add("eval", time.perf_counter() - ev0)
             self.global_round += 1
         # lint: hot-path-end
+
+    def _secure_substitute(self, prev_params, new_params, client_params, n):
+        """Replace the round's plaintext device aggregate with the masked
+        secure sum (resilience/secure_round.py): the adopted params come
+        only from what the protocol opened — within fixed-point
+        quantization of the plaintext weighted mean on the inclusion
+        mask — and a degraded round keeps the pre-round params."""
+        # lint: r2-ok (secure protocol runs on host every round by design)
+        host_prev, host_cp, host_n = multihost.fetch(
+            (prev_params, client_params, n))
+        C = self.C_   # slice off phantom padding: holders = real cohort
+        host_cp = jax.tree_util.tree_map(
+            lambda l: np.asarray(l)[:, :C], host_cp)
+        agg, _res = self.secure_driver.aggregate_params(
+            jax.tree_util.tree_map(np.asarray, host_prev), host_cp,
+            np.asarray(host_n)[:, :C], self.global_round)
+        if agg is None:
+            return prev_params
+        return jax.tree_util.tree_map(
+            lambda ref, v: jax.device_put(
+                jnp.asarray(v, ref.dtype), ref.sharding),
+            new_params, agg)
 
     def _stream_view(self, t: int):
         """Device view [C_pad, 2, N, ...] of steps (t, t+1), prefetched one

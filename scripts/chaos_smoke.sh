@@ -60,6 +60,13 @@
 #    canary_rollback alert lands in alerts.jsonl, and the closed-loop
 #    traffic flowing throughout sees ZERO errors — the corrupt swap is
 #    traffic-invisible.
+# 14) secure aggregation domain — a 3-holder secure round runs over the
+#    real TCP broker (sha256-digested share frames); one share-holder
+#    process is SIGKILLed mid-protocol and one share is corrupted in
+#    transit: the round still completes (share_dropped +
+#    secure_reconstructed in events.jsonl), the opened sum matches the
+#    plaintext reference of the included contributors within fixed-point
+#    quantization tolerance, and a degraded round can never hang.
 #
 # Usage: scripts/chaos_smoke.sh            (~2-3 min on one CPU core)
 set -euo pipefail
@@ -70,12 +77,12 @@ OUT=$(mktemp -d)
 trap 'rm -rf "$OUT"' EXIT
 RUN="$OUT/run"
 
-echo "== [1/13] chaos transport e2e (drop_prob=0.2 + broker kill/restart) =="
+echo "== [1/14] chaos transport e2e (drop_prob=0.2 + broker kill/restart) =="
 timeout -k 10 300 python -m pytest tests/test_resilience.py -q \
     -p no:cacheprovider -p no:randomly \
     -k "ChaosEndToEnd or survives_broker_kill or heartbeat_missed"
 
-echo "== [2/13] preemption: SIGTERM a real run, then --auto_resume =="
+echo "== [2/14] preemption: SIGTERM a real run, then --auto_resume =="
 ARGS=(--dataset sine --model fnn --concept_drift_algo win-1
       --concept_num 2 --client_num_in_total 4 --client_num_per_round 4
       --train_iterations 6 --comm_round 8 --epochs 2
@@ -112,15 +119,15 @@ print(f"resume OK: {len(rows)} metric rows, final Test/Acc="
       f"{rows[-1]['Test/Acc']:.4f}")
 EOF
 
-echo "== [3/13] event taxonomy consistency (strict: no dead kinds) =="
+echo "== [3/14] event taxonomy consistency (strict: no dead kinds) =="
 python scripts/check_events_schema.py --strict
 
-echo "== [4/13] byzantine smoke: trimmed_mean defends where mean fails =="
+echo "== [4/14] byzantine smoke: trimmed_mean defends where mean fails =="
 timeout -k 10 300 python -m pytest tests/test_robust_agg.py -q \
     -p no:cacheprovider -p no:randomly \
     -k "trimmed_mean_defends_where_mean_fails"
 
-echo "== [5/13] decision observability: kill clients -> alerts + lineage =="
+echo "== [5/14] decision observability: kill clients -> alerts + lineage =="
 LRUN="$OUT/lineage-run"
 timeout -k 10 300 python - "$LRUN" <<'EOF'
 import sys
@@ -154,7 +161,7 @@ python -m feddrift_tpu report "$LRUN" > "$OUT/report.txt"
 grep -q "alerts:" "$OUT/report.txt" \
     || { echo "report missing alerts section"; exit 1; }
 
-echo "== [6/13] participation: 10^3 population, 20% stragglers + churn =="
+echo "== [6/14] participation: 10^3 population, 20% stragglers + churn =="
 PRUN="$OUT/population-run"
 timeout -k 10 300 python -m feddrift_tpu run \
     --dataset sea --model fnn --concept_drift_algo softcluster \
@@ -173,7 +180,7 @@ python -m feddrift_tpu report "$PRUN" > "$OUT/preport.txt"
 grep -q "participation:" "$OUT/preport.txt" \
     || { echo "report missing participation section"; exit 1; }
 
-echo "== [7/13] fused participation: megastep_k=4 kill -> resume, same cohorts =="
+echo "== [7/14] fused participation: megastep_k=4 kill -> resume, same cohorts =="
 FREF="$OUT/fused-ref"
 FRUN="$OUT/fused-run"
 FARGS=(--dataset sea --model fnn --concept_drift_algo oblivious
@@ -231,7 +238,7 @@ print(f"fused resume OK: {len(c_ref)} iterations, identical cohort "
       f"schedule, {len(rows)} metric rows")
 EOF
 
-echo "== [8/13] hierarchy: 10^3 population, kill edge 0 mid-run =="
+echo "== [8/14] hierarchy: 10^3 population, kill edge 0 mid-run =="
 HRUN="$OUT/hierarchy-run"
 timeout -k 10 300 python -m feddrift_tpu run \
     --dataset sea --model fnn --concept_drift_algo softcluster \
@@ -269,12 +276,12 @@ grep -q "hierarchy:" "$OUT/hreport.txt" \
 grep -q "re-homed:" "$OUT/hreport.txt" \
     || { echo "report missing re-homed line"; exit 1; }
 
-echo "== [9/13] causal trace continuity across broker reconnect =="
+echo "== [9/14] causal trace continuity across broker reconnect =="
 timeout -k 10 300 python -m pytest tests/test_causal_trace.py -q \
     -p no:cacheprovider -p no:randomly \
     -k "trace_survives_broker_reconnect"
 
-echo "== [10/13] live ops plane: broker kill -> /healthz 503 + slo_burn -> recovery =="
+echo "== [10/14] live ops plane: broker kill -> /healthz 503 + slo_burn -> recovery =="
 ORUN="$OUT/ops-run"
 mkdir -p "$ORUN"
 timeout -k 10 300 python - "$ORUN" <<'EOF'
@@ -342,7 +349,7 @@ print(f"  recovery OK: /healthz {code} {doc['status']}, "
 client.close(); srv.close(); broker2.close()
 EOF
 
-echo "== [11/13] serving: broker kill mid-traffic -> degrade, swaps resume =="
+echo "== [11/14] serving: broker kill mid-traffic -> degrade, swaps resume =="
 SRUN="$OUT/serve-run"
 mkdir -p "$SRUN"
 timeout -k 10 300 python - "$SRUN" <<'EOF'
@@ -466,7 +473,7 @@ print(f"  recovery OK: {stats['served']} served total, 0 errors, "
       f"pool version {stats['version']}")
 EOF
 
-echo "== [12/13] canary: corrupt candidate mid-swap -> rollback + crit alert, 0 errors =="
+echo "== [12/14] canary: corrupt candidate mid-swap -> rollback + crit alert, 0 errors =="
 CRUN="$OUT/canary-run"
 mkdir -p "$CRUN"
 timeout -k 10 300 python - "$CRUN" <<'EOF'
@@ -552,7 +559,7 @@ print(f"  rollback OK: shadow_acc={v['shadow_acc']} vs "
       f"{served[0]} requests served, 0 errors")
 EOF
 
-echo "== [13/13] frontend: kill 1 of 2 replicas mid-traffic -> 0 admitted failures, survivor lane lives =="
+echo "== [13/14] frontend: kill 1 of 2 replicas mid-traffic -> 0 admitted failures, survivor lane lives =="
 FRUN="$OUT/frontend-run"
 mkdir -p "$FRUN"
 timeout -k 10 300 python - "$FRUN" <<'EOF'
@@ -669,6 +676,96 @@ for k in ("chaos_injected", "replica_failed", "replica_drained"):
     assert k in kinds, f"missing {k} in {sorted(kinds)}"
 print(f"  failover OK: {served[0]} served ({sheds[0]} explicit sheds), "
       f"0 admitted failures, retries={st['retries']}, survivor r1")
+EOF
+
+echo "== [14/14] secure agg: SIGKILL a share-holder mid-protocol + corrupt one share =="
+SECRUN="$OUT/secure-run"
+mkdir -p "$SECRUN"
+timeout -k 10 300 python - "$SECRUN" <<'EOF'
+import json, os, signal, subprocess, sys, time
+import numpy as np
+from feddrift_tpu import obs
+from feddrift_tpu.comm.netbroker import NetworkBroker, NetworkBrokerClient
+
+out = sys.argv[1]
+obs.configure(os.path.join(out, "events.jsonl"))
+broker = NetworkBroker()
+
+# 3 share-holder PROCESSES over the TCP broker: each subscribes its
+# share topic + ctl, then signals readiness on its loopback sync topic
+# (the publish is ordered after the subscribes on the same connection,
+# so "ready" proves the broker registered the share subscriptions).
+holder_src = r'''
+import sys
+from feddrift_tpu.comm.netbroker import NetworkBrokerClient
+from feddrift_tpu.resilience.secure_round import SecureShareHolder
+host, port, hid = sys.argv[1], int(sys.argv[2]), int(sys.argv[3])
+cli = NetworkBrokerClient(host, port, timeout=5.0)
+holder = SecureShareHolder(cli, hid)
+cli.publish("__sync__/%d" % hid, "ready")
+holder.run(timeout=90)
+'''
+server = NetworkBrokerClient(broker.host, broker.port, timeout=5.0)
+sync_qs = [server.subscribe("__sync__/%d" % h) for h in range(3)]
+procs = [subprocess.Popen(
+    [sys.executable, "-c", holder_src, broker.host, str(broker.port),
+     str(h)], env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    for h in range(3)]
+for h, q in enumerate(sync_qs):
+    assert q.get(timeout=30) == "ready", f"holder {h} never came up"
+
+# the chaos: corrupt the share (sender 1 -> holder 1) in transit, and
+# SIGKILL holder 0 mid-protocol — after it has acked earlier shares,
+# before the masked sums are collected
+killed = []
+def tamper(wire, sender, holder):
+    if (sender, holder) == (1, 1):
+        d = json.loads(wire)
+        d["data"] = ("B" if d["data"][0] != "B" else "C") + d["data"][1:]
+        return json.dumps(d)
+    if (sender, holder) == (3, 0) and not killed:
+        time.sleep(0.5)              # let holder 0 ack what it received
+        procs[0].kill()              # SIGKILL: a silent topic from here on
+        procs[0].wait()
+        killed.append(0)
+    return wire
+
+from feddrift_tpu.resilience.secure_round import run_secure_wire_round
+rng = np.random.default_rng(18)
+pay = rng.normal(size=(4, 64))
+res = run_secure_wire_round(server, pay, threshold=1, num_holders=3,
+                            round_idx=0, deadline=6.0, tamper=tamper)
+
+assert killed == [0], "holder kill never fired"
+assert not res.degraded, f"round degraded: {res.reason}"
+assert res.holders_alive >= 2, res.holders_alive
+assert 1 not in res.included, "corrupted share's contributor not excluded"
+# the opened sum matches the plaintext reference of the included
+# contributors within fixed-point quantization tolerance, and is finite
+plain = pay[res.included].sum(axis=0)
+tol = max(1, len(res.included)) * 0.5 / 2 ** 16 + 1e-9
+assert np.isfinite(res.total).all()
+assert np.abs(res.total[:-1] - plain).max() <= tol, \
+    (np.abs(res.total[:-1] - plain).max(), tol)
+assert abs(res.total[-1] - len(res.included)) < 1e-3
+
+for p in procs[1:]:
+    p.terminate()
+    p.wait()
+server.close()
+broker.close()
+kinds = {json.loads(l)["kind"]
+         for l in open(os.path.join(out, "events.jsonl"))}
+for k in ("secure_round_started", "share_sent", "share_dropped",
+          "secure_reconstructed"):
+    assert k in kinds, f"missing {k} in {sorted(kinds)}"
+reasons = {json.loads(l).get("reason")
+           for l in open(os.path.join(out, "events.jsonl"))
+           if json.loads(l)["kind"] == "share_dropped"}
+assert "corrupt" in reasons, reasons
+print(f"  secure round OK: included={res.included} "
+      f"holders_alive={res.holders_alive} max_err={res.max_abs_err:.2e} "
+      f"dropped={res.shares_dropped}")
 EOF
 
 echo "chaos_smoke: ALL OK"

@@ -1,0 +1,476 @@
+"""Secure aggregation as a round mode (ISSUE 18): field-primitive
+property tests (BGW/LCC encode -> drop-k -> decode), quantize boundary
+semantics, threshold validation, the dropout-tolerant protocol engine
+(parity with plaintext masked sums under injected share faults, explicit
+degrade below threshold), the digested wire layer, and the runner
+round-path integration."""
+
+from __future__ import annotations
+
+import json
+import threading
+import warnings
+
+import numpy as np
+import pytest
+
+from feddrift_tpu import obs
+from feddrift_tpu.comm.compress import CorruptFrameError
+from feddrift_tpu.comm.pubsub import Broker
+from feddrift_tpu.config import ExperimentConfig
+from feddrift_tpu.platform import secure_agg
+from feddrift_tpu.platform.faults import ShareDropInjector
+from feddrift_tpu.platform.turboagg import RingConfig
+from feddrift_tpu.resilience.secure_round import (
+    SecureAggregator,
+    SecureRoundDriver,
+    SecureShareHolder,
+    decode_share_frame,
+    encode_share_frame,
+    run_secure_wire_round,
+)
+
+
+@pytest.fixture(autouse=True)
+def _fresh_bus():
+    obs.configure(None)
+    yield
+    obs.configure(None)
+
+
+def _events(kind):
+    return obs.get_bus().events(kind)
+
+
+# ----------------------------------------------------------------------
+class TestQuantize:
+    """Satellite: quantize must clamp (or raise) instead of silently
+    wrapping past the field bound."""
+
+    def test_round_trip_boundary_and_negatives(self):
+        scale, p = 2 ** 16, secure_agg.P_DEFAULT
+        bound = (int(p) // 2) / scale
+        x = np.array([0.0, 1.5, -1.5, bound, -bound, bound / 2, -1e-4])
+        rt = secure_agg.dequantize(secure_agg.quantize(x, scale, p),
+                                   scale, p)
+        np.testing.assert_allclose(rt, x, atol=0.5 / scale)
+
+    def test_overflow_clamps_with_warning(self):
+        scale, p = 2 ** 16, secure_agg.P_DEFAULT
+        bound = (int(p) // 2) / scale
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            q = secure_agg.quantize(np.array([bound * 10, -bound * 10]))
+            assert any("clamp" in str(x.message) for x in w)
+        rt = secure_agg.dequantize(q, scale, p)
+        # clamped to the boundary, NOT wrapped to the opposite sign
+        np.testing.assert_allclose(rt, [bound, -bound], atol=1.0 / scale)
+
+    def test_overflow_raises_under_strict(self):
+        with pytest.raises(ValueError, match="representable range"):
+            secure_agg.quantize(np.array([1e12]), strict=True)
+        with pytest.raises(ValueError, match="representable range"):
+            secure_agg.quantize(np.array([np.nan]), strict=True)
+
+    def test_in_range_never_warns(self):
+        with warnings.catch_warnings():
+            warnings.simplefilter("error")
+            secure_agg.quantize(np.linspace(-100, 100, 64), strict=True)
+
+
+class TestThresholdValidation:
+    """Satellite: T vs N validated up front with a clear error."""
+
+    def test_bgw_encode_rejects_impossible(self):
+        X = np.zeros((1, 4))
+        with pytest.raises(ValueError, match="N >= 2T\\+1"):
+            secure_agg.bgw_encode(X, N=4, T=2)
+        with pytest.raises(ValueError, match="must be >= 0"):
+            secure_agg.bgw_encode(X, N=4, T=-1)
+
+    def test_bgw_encode_largest_valid_t(self):
+        # N=7 -> largest tolerable T is 3 (2*3+1 = 7)
+        X = np.arange(8, dtype=np.float64).reshape(1, 8)
+        q = secure_agg.quantize(X)
+        shares = secure_agg.bgw_encode(q, N=7, T=3,
+                                       rng=np.random.default_rng(0))
+        idx = np.arange(4)
+        dec = secure_agg.bgw_decode(shares[idx, 0, :], idx)
+        np.testing.assert_array_equal(dec[0], q[0])
+        with pytest.raises(ValueError):
+            secure_agg.bgw_encode(q, N=7, T=4)
+
+    def test_secure_sum_explicit_n_validated(self):
+        v = np.ones((3, 4))
+        with pytest.raises(ValueError, match="secure_sum"):
+            secure_agg.secure_sum(v, T=2, N=4)
+        out = secure_agg.secure_sum(v, T=2, N=5)
+        np.testing.assert_allclose(out, 3.0, atol=1e-3)
+
+    def test_ring_config_rejects_thin_groups(self):
+        with pytest.raises(ValueError, match="N >= 2T\\+1"):
+            RingConfig(num_clients=12, group_size=4, privacy_t=2)
+        RingConfig(num_clients=12, group_size=5, privacy_t=2)  # 5 >= 2*2+1
+
+    def test_aggregator_rejects_impossible(self):
+        with pytest.raises(ValueError, match="SecureAggregator"):
+            SecureAggregator("shamir", num_contributors=4, threshold=2)
+
+
+# ----------------------------------------------------------------------
+class TestFieldProperties:
+    """Satellite: seeded encode -> drop-k -> decode round-trips for the
+    primitives test_turboagg.py only smoke-tests."""
+
+    @pytest.mark.parametrize("seed", range(5))
+    def test_bgw_random_dropouts_up_to_threshold(self, seed):
+        rng = np.random.default_rng(seed)
+        N, T, d = 7, 3, 12
+        X = rng.normal(size=(2, d)) * 10
+        q = secure_agg.quantize(X)
+        shares = secure_agg.bgw_encode(q, N, T, rng=rng)
+        for k in range(T + 1):               # drop 0..T shares
+            dead = rng.choice(N, size=k, replace=False)
+            alive = np.setdiff1d(np.arange(N), dead)
+            use = rng.permutation(alive)[: T + 1]
+            dec = secure_agg.bgw_decode(shares[use, 0, :], use)
+            np.testing.assert_array_equal(dec[0], q[0])
+            rt = secure_agg.dequantize(dec[0])
+            np.testing.assert_allclose(rt, X[0], atol=0.5 / 2 ** 16)
+
+    @pytest.mark.parametrize("seed", range(5))
+    def test_lcc_random_dropouts_up_to_threshold(self, seed):
+        rng = np.random.default_rng(100 + seed)
+        N, K, T, d = 8, 2, 2, 6
+        X = secure_agg.quantize(rng.normal(size=(4, d)))
+        enc = secure_agg.lcc_encode(X, N, K, T, rng=rng)
+        max_drop = N - (K + T)               # decode needs K+T shares
+        for k in range(max_drop + 1):
+            dead = rng.choice(N, size=k, replace=False)
+            alive = np.setdiff1d(np.arange(N), dead)
+            use = np.sort(rng.permutation(alive)[: K + T])
+            dec = secure_agg.lcc_decode(enc[use], use, K, T, N)
+            np.testing.assert_array_equal(
+                dec.reshape(4, d), X)
+
+    def test_bgw_linearity_share_sums_decode_to_sum(self):
+        # the property the whole protocol rests on: sum of shares
+        # decodes to the sum of secrets, exactly, in the field
+        rng = np.random.default_rng(7)
+        N, T, d, C = 5, 2, 9, 4
+        qs = [secure_agg.quantize(rng.normal(size=(1, d))) for _ in range(C)]
+        acc = np.zeros((N, 1, d), dtype=np.int64)
+        for q in qs:
+            acc = np.mod(acc + secure_agg.bgw_encode(q, N, T, rng=rng),
+                         secure_agg.P_DEFAULT)
+        use = np.array([4, 1, 2])            # any T+1 shares
+        dec = secure_agg.bgw_decode(acc[use, 0, :], use)
+        expect = np.mod(sum(q[0] for q in qs), secure_agg.P_DEFAULT)
+        np.testing.assert_array_equal(dec[0], expect)
+
+
+# ----------------------------------------------------------------------
+class TestShareDropInjector:
+    def test_deterministic_and_round_varying(self):
+        a = ShareDropInjector(4, 5, drop_prob=0.2, corrupt_prob=0.1, seed=3)
+        b = ShareDropInjector(4, 5, drop_prob=0.2, corrupt_prob=0.1, seed=3)
+        np.testing.assert_array_equal(a.share_fates(7), b.share_fates(7))
+        np.testing.assert_array_equal(a.holder_latencies(7),
+                                      b.holder_latencies(7))
+        assert not np.array_equal(a.share_fates(7), a.share_fates(8))
+
+    def test_killed_holder_loses_everything(self):
+        inj = ShareDropInjector(3, 4, deadline=1.0, seed=0)
+        inj.kill_holder(2)
+        assert (inj.share_fates(0)[:, 2] == ShareDropInjector.DROP).all()
+        assert (inj.holder_latencies(0)[2] > 1.0)
+        assert (inj.holder_latencies(5)[2] > 1.0)     # stays dead
+
+    def test_prob_validation(self):
+        with pytest.raises(ValueError):
+            ShareDropInjector(2, 3, drop_prob=1.5)
+
+
+# ----------------------------------------------------------------------
+class TestSecureAggregatorEngine:
+    def _payloads(self, C=6, D=40, seed=0):
+        return np.random.default_rng(seed).normal(size=(C, D))
+
+    @pytest.mark.parametrize("mode", ["shamir", "turbo"])
+    def test_faultfree_parity(self, mode):
+        pay = self._payloads(8)
+        eng = SecureAggregator(mode, num_contributors=8, threshold=1, seed=1)
+        res = eng.secure_masked_sum(pay, 0)
+        assert not res.degraded and res.included == list(range(8))
+        tol = 8 * 0.5 / 2 ** 16 + 1e-9
+        np.testing.assert_allclose(res.total, pay.sum(axis=0), atol=tol)
+        assert res.max_abs_err <= tol
+        assert len(_events("secure_round_started")) == 1
+        assert len(_events("secure_reconstructed")) == 1
+
+    @pytest.mark.parametrize("round_idx", range(6))
+    def test_shamir_parity_under_injected_faults(self, round_idx):
+        """Per-round parity vs the plaintext masked sum on the IDENTICAL
+        inclusion mask, driven by the seeded fault injector."""
+        C = 7
+        pay = self._payloads(C, seed=round_idx)
+        inj = ShareDropInjector(C, C, drop_prob=0.08, delay_prob=0.05,
+                                corrupt_prob=0.05, holder_stall_prob=0.15,
+                                seed=11)
+        eng = SecureAggregator("shamir", C, threshold=2, seed=2,
+                               injector=inj)
+        res = eng.secure_masked_sum(pay, round_idx)
+        if res.degraded:
+            assert res.total is None and res.included == []
+            assert _events("secure_degraded")
+            return
+        # recompute the expected inclusion set from the same pure draws
+        fates = inj.share_fates(round_idx)
+        alive = inj.holder_latencies(round_idx) <= 1.0
+        expect_inc = [c for c in range(C)
+                      if (fates[c, alive] == ShareDropInjector.OK).all()]
+        assert res.included == expect_inc
+        plain = pay[expect_inc].sum(axis=0)
+        np.testing.assert_allclose(
+            res.total, plain, atol=len(expect_inc) * 0.5 / 2 ** 16 + 1e-9)
+
+    def test_degrades_below_threshold_keeps_no_partial_sum(self):
+        C, T = 5, 1
+        inj = ShareDropInjector(C, C, seed=0)
+        for h in range(C - 1):               # leave 1 alive < T+1 = 2
+            inj.kill_holder(h)
+        eng = SecureAggregator("shamir", C, threshold=T, seed=0,
+                               injector=inj)
+        res = eng.secure_masked_sum(self._payloads(C), 0)
+        assert res.degraded and res.reason == "holders_below_threshold"
+        assert res.total is None
+        ev = _events("secure_degraded")
+        assert ev and ev[-1]["reason"] == "holders_below_threshold"
+        # the participation plane saw it too, at the secure_agg tier
+        deg = _events("round_degraded")
+        assert deg and deg[-1]["tier"] == "secure_agg"
+
+    def test_survives_exactly_t_dropped_holders(self):
+        C, T = 5, 2
+        inj = ShareDropInjector(C, C, seed=0)
+        inj.kill_holder(0)
+        inj.kill_holder(3)                   # T dead, N-T = 3 = T+1 alive
+        eng = SecureAggregator("shamir", C, threshold=T, seed=0,
+                               injector=inj)
+        pay = self._payloads(C)
+        res = eng.secure_masked_sum(pay, 0)
+        assert not res.degraded
+        assert res.holders_alive == C - T
+        np.testing.assert_allclose(res.total, pay.sum(axis=0),
+                                   atol=C * 0.5 / 2 ** 16 + 1e-9)
+        drops = _events("share_dropped")
+        assert any(e["reason"] == "holder_dropout" for e in drops)
+
+    def test_turbo_excluded_contributor(self):
+        C = 8
+        inj = ShareDropInjector(C, C, drop_prob=0.06, seed=5)
+        eng = SecureAggregator("turbo", C, threshold=1, seed=1,
+                               injector=inj)
+        pay = self._payloads(C, seed=2)
+        res = eng.secure_masked_sum(pay, 1)
+        assert not res.degraded
+        plain = pay[res.included].sum(axis=0)
+        np.testing.assert_allclose(
+            res.total, plain, atol=len(res.included) * 0.5 / 2 ** 16 + 1e-9)
+
+    def test_weighted_mean_matches_plaintext(self):
+        C = 6
+        pay = self._payloads(C, D=20)
+        w = np.abs(np.random.default_rng(3).normal(size=C)) * 50 + 1
+        eng = SecureAggregator("shamir", C, threshold=1, seed=4)
+        mean, res = eng.secure_weighted_mean(pay, w, 0)
+        assert not res.degraded
+        ref = (pay * w[:, None]).sum(axis=0) / w.sum()
+        np.testing.assert_allclose(mean, ref, atol=1e-3)
+
+    def test_unknown_mode_rejected(self):
+        with pytest.raises(ValueError, match="unknown secure_agg mode"):
+            SecureAggregator("rot13", num_contributors=5)
+
+
+# ----------------------------------------------------------------------
+class TestWireLayer:
+    def test_frame_round_trip_and_digest(self):
+        vec = np.arange(17, dtype=np.int64) * 12345
+        wire = encode_share_frame(vec, sender=3, holder=1, round_idx=9)
+        f = decode_share_frame(wire)
+        assert f["sender"] == 3 and f["holder"] == 1 and f["round"] == 9
+        np.testing.assert_array_equal(f["vec"], vec)
+
+    def test_tampered_frame_detected(self):
+        wire = encode_share_frame(np.arange(8), sender=0, holder=0)
+        d = json.loads(wire)
+        d["data"] = ("A" if d["data"][0] != "A" else "B") + d["data"][1:]
+        with pytest.raises(CorruptFrameError, match="digest"):
+            decode_share_frame(json.dumps(d))
+        with pytest.raises(CorruptFrameError):
+            decode_share_frame("not json at all")
+        with pytest.raises(CorruptFrameError, match="missing"):
+            decode_share_frame(json.dumps({"v": 1}))
+
+    def _spawn_holders(self, broker, ids):
+        holders = [SecureShareHolder(broker, h) for h in ids]
+        threads = [threading.Thread(target=h.run, kwargs={"timeout": 15},
+                                    daemon=True) for h in holders]
+        for t in threads:
+            t.start()
+        return holders, threads
+
+    def test_wire_round_with_corruption_and_dead_holder(self):
+        broker = Broker()
+        # holder 2 never comes up: a silent topic = a dead process
+        self._spawn_holders(broker, [0, 1])
+        pay = np.random.default_rng(0).normal(size=(4, 16))
+
+        def tamper(wire, sender, holder):
+            if (sender, holder) == (1, 0):   # flip a payload byte in transit
+                d = json.loads(wire)
+                d["data"] = ("B" if d["data"][0] != "B" else "C") \
+                    + d["data"][1:]
+                return json.dumps(d)
+            return wire
+
+        res = run_secure_wire_round(broker, pay, threshold=1, num_holders=3,
+                                    deadline=2.0, tamper=tamper)
+        assert not res.degraded
+        assert res.included == [0, 2, 3]     # sender 1 excluded (corrupt)
+        assert res.holders_alive == 2
+        plain = pay[res.included].sum(axis=0)
+        np.testing.assert_allclose(res.total[:-1], plain, atol=1e-3)
+        assert abs(res.total[-1] - 3) < 1e-3  # opened contributor count
+        reasons = {e["reason"] for e in _events("share_dropped")}
+        assert {"corrupt", "holder_dropout"} <= reasons
+        assert _events("secure_reconstructed")
+
+    def test_wire_round_degrades_without_quorum_no_hang(self):
+        broker = Broker()
+        self._spawn_holders(broker, [0])     # 1 alive < T+1 = 2
+        pay = np.zeros((3, 4))
+        res = run_secure_wire_round(broker, pay, threshold=1, num_holders=3,
+                                    deadline=0.7)
+        assert res.degraded
+        assert res.reason == "holders_below_threshold"
+        assert _events("secure_degraded")
+
+
+# ----------------------------------------------------------------------
+class TestSecureRoundDriver:
+    def _tree(self, M=2, C=5, seed=0):
+        rng = np.random.default_rng(seed)
+        prev = {"w": rng.normal(size=(M, 4, 3)).astype(np.float32),
+                "b": rng.normal(size=(M, 3)).astype(np.float32)}
+        cp = {k: v[:, None] + rng.normal(
+            size=(M, C) + v.shape[1:]).astype(np.float32) * 0.01
+            for k, v in prev.items()}
+        n = np.abs(rng.normal(size=(M, C))) * 100 + 1
+        return prev, cp, n
+
+    def test_matches_plaintext_weighted_mean(self):
+        prev, cp, n = self._tree()
+        drv = SecureRoundDriver("shamir", num_clients=5, threshold=1, seed=0)
+        newp, res = drv.aggregate_params(prev, cp, n, 0)
+        assert not res.degraded
+        wt = n / n.sum(axis=1, keepdims=True)
+        for k in prev:
+            ref = prev[k] + np.einsum(
+                "mc,mc...->m...", wt, cp[k] - prev[k][:, None])
+            np.testing.assert_allclose(newp[k], ref, atol=1e-3)
+            assert newp[k].dtype == prev[k].dtype
+
+    def test_untrained_model_keeps_prev(self):
+        prev, cp, n = self._tree()
+        n[1, :] = 0.0                        # model 1 untouched this round
+        drv = SecureRoundDriver("shamir", num_clients=5, threshold=1, seed=0)
+        newp, res = drv.aggregate_params(prev, cp, n, 0)
+        assert not res.degraded
+        for k in prev:
+            np.testing.assert_allclose(newp[k][1], prev[k][1], atol=1e-3)
+
+    def test_degraded_returns_none(self):
+        prev, cp, n = self._tree()
+        drv = SecureRoundDriver("shamir", num_clients=5, threshold=1, seed=0)
+        for h in range(4):
+            drv.injector.kill_holder(h)
+        newp, res = drv.aggregate_params(prev, cp, n, 0)
+        assert newp is None and res.degraded
+
+
+# ----------------------------------------------------------------------
+class TestConfigValidation:
+    def _cfg(self, **kw):
+        base = dict(dataset="sine", model="fnn", concept_num=2,
+                    concept_drift_algo="softcluster",
+                    concept_drift_algo_arg="mmacc_10",
+                    client_num_in_total=5, client_num_per_round=5,
+                    train_iterations=1, comm_round=2, sample_num=24,
+                    batch_size=12, report_client=0)
+        base.update(kw)
+        return ExperimentConfig(**base)
+
+    def test_accepts_valid(self):
+        self._cfg(secure_agg="shamir", secure_threshold_t=2)
+
+    def test_rejects_bad_combos(self):
+        with pytest.raises(ValueError, match="unknown secure_agg"):
+            self._cfg(secure_agg="bgw")
+        with pytest.raises(ValueError, match="2T\\+1"):
+            self._cfg(secure_agg="shamir", secure_threshold_t=3)
+        with pytest.raises(ValueError, match="robust_agg"):
+            self._cfg(secure_agg="shamir", robust_agg="median")
+        with pytest.raises(ValueError, match="hierarchy"):
+            self._cfg(secure_agg="shamir", hierarchy_edges=2)
+        with pytest.raises(ValueError, match="megastep"):
+            self._cfg(secure_agg="shamir", megastep_k=4)
+
+
+# ----------------------------------------------------------------------
+@pytest.mark.slow
+class TestRunnerIntegration:
+    def _run(self, **overrides):
+        from feddrift_tpu.simulation.runner import Experiment
+        base = dict(dataset="sine", model="fnn", concept_num=2,
+                    concept_drift_algo="softcluster",
+                    concept_drift_algo_arg="mmacc_10",
+                    client_num_in_total=5, client_num_per_round=5,
+                    train_iterations=2, comm_round=3, epochs=1,
+                    sample_num=24, batch_size=12,
+                    frequency_of_the_test=2, report_client=0,
+                    checkpoint_every_iteration=False, seed=0)
+        base.update(overrides)
+        exp = Experiment(ExperimentConfig(**base))
+        exp.run()
+        return exp
+
+    def test_secure_run_tracks_plaintext(self):
+        import jax
+        exp_sec = self._run(secure_agg="shamir")
+        sec_leaves = [np.asarray(l) for l in
+                      jax.tree_util.tree_leaves(exp_sec.pool.params)]
+        recs = _events("secure_reconstructed")
+        assert len(recs) == 6                # 2 iterations x 3 rounds
+        assert max(e["max_abs_err"] for e in recs) < 1e-3
+        assert all(np.isfinite(l).all() for l in sec_leaves)
+        obs.configure(None)
+        exp_ref = self._run()                # plaintext, same seed
+        ref_leaves = [np.asarray(l) for l in
+                      jax.tree_util.tree_leaves(exp_ref.pool.params)]
+        for s, r in zip(sec_leaves, ref_leaves):
+            np.testing.assert_allclose(s, r, atol=5e-2)
+
+    def test_secure_run_with_faults_degrades_not_hangs(self):
+        exp = self._run(secure_agg="shamir",
+                        secure_holder_stall_prob=0.45,
+                        secure_fault_seed=7, train_iterations=1)
+        import jax
+        assert all(np.isfinite(np.asarray(l)).all()
+                   for l in jax.tree_util.tree_leaves(exp.pool.params))
+        started = _events("secure_round_started")
+        rec = _events("secure_reconstructed")
+        deg = _events("secure_degraded")
+        assert len(started) == 3
+        assert len(rec) + len(deg) == 3      # every round closed, no hang
