@@ -500,6 +500,93 @@ def _popscale_bench(backend: str, smoke: bool) -> list:
     return out
 
 
+def _instr_value(instruments: dict, name: str, **labels):
+    """One series from a registry snapshot; keys are name{k="v"}."""
+    if not labels:
+        return instruments.get(name)
+    key = name + "{" + ",".join(
+        f'{k}="{v}"' for k, v in sorted(labels.items())) + "}"
+    return instruments.get(key)
+
+
+def _hostscale_cfg(smoke: bool, population: int):
+    """The popscale geometry with the full host-plane observatory ON
+    (sampling profiler + ledger): what we are measuring here is the HOST
+    control plane's cost as the registered population grows, with the
+    device program held fixed by the cohort shape."""
+    cfg = _popscale_cfg(smoke, population)
+    import dataclasses
+    return dataclasses.replace(cfg, hostprof_hz=50.0)
+
+
+def _hostscale_bench(backend: str, smoke: bool) -> dict:
+    """Per-subsystem host-seconds/round and host-bytes vs population P,
+    with fitted log-log scaling exponents (ISSUE 19).
+
+    The HOSTSCALE artifact the `regress` hostscale axis gates: the dense
+    registry columns, assign_hist and cohort planning are O(P) by
+    construction — this measures their actual exponents and bytes/client
+    so the ROADMAP item-2 refactor has named numbers to beat. Seconds
+    come from the host_ledger_seconds_total counters, which accumulate
+    exactly the steady state because _measure resets the instrument
+    registry after warm-up; bytes are the ledger's latest-value gauges."""
+    from feddrift_tpu.obs.hostprof import SUBSYSTEMS, fit_scaling
+    from feddrift_tpu.obs.regress import _compile_counts
+    structures = ("registry_columns", "assign_hist", "routing_table",
+                  "staged_shards")
+    rows = []
+    populations = (100, 1000) if smoke else (100, 1000, 10000, 100000)
+    for population in populations:
+        cfg = _hostscale_cfg(smoke, population)
+        r = _measure_with_retry(cfg, backend)
+        _, recompiles = _compile_counts(r)
+        instr = r.get("instruments") or {}
+        rounds = max(r.get("rounds") or 1, 1)
+        sec = {}
+        for sub in SUBSYSTEMS:
+            total = _instr_value(instr, "host_ledger_seconds_total",
+                                 subsystem=sub)
+            sec[sub] = (round(total / rounds, 8)
+                        if isinstance(total, (int, float)) else None)
+        byt = {s: _instr_value(instr, "host_bytes", structure=s)
+               for s in structures}
+        rows.append({
+            "population": population,
+            "cohort_slots": cfg.cohort_slots,
+            "rounds_per_sec": r.get("value"),
+            "wall_s": r.get("wall_s"),
+            "steady_recompiles": recompiles,
+            "seconds_per_round": sec,
+            "bytes": byt,
+            "rss_peak_bytes": _instr_value(instr, "host_rss_peak_bytes"),
+            **({"error": r["error"]} if "error" in r else {}),
+        })
+        print(json.dumps({"partial": f"hostscale@{population}",
+                          **rows[-1]}), file=sys.stderr)
+    pops = [row["population"] for row in rows]
+    exp_seconds = {
+        sub: fit_scaling(pops, [(row["seconds_per_round"] or {}).get(sub)
+                                for row in rows])
+        for sub in SUBSYSTEMS}
+    exp_bytes = {
+        s: fit_scaling(pops, [(row["bytes"] or {}).get(s) for row in rows])
+        for s in structures}
+    top = rows[-1]
+    bytes_per_client = {
+        s: round(v / top["population"], 3)
+        for s, v in (top["bytes"] or {}).items()
+        if isinstance(v, (int, float)) and v > 0}
+    return {
+        "populations": pops,
+        "rows": rows,
+        "exp_seconds": {k: round(v, 4) if v is not None else None
+                        for k, v in exp_seconds.items()},
+        "exp_bytes": {k: round(v, 4) if v is not None else None
+                      for k, v in exp_bytes.items()},
+        "bytes_per_client": bytes_per_client,
+    }
+
+
 def _hierarchy_bench(smoke: bool) -> list:
     """Broker bytes/round per wire codec (ISSUE 8: verified compression on
     the update path). Backend-independent by design — the codecs are numpy
@@ -1619,6 +1706,13 @@ def main() -> None:
         # runs); committed as POPSCALE_r0*.json and gated by `regress`
         "popscale": (_popscale_bench(backend, smoke)
                      if "--popscale" in sys.argv else None),
+        # host-plane scaling axis (opt-in: population sweep with the
+        # sampling profiler + subsystem ledger on, per-subsystem log-log
+        # exponents of host-seconds/round and bytes vs P); committed as
+        # HOSTSCALE_r1*.json and gated by `regress` (exponent ceilings,
+        # bytes/client ceilings, rounds/s floor, zero steady recompiles)
+        "hostscale": (_hostscale_bench(backend, smoke)
+                      if "--hostscale" in sys.argv else None),
         # two-tier wire axis (opt-in: pure-wire TCP broker measurement);
         # committed as COMM_r0*.json and gated by `regress`
         "hierarchy": (_hierarchy_bench(smoke)

@@ -232,6 +232,7 @@ def main(argv: list[str] | None = None) -> int:
     reg_p.add_argument("--tol-p99", type=float, default=None)
     reg_p.add_argument("--tol-precision-acc", type=float, default=None)
     reg_p.add_argument("--tol-quality-acc", type=float, default=None)
+    reg_p.add_argument("--tol-hostscale-exp", type=float, default=None)
     reg_p.add_argument("--json", action="store_true")
 
     cp_p = sub.add_parser(
@@ -241,6 +242,10 @@ def main(argv: list[str] | None = None) -> int:
              "(obs/critical_path.py)")
     cp_p.add_argument("run_dir")
     cp_p.add_argument("--json", action="store_true")
+    cp_p.add_argument("--flame", action="store_true",
+                      help="also print top folded host stacks from the "
+                           "run's sampling profiler (hostprof.folded)")
+    cp_p.add_argument("--flame-top", type=int, default=10, metavar="N")
 
     fl_p = sub.add_parser(
         "fleet",
@@ -382,7 +387,7 @@ def main(argv: list[str] | None = None) -> int:
         argv_r = [args.candidate, "--baseline", args.baseline]
         for flag in ("tol_rounds", "tol_wall", "tol_acc", "tol_compiles",
                      "tol_host_overhead", "tol_p99", "tol_precision_acc",
-                     "tol_quality_acc"):
+                     "tol_quality_acc", "tol_hostscale_exp"):
             v = getattr(args, flag)
             if v is not None:
                 argv_r += [f"--{flag.replace('_', '-')}", str(v)]
@@ -393,7 +398,10 @@ def main(argv: list[str] | None = None) -> int:
     if args.cmd == "critical_path":
         # pure host-side: no jax / backend initialisation needed
         from feddrift_tpu.obs.critical_path import main as cp_main
-        return cp_main([args.run_dir] + (["--json"] if args.json else []))
+        return cp_main([args.run_dir]
+                       + (["--json"] if args.json else [])
+                       + (["--flame", "--flame-top", str(args.flame_top)]
+                          if args.flame else []))
 
     if args.cmd == "fleet":
         # pure host-side: the netbroker client is stdlib + obs, no jax

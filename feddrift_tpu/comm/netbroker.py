@@ -368,21 +368,25 @@ class NetworkBrokerClient:
             while len(self._pending) > self.PENDING_MAX:
                 self._pending.pop(next(iter(self._pending)))
         frame = {"op": "pub", "topic": topic, "payload": payload, "seq": seq}
+        ledger = obs.hostprof.ledger()
         if trace is not None:
             tctx = obs_spans.child_of(trace)
             frame["trace"] = tctx
             t0, p0 = time.time(), time.perf_counter()
             # keep the pending entry on OSError: a retry layer resends it
             self._send(frame)
-            obs_spans.record("broker_publish", t0,
-                             time.perf_counter() - p0, cat="comm",
+            dt = time.perf_counter() - p0
+            obs_spans.record("broker_publish", t0, dt, cat="comm",
                              topic=topic, **tctx)
+            ledger.add_seconds("broker_io", dt)
             return seq
+        p0 = time.perf_counter()
         try:
             self._send(frame)
         except OSError:
             # keep the pending entry: a retry layer resends it on reconnect
             raise
+        ledger.add_seconds("broker_io", time.perf_counter() - p0)
         return seq
 
     def unacked(self) -> "dict[int, tuple[str, str]]":

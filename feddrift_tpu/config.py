@@ -296,6 +296,13 @@ class ExperimentConfig:
     # Size cap (MiB) on events.jsonl / spans.jsonl before rotation to
     # <file>.1 with a loud obs_rotated event; 0 = unbounded (default).
     obs_max_file_mb: float = 0.0
+    # Host-plane sampling profiler (obs/hostprof.py; docs/OBSERVABILITY.md
+    # "Host-plane observatory"): wall-clock stack samples per second taken
+    # by a daemon thread over sys._current_frames(). 0 = off (default);
+    # when on, the coordinator writes hostprof.jsonl (merged into
+    # report --trace) and hostprof.folded (flamegraph input) to the run
+    # dir. The per-subsystem HostLedger runs regardless of this knob.
+    hostprof_hz: float = 0.0
     # --- live ops plane (obs/live.py; docs/OBSERVABILITY.md) ------------
     # HTTP ops endpoint (/metrics, /healthz, /status) on a background
     # thread. 0 = disabled (default, zero hot-path work); -1 = bind an
@@ -357,6 +364,9 @@ class ExperimentConfig:
                     "population mode already stages only the cohort's shard")
         if self.cohort_size < 0 or self.cohort_overprovision < 0:
             raise ValueError("cohort_size/cohort_overprovision must be >= 0")
+        if self.hostprof_hz < 0:
+            raise ValueError(
+                "hostprof_hz must be >= 0 (0 disables the sampling profiler)")
         if self.round_deadline <= 0:
             raise ValueError("round_deadline must be > 0")
         if not 0.0 < self.quorum_frac <= 1.0:

@@ -141,7 +141,23 @@ class AsyncStager:
         self._slots.pop(tag, None)
         while len(self._slots) >= self.depth:
             self._slots.pop(next(iter(self._slots)))
-        self._slots[tag] = (self._pool.submit(fn), meta)
+
+        def staged():
+            # worker-thread ledger accounting: gather+put wall into the
+            # "stager" subsystem, staged shard footprint as host bytes
+            # (HostLedger is thread-safe; overhead is two perf_counter
+            # calls per staged iteration)
+            import time
+
+            from feddrift_tpu.obs import hostprof
+            t0 = time.perf_counter()
+            out = fn()
+            ledger = hostprof.ledger()
+            ledger.add_seconds("stager", time.perf_counter() - t0)
+            ledger.set_bytes("staged_shards", hostprof.nbytes_of(out))
+            return out
+
+        self._slots[tag] = (self._pool.submit(staged), meta)
 
     def has(self, tag) -> bool:
         """True when ``tag`` is staged (possibly still in flight)."""

@@ -22,6 +22,10 @@ were all invisible. This package is the missing observability layer:
   (FLOPs, bytes accessed, peak HBM), live ``device.memory_stats()``
   watermarks, measured/datasheet peaks, and the roofline math behind
   ``bench.py``'s ``mfu_estimate``.
+- ``obs.hostprof``    — the host-plane observatory: a sampling stack
+  profiler (``cfg.hostprof_hz``, folded-stack text + trace.json lanes)
+  and the per-subsystem ``HostLedger`` of host-seconds and host bytes
+  behind the ``host_ledger`` event and ``bench.py --hostscale``.
 - ``obs.spans``       — wall-clock span recording (``spans.jsonl``) and
   the Chrome-trace-event exporter behind ``report <run_dir> --trace``
   (Perfetto-loadable ``trace.json``, one lane per process/thread).
@@ -66,6 +70,7 @@ from feddrift_tpu.obs.instruments import (  # noqa: F401
 from feddrift_tpu.obs import (  # noqa: F401
     alerts,
     costmodel,
+    hostprof,
     lineage,
     live,
     quantiles,

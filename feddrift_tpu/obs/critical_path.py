@@ -186,6 +186,38 @@ def render(result: dict[str, Any]) -> str:
     return "\n".join(lines)
 
 
+def render_flame(run_dir: str, result: dict[str, Any],
+                 top: int = 10) -> str:
+    """The host-side view of the dominant segment: top-N folded stacks
+    from the run's sampling profiler (``hostprof.folded``, written when
+    ``cfg.hostprof_hz > 0``). A segment table says WHICH phase dominates;
+    the flame rows say WHAT the host was executing during it."""
+    path = os.path.join(run_dir, "hostprof.folded")
+    if not os.path.exists(path):
+        return ("no hostprof data: rerun with --hostprof_hz > 0 to sample "
+                "host stacks (writes hostprof.folded next to spans.jsonl)")
+    rows = []
+    with open(path) as f:
+        for line in f:
+            stack, _, count = line.rstrip("\n").rpartition(" ")
+            if stack and count.isdigit():
+                rows.append((int(count), stack))
+    if not rows:
+        return "hostprof.folded is empty (profiler sampled no stacks)"
+    rows.sort(key=lambda r: (-r[0], r[1]))
+    total = sum(c for c, _ in rows)
+    dom = result.get("dominant_segment") or "-"
+    lines = [f"host stacks while '{dom}' dominated the critical path "
+             f"({total} samples, top {min(top, len(rows))} of {len(rows)} "
+             f"stacks):"]
+    for count, stack in rows[:top]:
+        # leaf-first: the sampled frame, then its callers
+        frames = stack.split(";")
+        lines.append(f"{count:>6} ({100.0 * count / total:5.1f}%)  "
+                     f"{' <- '.join(reversed(frames[-4:]))}")
+    return "\n".join(lines)
+
+
 def main(argv: list[str] | None = None) -> int:
     ap = argparse.ArgumentParser(
         prog="feddrift_tpu critical_path",
@@ -193,6 +225,11 @@ def main(argv: list[str] | None = None) -> int:
                     "attribution from a run dir's spans/events streams")
     ap.add_argument("run_dir")
     ap.add_argument("--json", action="store_true", help="machine-readable")
+    ap.add_argument("--flame", action="store_true",
+                    help="also print the top folded host stacks from the "
+                         "run's sampling profiler (hostprof.folded)")
+    ap.add_argument("--flame-top", type=int, default=10, metavar="N",
+                    help="folded stacks to print with --flame (default 10)")
     args = ap.parse_args(argv)
     try:
         result = analyze(args.run_dir)
@@ -207,6 +244,9 @@ def main(argv: list[str] | None = None) -> int:
         print(json.dumps(result, indent=2))
     else:
         print(render(result))
+    if args.flame:
+        print()
+        print(render_flame(args.run_dir, result, top=args.flame_top))
     return 0
 
 

@@ -105,12 +105,15 @@ EVENT_KINDS = frozenset({
     # obs/events.py + obs/spans.py rotation)
     "round_breakdown",      # per-iteration segment split + dispatch gap
     "obs_rotated",          # a size-capped JSONL sink rotated a generation
+    # host-plane observatory (obs/hostprof.py, simulation/runner.py)
+    "host_ledger",          # per-iteration host-seconds/bytes ledger + RSS
     # live ops plane (obs/live.py)
     "ops_snapshot",         # periodic per-process metric+health snapshot
     "slo_burn",             # SLO error-budget burn-rate rule fired
     # serving read path (platform/serving.py)
     "request_served",       # one inference request answered (routing + latency)
     "pool_swapped",         # engine published a new pool/routing generation
+    "routing_rebuilt",      # dense routing table rebuilt from the registry
     # serving frontend / replica plane (platform/frontend.py,
     # platform/serving.py)
     "frontend_shed",        # admission refused a request (queue/rate/backpressure)

@@ -433,6 +433,18 @@ def status_snapshot(slo: Optional[SLOEngine] = None,
     ex = exemplars()
     if ex:
         doc["exemplars"] = ex
+    # host-plane observatory: process RSS + the ledger's biggest tracked
+    # structures, so /status answers "what is this process's host memory
+    # doing" without grepping events.jsonl
+    from feddrift_tpu.obs import hostprof
+    rss = hostprof.rss_bytes()
+    led = hostprof.ledger()
+    doc["host"] = {
+        "rss_mb": round(rss / (1 << 20), 1) if rss else None,
+        "rss_peak_mb": round(led.rss_peak_bytes / (1 << 20), 1)
+        if led.rss_peak_bytes else None,
+        "top_structures": {k: v for k, v in led.top_bytes(3)},
+    }
     doc["pid"] = os.getpid()
     return doc
 
@@ -449,6 +461,10 @@ _METRIC_PREFIXES = (
     "requests_", "serve_", "pool_version", "pool_swaps",
     "request_latency_seconds_q", "model_accuracy_q", "canary_",
     "frontend_", "replica_",
+    # host-plane observatory (obs/hostprof.py): per-subsystem seconds,
+    # per-structure bytes, RSS, and the routing-rebuild counter
+    "host_ledger_seconds", "host_bytes", "host_rss_bytes",
+    "routing_rebuilds",
 )
 
 
@@ -765,8 +781,8 @@ def _sketch_q(snap: dict, name: str, q: str):
 def render_fleet(lanes: dict) -> str:
     """The merged multi-process table the ``fleet`` CLI verb prints."""
     cols = ("LANE", "PID", "ITER", "ROUNDS/S", "P99 WALL", "BYTES OUT",
-            "STRAGGLERS", "RECONNECTS", "REQ/S", "P99-REQ", "POOL-VER",
-            "CANARY", "ALERTS", "HEALTH")
+            "HOST-MB", "STRAGGLERS", "RECONNECTS", "REQ/S", "P99-REQ",
+            "POOL-VER", "CANARY", "ALERTS", "HEALTH")
     rows = []
     for lane in sorted(lanes):
         snap = lanes[lane]
@@ -777,6 +793,11 @@ def render_fleet(lanes: dict) -> str:
         if bytes_out is None:
             bytes_out = _metric(snap, "broker_bytes_out")
         pool_ver = _metric(snap, "pool_version")
+        # process RSS from the host-plane ledger gauge; falls back to the
+        # /status host block for lanes that snapshot status but no metrics
+        rss = _metric(snap, "host_rss_bytes")
+        host_mb = (round(rss / (1 << 20), 1) if rss
+                   else (st.get("host") or {}).get("rss_mb"))
         rows.append((
             lane,
             _fmt(snap.get("pid")),
@@ -784,6 +805,7 @@ def render_fleet(lanes: dict) -> str:
             _fmt(st.get("rounds_per_s")),
             _fmt(_sketch_q(snap, "round_wall_seconds_q", "0.99"), 4),
             _fmt(int(bytes_out) if bytes_out is not None else None),
+            _fmt(host_mb, 1),
             _fmt(_metric(snap, "stragglers_masked")),
             _fmt((health.get("broker") or {}).get("reconnects")),
             _fmt(extra.get("requests_per_s"), 1),

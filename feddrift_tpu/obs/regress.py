@@ -46,6 +46,10 @@ DEFAULT_TOL = {
     "secure_wall": 1.0,      # fail if the secure-agg engine wall/round >
                              # baseline * (1 + tol) — host-side numpy on
                              # shared CI, so the ceiling is generous
+    "hostscale_exp": 0.2,    # fail if a fitted host-plane scaling exponent
+                             # (host-seconds/round or bytes vs P, log-log
+                             # slope) > baseline + tol — absolute headroom
+                             # sized for fit noise on short sweeps
 }
 
 
@@ -215,6 +219,66 @@ def compare(candidate: dict, baseline: dict,
                                      "population size"))
     elif isinstance(bps, list):
         skip("popscale", "candidate lacks the popscale axis")
+
+    # host-plane scaling axis (bench.py --hostscale; HOSTSCALE artifacts):
+    # the ISSUE-19 gate on the dense-O(P) host behaviors. Per population
+    # point: rounds/s under the throughput tolerance and steady-state
+    # recompiles as an ABSOLUTE zero gate (the ledger + profiler are pure
+    # host work — enabling them must not mint programs). Then the fitted
+    # log-log scaling exponents per subsystem (host-seconds/round vs P)
+    # and per structure (bytes vs P) under an absolute +tol["hostscale_exp"]
+    # headroom, and bytes/client at the largest P under the bytes ceiling —
+    # the named numbers the ROADMAP item-2 refactor must beat.
+    chs, bhs = candidate.get("hostscale"), baseline.get("hostscale")
+    if isinstance(chs, dict) and isinstance(bhs, dict):
+        b_rows = {e.get("population"): e
+                  for e in (bhs.get("rows") or []) if isinstance(e, dict)}
+        for e in (chs.get("rows") or []):
+            if not isinstance(e, dict):
+                continue
+            p = e.get("population")
+            be = b_rows.get(p)
+            if be is None:
+                skip(f"hostscale[{p}]",
+                     "population point missing in baseline")
+                continue
+            bv, cv = be.get("rounds_per_sec"), e.get("rounds_per_sec")
+            if bv and cv:
+                floor = bv * (1.0 - tol["rounds"])
+                rows.append(row(f"hostscale[{p}].rounds_per_s", bv, cv,
+                                f">= {floor:.3f}", cv < floor))
+            rec = e.get("steady_recompiles")
+            if rec is not None:
+                rows.append(row(f"hostscale[{p}].steady_recompiles",
+                                be.get("steady_recompiles"), rec, "== 0",
+                                rec > 0,
+                                note="ledger + profiler are pure host "
+                                     "work"))
+        for axis, label in (("exp_seconds", "s/round"),
+                            ("exp_bytes", "bytes")):
+            b_exp = bhs.get(axis) or {}
+            for sub, cv in sorted((chs.get(axis) or {}).items()):
+                bv = b_exp.get(sub)
+                name = f"hostscale.{axis}[{sub}]"
+                if cv is None or bv is None:
+                    skip(name, "exponent unfit on one side")
+                    continue
+                ceil = bv + tol["hostscale_exp"]
+                rows.append(row(name, bv, cv, f"<= {ceil:.3f}", cv > ceil,
+                                note=f"log-log {label} vs P slope"))
+        b_bpc = bhs.get("bytes_per_client") or {}
+        for s, cv in sorted((chs.get("bytes_per_client") or {}).items()):
+            bv = b_bpc.get(s)
+            name = f"hostscale.bytes_per_client[{s}]"
+            if bv is None:
+                skip(name, "structure missing in baseline")
+                continue
+            ceil = bv * (1.0 + tol["bytes"])
+            rows.append(row(name, bv, cv, f"<= {ceil:.1f}", cv > ceil,
+                            note="host bytes per registered client at "
+                                 "max P"))
+    elif isinstance(bhs, dict):
+        skip("hostscale", "candidate lacks the hostscale axis")
 
     # multi-iteration megastep axis (bench.py --megastep; MEGASTEP
     # artifacts): rounds/s per K point under the throughput tolerance,
@@ -643,6 +707,10 @@ def main(argv: list[str] | None = None) -> int:
                     default=DEFAULT_TOL["secure_wall"],
                     help="relative secure-agg engine wall/round growth "
                          "tolerated (default %(default)s)")
+    ap.add_argument("--tol-hostscale-exp", type=float,
+                    default=DEFAULT_TOL["hostscale_exp"],
+                    help="absolute growth tolerated in a fitted host-plane "
+                         "scaling exponent (default %(default)s)")
     ap.add_argument("--json", action="store_true", help="machine-readable")
     args = ap.parse_args(argv)
 
@@ -661,7 +729,8 @@ def main(argv: list[str] | None = None) -> int:
                         "p99": args.tol_p99,
                         "precision_acc": args.tol_precision_acc,
                         "quality_acc": args.tol_quality_acc,
-                        "secure_wall": args.tol_secure_wall})
+                        "secure_wall": args.tol_secure_wall,
+                        "hostscale_exp": args.tol_hostscale_exp})
     regressed = any(r["status"] == "regress" for r in rows)
     if args.json:
         print(json.dumps({"regressed": regressed, "rows": rows,

@@ -252,6 +252,9 @@ def build_trace(run_dir: str) -> dict:
             spans = extra + spans
         else:
             events = extra + events
+    # sampling-profiler slices (obs/hostprof.py) share the span schema;
+    # their string tids ("hostprof:<thread>") become their own named lanes
+    spans = spans + _load_jsonl(os.path.join(run_dir, "hostprof.jsonl"))
 
     trace: list[dict] = []
     # (pid, raw tid) -> compact per-process tid; tid 0 = events lane
@@ -316,9 +319,13 @@ def build_trace(run_dir: str) -> dict:
                      "tid": 0, "args": {"name": f"process {pid}"}})
         meta.append({"ph": "M", "name": "thread_name", "pid": pid,
                      "tid": EVENTS_LANE_TID, "args": {"name": "events"}})
-    for (pid, _raw), tid in sorted(lanes.items(), key=lambda kv: kv[1]):
+    for (pid, raw), tid in sorted(lanes.items(), key=lambda kv: kv[1]):
+        # descriptive raw tids (e.g. "hostprof:140…") name the lane
+        # directly; integer thread idents keep the compact label
+        name = raw if isinstance(raw, str) and not raw.isdigit() \
+            else f"thread {tid}"
         meta.append({"ph": "M", "name": "thread_name", "pid": pid,
-                     "tid": tid, "args": {"name": f"thread {tid}"}})
+                     "tid": tid, "args": {"name": name}})
 
     return {"traceEvents": meta + trace, "displayTimeUnit": "ms"}
 

@@ -192,6 +192,13 @@ class ClientRegistry:
             "max_absent_streak": int(self.absent_streak.max(initial=0)),
         }
 
+    def column_bytes(self) -> dict:
+        """Per-column host-memory footprint in bytes. Every column is
+        dense O(P) (assign_hist O(P*T1)) — this is the number the
+        hostprof ledger tracks against population and the ROADMAP item-2
+        refactor must shrink."""
+        return {k: int(v.nbytes) for k, v in self.state_dict().items()}
+
 
 class CohortSampler:
     """Seeded per-iteration cohort draws over the registry's active set.

@@ -298,6 +298,12 @@ class RoutingTable:
 
     @classmethod
     def from_registry(cls, reg) -> "RoutingTable":
+        # Dense O(P) rebuild (and O(P*T1) when the history fallback runs):
+        # exactly the ROADMAP item-2 cost this event/counter/ledger entry
+        # makes visible — at 10^6 clients these rebuilds dominate the
+        # serve-side host plane.
+        from feddrift_tpu import obs
+        t0 = time.perf_counter()
         table = np.asarray(reg.cluster, dtype=np.int64).copy()
         unknown = table < 0
         if unknown.any():
@@ -309,6 +315,14 @@ class RoutingTable:
             fallback = np.where(
                 has_any, hist[np.arange(hist.shape[0]), last], -1)
             table[unknown] = fallback[unknown]
+        build_wall = time.perf_counter() - t0
+        ledger = obs.hostprof.ledger()
+        ledger.add_seconds("routing_rebuild", build_wall)
+        ledger.set_bytes("routing_table", int(table.nbytes))
+        obs.registry().counter("routing_rebuilds").inc()
+        obs.emit("routing_rebuilt", population=int(table.shape[0]),
+                 build_wall_s=round(build_wall, 6),
+                 table_bytes=int(table.nbytes), source="registry")
         return cls(table)
 
     @classmethod
@@ -759,6 +773,17 @@ class InferenceEngine:
         obs.registry().counter("pool_swaps").inc()
         obs.emit("pool_swapped", version=gen.version, reason=reason,
                  models=gen.num_models, **evidence)
+        if routing is not None:
+            # a swap that ships a new routing table IS a rebuild on the
+            # serve path — count it even when the table was built
+            # elsewhere (from_assignment, canary commit)
+            obs.emit("routing_rebuilt", population=routing.population,
+                     build_wall_s=0.0,
+                     table_bytes=int(routing.table.nbytes),
+                     source="swap", version=gen.version)
+            obs.registry().counter("routing_rebuilds").inc()
+            obs.hostprof.ledger().set_bytes("routing_table",
+                                            int(routing.table.nbytes))
         if self.quality is not None:
             self.quality.on_swap()
         return gen.version

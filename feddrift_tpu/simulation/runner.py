@@ -211,6 +211,19 @@ class Experiment:
             os.path.join(out_dir, "spans.jsonl")
             if (out_dir and self.is_coordinator) else None,
             pid=jax.process_index(), max_bytes=obs_cap)
+        # Host-plane observatory (obs/hostprof.py): the per-subsystem
+        # host-seconds/bytes ledger finalized at each iteration tail, and
+        # the optional sampling stack profiler (cfg.hostprof_hz > 0) whose
+        # slices land in hostprof.jsonl (merged into report --trace) and
+        # whose folded stacks are written at run() exit. configure_profiler
+        # stops any sampler left by a previous Experiment in this process.
+        self._ledger = obs.hostprof.ledger()
+        self._ledger.reset()
+        self.hostprof = obs.hostprof.configure_profiler(
+            cfg.hostprof_hz,
+            path=os.path.join(out_dir, "hostprof.jsonl")
+            if (out_dir and self.is_coordinator) else None,
+            pid=jax.process_index())
         # Live health monitor (obs/alerts.py): a bus tap evaluating the
         # declarative rule set over every emitted event; fired alerts are
         # re-emitted as alert_raised AND appended to alerts.jsonl so a
@@ -605,12 +618,14 @@ class Experiment:
         # next iteration) must leave no trace in events.jsonl, or the
         # resumed run — which re-draws identically from the checkpointed
         # registry — would duplicate them.
+        plan0 = time.perf_counter()
         with obs.capture() as deferred:
             if self.churn is not None:
                 joins, leaves = self.churn.events(t, self.registry.active)
                 self.registry.apply_churn(joins, leaves, t)
             members = self.sampler.sample(t)
         idx = self._cohort_gather_index(members)
+        self._ledger.add_seconds("cohort_plan", time.perf_counter() - plan0)
 
         def gather():
             return (shard_client_arrays(self.mesh,
@@ -712,12 +727,28 @@ class Experiment:
         assign = np.asarray(self.algo.test_model_idx(t))
         self.registry.writeback(t, self._cohort_members, assign,
                                 self.algo.cohort_arm_acc(t))
+        cb = self.registry.column_bytes()
+        self._ledger.set_bytes("assign_hist", cb.get("assign_hist", 0))
+        self._ledger.set_bytes(
+            "registry_columns",
+            sum(v for k, v in cb.items() if k != "assign_hist"))
         if self.logger:
             self.logger.set_summary("Population", self.registry.summary())
 
     # ------------------------------------------------------------------
+    # round_breakdown segments that are HOST control-plane work double-
+    # book into the hostprof ledger (device_compute/h2d/dispatch do not);
+    # _seg_add is the single accumulation point for both the iteration
+    # and the megastep path, so this map covers both.
+    _LEDGER_SEGS = {"cohort_prep": "cohort_plan",
+                    "writeback": "registry_writeback",
+                    "drift_decision": "drift_decision"}
+
     def _seg_add(self, name: str, dt: float) -> None:
         self._segs[name] = self._segs.get(name, 0.0) + dt
+        sub = self._LEDGER_SEGS.get(name)
+        if sub is not None:
+            self._ledger.add_seconds(sub, dt)
 
     def _seg(self, name: str, **args):
         """Sub-span of the iteration (cat="round") that also accumulates
@@ -862,6 +893,7 @@ class Experiment:
         reg.quantile_sketch("round_wall_seconds_q").observe(
             wall / max(cfg.comm_round, 1))
         reg.quantile_sketch("dispatch_gap_seconds_q").observe(gap)
+        self._ledger.finalize(iteration=t, rounds=cfg.comm_round)
         obs.costmodel.record_hbm_watermark(iteration=t)
         if self._ops_active and t % cfg.ops_snapshot_every == 0:
             obs.live.emit_snapshot("runner", seq=t, slo=self.slo)
@@ -886,7 +918,8 @@ class Experiment:
         if self.population_mode:
             # the cohort IS the round's sample; participation is governed
             # by the deadline/quorum policy, not dense-pool subsampling
-            return self._population_masks(t, rounds)
+            with self._ledger.timed("cohort_plan"):
+                return self._population_masks(t, rounds)
         sampling = cfg.client_num_per_round < self.C_
         if not sampling and self.fault_injector is None:
             return None
@@ -1703,6 +1736,7 @@ class Experiment:
             wall_j / max(R, 1))
         reg.quantile_sketch("dispatch_gap_seconds_q").observe(
             gap / committed)
+        self._ledger.finalize(iteration=last_t, rounds=committed * R)
         obs.costmodel.record_hbm_watermark(iteration=last_t)
         if self._ops_active and last_t % cfg.ops_snapshot_every == 0:
             obs.live.emit_snapshot("runner", seq=last_t, slo=self.slo)
@@ -1743,6 +1777,12 @@ class Experiment:
             self.events.emit("run_end", global_round=self.global_round,
                              test_acc=self.logger.last("Test/Acc"),
                              preempted=self.preempted)
+        if self.hostprof is not None:
+            self.hostprof.stop()
+            if self.out_dir and self.is_coordinator:
+                import os
+                self.hostprof.write_folded(
+                    os.path.join(self.out_dir, "hostprof.folded"))
         return self.logger
 
     def _preempt_stop(self, completed_iteration: int, signal_name) -> None:

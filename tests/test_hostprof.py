@@ -1,0 +1,321 @@
+"""Host-plane observatory tests (obs/hostprof.py): sampling profiler
+lifecycle + thread-safety, ledger golden byte accounting against a
+hand-computed registry layout, scaling-exponent fits on synthetic
+curves, the regress hostscale axis, and the fleet HOST-MB column.
+Pure host logic — no compiled programs."""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+
+from feddrift_tpu.obs import hostprof, live
+from feddrift_tpu.obs.hostprof import (HostLedger, SamplingProfiler,
+                                       fit_scaling, nbytes_of)
+from feddrift_tpu.obs.instruments import registry
+
+
+class TestSamplingProfiler:
+    def test_start_stop_idempotent_and_restartable(self, tmp_path):
+        path = str(tmp_path / "hostprof.jsonl")
+        prof = SamplingProfiler(hz=200.0, path=path)
+        assert not prof.running
+        prof.start()
+        prof.start()                              # second start is a no-op
+        assert prof.running
+        time.sleep(0.05)
+        prof.stop()
+        prof.stop()                               # second stop is a no-op
+        prof.close()                              # close is an alias
+        assert not prof.running
+        n1 = prof.samples
+        assert n1 > 0
+        # restartable: the perf-gate toggle loop depends on this
+        prof.start()
+        time.sleep(0.05)
+        prof.stop()
+        assert prof.samples > n1
+        assert os.path.exists(path)
+
+    def test_samples_other_threads_and_folds_stacks(self, tmp_path):
+        stop = threading.Event()
+
+        def parked_worker():
+            while not stop.wait(0.002):
+                pass
+
+        t = threading.Thread(target=parked_worker, daemon=True,
+                             name="hp-test-worker")
+        t.start()
+        prof = SamplingProfiler(hz=500.0,
+                                path=str(tmp_path / "hostprof.jsonl"))
+        with prof:
+            time.sleep(0.15)
+        stop.set()
+        t.join(timeout=2.0)
+        folded = prof.folded()
+        assert folded, "no stacks captured"
+        # the worker's wait() leaf must appear in some folded stack, and
+        # folded stacks are root->leaf ';'-joined frame labels
+        assert any("parked_worker" in stack for stack in folded)
+        text = prof.folded_text()
+        lines = [l for l in text.splitlines() if l]
+        counts = [int(l.rsplit(" ", 1)[1]) for l in lines]
+        assert counts == sorted(counts, reverse=True)
+        assert sum(counts) >= prof.samples  # >=1 thread folded per sample
+        out = prof.write_folded(str(tmp_path / "x.folded"))
+        assert open(out).read() == text
+
+    def test_trace_slices_use_hostprof_lanes(self, tmp_path):
+        import json
+        path = str(tmp_path / "hostprof.jsonl")
+        prof = SamplingProfiler(hz=500.0, path=path, pid=3)
+        with prof:
+            time.sleep(0.1)
+        rows = [json.loads(l) for l in open(path)]
+        assert rows, "no slices written"
+        for r in rows:
+            assert r["cat"] == "hostprof"
+            assert r["tid"].startswith("hostprof:")
+            assert r["pid"] == 3
+            assert r["dur"] > 0
+            assert ";" in r["args"]["stack"] or r["args"]["stack"]
+
+    def test_concurrent_start_stop_is_safe(self):
+        prof = SamplingProfiler(hz=1000.0)
+        errs = []
+
+        def churn():
+            try:
+                for _ in range(20):
+                    prof.start()
+                    prof.stop()
+            except Exception as e:  # noqa: BLE001 — the assertion target
+                errs.append(e)
+
+        threads = [threading.Thread(target=churn) for _ in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=10.0)
+        prof.stop()
+        assert not errs
+        assert not prof.running
+
+    def test_configure_profiler_replaces_and_clears(self, tmp_path):
+        try:
+            p1 = hostprof.configure_profiler(
+                100.0, path=str(tmp_path / "a.jsonl"))
+            assert p1 is hostprof.get_profiler() and p1.running
+            p2 = hostprof.configure_profiler(
+                100.0, path=str(tmp_path / "b.jsonl"))
+            assert not p1.running                 # old sampler stopped
+            assert p2 is hostprof.get_profiler() and p2.running
+            assert hostprof.configure_profiler(0.0) is None
+            assert hostprof.get_profiler() is None
+            assert not p2.running
+        finally:
+            hostprof.configure_profiler(0.0)
+
+
+class TestHostLedgerGolden:
+    def test_registry_column_bytes_hand_computed(self):
+        """P=4 clients, T=3 steps: active 4x bool = 4 B; five int64
+        columns (joined/last_seen/last_sampled/absent_streak/cluster)
+        4x8 = 32 B each; two float64 columns (reliability, arm_acc)
+        32 B each; assign_hist [4,3] int32 = 48 B. Total 276 B."""
+        from feddrift_tpu.platform.registry import ClientRegistry
+        reg = ClientRegistry(population=4, num_steps=3)
+        cb = reg.column_bytes()
+        assert cb["active"] == 4
+        for col in ("joined_round", "last_seen_round",
+                    "last_sampled_round", "absent_streak", "cluster"):
+            assert cb[col] == 32, col
+        assert cb["reliability"] == 32
+        assert cb["arm_acc"] == 32
+        assert cb["assign_hist"] == 4 * 3 * 4
+        assert sum(cb.values()) == 276
+        assert nbytes_of(reg.state_dict()) == 276
+
+    def test_finalize_accounting_instruments_and_event_record(self):
+        reg = registry()
+        reg.reset()
+        try:
+            led = HostLedger()
+            led.add_seconds("cohort_plan", 0.25)
+            led.add_seconds("cohort_plan", 0.25)  # accumulates
+            with led.timed("registry_writeback"):
+                time.sleep(0.01)
+            led.add_seconds("noise", -1.0)        # non-positive ignored
+            led.set_bytes("registry_columns", 276)
+            led.set_bytes("assign_hist", 48)
+            led.set_bytes("routing_table", 1000)
+            rec = led.finalize(iteration=7, rounds=4, emit_event=False)
+            assert rec["iteration"] == 7 and rec["rounds"] == 4
+            assert rec["seconds"]["cohort_plan"] == 0.5
+            assert rec["seconds"]["registry_writeback"] >= 0.01
+            assert "noise" not in rec["seconds"]
+            assert rec["bytes"] == {"assign_hist": 48,
+                                    "registry_columns": 276,
+                                    "routing_table": 1000}
+            assert rec["rss_bytes"] and rec["rss_peak_bytes"] >= \
+                rec["rss_bytes"] > 0
+            snap = reg.snapshot()
+            assert snap['host_ledger_seconds{subsystem="cohort_plan"}'] == 0.5
+            assert snap[
+                'host_ledger_seconds_total{subsystem="cohort_plan"}'] == 0.5
+            assert snap['host_bytes{structure="registry_columns"}'] == 276
+            assert snap["host_rss_bytes"] > 0
+            # seconds are per-iteration (cleared); bytes + counter persist
+            rec2 = led.finalize(iteration=8, rounds=4, emit_event=False)
+            assert rec2["seconds"] == {}
+            assert rec2["bytes"]["routing_table"] == 1000
+            led.add_seconds("cohort_plan", 0.5)
+            led.finalize(iteration=9, rounds=4, emit_event=False)
+            snap = reg.snapshot()
+            assert snap[
+                'host_ledger_seconds_total{subsystem="cohort_plan"}'] == 1.0
+            assert led.top_bytes(2) == [("routing_table", 1000),
+                                        ("registry_columns", 276)]
+            led.reset()
+            assert led.bytes() == {} and led.rss_peak_bytes == 0
+        finally:
+            reg.reset()
+
+
+class TestFitScaling:
+    def test_recovers_constant_and_linear_exponents(self):
+        xs = [100, 1000, 10000, 100000]
+        flat = fit_scaling(xs, [3.0, 3.0, 3.0, 3.0])
+        assert abs(flat) < 1e-9                   # O(1) -> slope 0
+        linear = fit_scaling(xs, [2.0 * x for x in xs])
+        assert abs(linear - 1.0) < 1e-9           # O(P) -> slope 1
+        quad = fit_scaling(xs, [x * x for x in xs])
+        assert abs(quad - 2.0) < 1e-9             # O(P^2) -> slope 2
+
+    def test_degenerate_inputs_return_none(self):
+        assert fit_scaling([100], [1.0]) is None          # one point
+        assert fit_scaling([100, 100], [1.0, 2.0]) is None  # x constant
+        assert fit_scaling([100, 1000], [0.0, 0.0]) is None  # y <= 0 dropped
+        assert fit_scaling([100, 1000], [None, 1.0]) is None
+        # zeros are dropped, surviving points still fit
+        e = fit_scaling([100, 1000, 10000], [0.0, 10.0, 100.0])
+        assert abs(e - 1.0) < 1e-9
+
+
+class TestHostscaleRegressAxis:
+    BASE = {"hostscale": {
+        "populations": [100, 1000],
+        "rows": [
+            {"population": 100, "rounds_per_sec": 100.0,
+             "steady_recompiles": 0},
+            {"population": 1000, "rounds_per_sec": 90.0,
+             "steady_recompiles": 0},
+        ],
+        "exp_seconds": {"cohort_plan": 0.1, "registry_writeback": 1.0},
+        "exp_bytes": {"registry_columns": 1.0},
+        "bytes_per_client": {"registry_columns": 100.0},
+    }}
+
+    def test_pass_fail_and_skip_rows(self):
+        import copy
+        from feddrift_tpu.obs.regress import compare
+        ok = compare(copy.deepcopy(self.BASE), self.BASE)
+        hs = {r["metric"]: r for r in ok
+              if r["metric"].startswith("hostscale")}
+        assert hs["hostscale[100].rounds_per_s"]["status"] == "ok"
+        assert hs["hostscale[100].steady_recompiles"]["status"] == "ok"
+        assert hs["hostscale.exp_seconds[cohort_plan]"]["status"] == "ok"
+        assert hs["hostscale.exp_bytes[registry_columns]"]["status"] == "ok"
+        assert hs[
+            "hostscale.bytes_per_client[registry_columns]"]["status"] == "ok"
+
+        bad = copy.deepcopy(self.BASE)
+        row = bad["hostscale"]["rows"][0]
+        row["rounds_per_sec"], row["steady_recompiles"] = 10.0, 1
+        # an O(1) subsystem went O(P); a structure outgrew its ceiling
+        bad["hostscale"]["exp_seconds"]["cohort_plan"] = 1.0
+        bad["hostscale"]["bytes_per_client"]["registry_columns"] = 200.0
+        rows = compare(bad, self.BASE)
+        hs = {r["metric"]: r for r in rows
+              if r["metric"].startswith("hostscale")}
+        assert hs["hostscale[100].rounds_per_s"]["status"] == "regress"
+        assert hs["hostscale[100].steady_recompiles"]["status"] == "regress"
+        assert hs["hostscale.exp_seconds[cohort_plan]"]["status"] == "regress"
+        assert hs["hostscale.exp_seconds[registry_writeback]"][
+            "status"] == "ok"
+        assert hs["hostscale.bytes_per_client[registry_columns]"][
+            "status"] == "regress"
+
+        # exponent unfit on either side, or a missing axis -> skip
+        unfit = copy.deepcopy(self.BASE)
+        unfit["hostscale"]["exp_seconds"]["cohort_plan"] = None
+        rows = compare(unfit, self.BASE)
+        hs = {r["metric"]: r for r in rows
+              if r["metric"].startswith("hostscale")}
+        assert hs["hostscale.exp_seconds[cohort_plan]"]["status"] == "skip"
+        rows = compare({}, self.BASE)
+        hs = {r["metric"]: r for r in rows
+              if r["metric"].startswith("hostscale")}
+        assert hs["hostscale"]["status"] == "skip"
+        # baseline without the axis: no hostscale rows at all, no failure
+        rows = compare(copy.deepcopy(self.BASE), {})
+        assert not any(r["metric"].startswith("hostscale") for r in rows)
+
+    def test_committed_artifact_passes_self_regress(self):
+        from feddrift_tpu.obs.regress import compare, load_bench
+        art = load_bench(os.path.join(os.path.dirname(__file__), "..",
+                                      "HOSTSCALE_r19.json"))
+        rows = compare(art, art)
+        assert all(r["status"] != "regress" for r in rows)
+        hs = [r for r in rows if r["metric"].startswith("hostscale")]
+        assert any(r["metric"].endswith("steady_recompiles") for r in hs)
+        assert any(".exp_seconds[" in r["metric"] for r in hs)
+        assert any(".bytes_per_client[" in r["metric"] for r in hs)
+
+    def test_exponent_tolerance_is_absolute_headroom(self):
+        import copy
+        from feddrift_tpu.obs.regress import compare
+        cand = copy.deepcopy(self.BASE)
+        cand["hostscale"]["exp_seconds"]["cohort_plan"] = 0.29  # within +0.2
+        rows = compare(cand, self.BASE)
+        hs = {r["metric"]: r for r in rows
+              if r["metric"].startswith("hostscale")}
+        assert hs["hostscale.exp_seconds[cohort_plan]"]["status"] == "ok"
+        cand["hostscale"]["exp_seconds"]["cohort_plan"] = 0.31
+        rows = compare(cand, self.BASE)
+        hs = {r["metric"]: r for r in rows
+              if r["metric"].startswith("hostscale")}
+        assert hs["hostscale.exp_seconds[cohort_plan]"]["status"] == "regress"
+
+
+class TestLivePlaneHostColumn:
+    def test_status_snapshot_host_block(self):
+        led = hostprof.ledger()
+        led.set_bytes("routing_table", 5 << 20)
+        led.set_bytes("assign_hist", 1 << 20)
+        try:
+            doc = live.status_snapshot()
+            host = doc["host"]
+            assert host["rss_mb"] and host["rss_mb"] > 0
+            assert host["top_structures"]["routing_table"] == 5 << 20
+        finally:
+            led.reset()
+
+    def test_render_fleet_host_mb_column(self):
+        lanes = {
+            "runner": {"pid": 1, "status": {"iteration": 3},
+                       "metrics": {"host_rss_bytes": 256 << 20}},
+            # no metrics lane: falls back to the /status host block
+            "edge/0": {"pid": 2, "status": {"host": {"rss_mb": 99.5}}},
+        }
+        table = live.render_fleet(lanes)
+        lines = table.splitlines()
+        header = lines[0].split()
+        assert "HOST-MB" in " ".join(header)
+        assert header.index("HOST-MB") == header.index("OUT") + 1
+        runner = [l for l in lines if l.startswith("runner")][0]
+        assert "256.0" in runner
+        edge = [l for l in lines if l.startswith("edge/0")][0]
+        assert "99.5" in edge
