@@ -21,6 +21,7 @@ checkpoint.
     python -m feddrift_tpu regress bench_new.json --baseline BENCH_r05.json
     python -m feddrift_tpu critical_path runs/my-run  # round segment breakdown
     python -m feddrift_tpu fleet 127.0.0.1:7777  # live multi-process ops table
+    python -m feddrift_tpu incident runs/my-run  # post-mortem incident triage
     python -m feddrift_tpu lint feddrift_tpu/  # graftlint static analysis
 
 Logging is configured in exactly one place (obs.setup_logging), driven by
@@ -131,6 +132,17 @@ def _serve_listen(args: argparse.Namespace, buckets: tuple) -> int:
     if args.ops_port is not None:
         from feddrift_tpu.obs import live
         ops = live.OpsServer(port=args.ops_port).start()
+    # black box + incident plane: a replica dying mid-traffic captures a
+    # merged cross-process bundle under <run_dir>/incidents/ (per-replica
+    # flight snapshots pulled over the broker when one is attached)
+    from feddrift_tpu.obs import blackbox
+    from feddrift_tpu.obs import events as obs_events
+    from feddrift_tpu.obs import incident as incident_mod
+    rec = blackbox.configure().attach(obs_events.get_bus())
+    inc = incident_mod.IncidentManager(
+        args.run_dir, recorder=rec).attach(obs_events.get_bus())
+    fe.attach_incidents(inc, client=broker)
+    incident_mod.install_process_hooks(inc)
     fe.start(port=args.listen)
     print(json.dumps({"listening": fe.url,
                       "replicas": fe.replicas.healthy_names()}))
@@ -171,7 +183,34 @@ def _cfg_from_args(args: argparse.Namespace):
     return ExperimentConfig(**d)
 
 
+def _arm_faulthandler(run_dir: str | None = None):
+    """Arm ``faulthandler`` so hard hangs and native crashes (wedged
+    collectives, deadlocked dispatchers, segfaults in XLA) dump Python
+    stacks instead of dying silently. Called once at CLI entry — BEFORE
+    jax/backend init so every verb is diagnosable — and again with a run
+    dir on run/resume to route dumps to ``<run_dir>/faulthandler.log``
+    (``kill -QUIT`` capture lands there too; see obs/incident.py).
+
+    Returns the dump file (kept open for the process lifetime:
+    faulthandler holds the raw fd), or None when dumping to stderr.
+    """
+    import faulthandler
+    import os
+
+    fh = None
+    if run_dir:
+        os.makedirs(run_dir, exist_ok=True)
+        fh = open(os.path.join(run_dir, "faulthandler.log"), "a")
+    try:
+        faulthandler.enable(file=fh if fh is not None else sys.stderr,
+                            all_threads=True)
+    except (ValueError, OSError, AttributeError):
+        pass        # fd-less stderr (pytest capture, embedded interpreters)
+    return fh
+
+
 def main(argv: list[str] | None = None) -> int:
+    _arm_faulthandler()
     parser = argparse.ArgumentParser(prog="feddrift_tpu")
     parser.add_argument("--log_level", type=str, default="info",
                         help="logging level for the feddrift_tpu loggers "
@@ -256,7 +295,23 @@ def main(argv: list[str] | None = None) -> int:
     fl_p.add_argument("--duration", type=float, default=5.0)
     fl_p.add_argument("--poll", type=float, default=0.2)
     fl_p.add_argument("--min-lanes", type=int, default=0)
+    fl_p.add_argument("--stale-after", type=float, default=60.0,
+                      help="evict lanes whose last snapshot is older than "
+                           "this many seconds and mark them (stale) in the "
+                           "table (<= 0 disables; default %(default)ss)")
     fl_p.add_argument("--json", action="store_true")
+
+    inc_p = sub.add_parser(
+        "incident",
+        help="post-mortem triage: render the story from an incident "
+             "bundle — what fired, the dominant critical-path segment, "
+             "recent swaps/canary verdicts with lineage ids, and "
+             "replica/broker health at capture (obs/incident.py; pass a "
+             "bundle dir or a run dir to pick its newest bundle)")
+    inc_p.add_argument("target",
+                       help="incident bundle directory, or a run dir "
+                            "holding <run_dir>/incidents/")
+    inc_p.add_argument("--json", action="store_true")
 
     srv_p = sub.add_parser(
         "serve",
@@ -354,7 +409,8 @@ def main(argv: list[str] | None = None) -> int:
     # --log_level is also accepted after the subcommand for convenience
     # (SUPPRESS default: an absent post-subcommand flag must not clobber a
     # pre-subcommand one — both write the same namespace attribute)
-    for p in (run_p, res_p, rep_p, reg_p, lin_p, cp_p, fl_p, srv_p, li_p):
+    for p in (run_p, res_p, rep_p, reg_p, lin_p, cp_p, fl_p, inc_p, srv_p,
+              li_p):
         p.add_argument("--log_level", type=str, default=argparse.SUPPRESS,
                        help=argparse.SUPPRESS)
 
@@ -409,8 +465,15 @@ def main(argv: list[str] | None = None) -> int:
         return fleet_main(
             [args.broker, "--namespace", args.namespace,
              "--duration", str(args.duration), "--poll", str(args.poll),
-             "--min-lanes", str(args.min_lanes)]
+             "--min-lanes", str(args.min_lanes),
+             "--stale-after", str(args.stale_after)]
             + (["--json"] if args.json else []))
+
+    if args.cmd == "incident":
+        # pure host-side: bundle reading + rendering is stdlib only, no jax
+        from feddrift_tpu.obs.incident import incident_main
+        return incident_main([args.target]
+                             + (["--json"] if args.json else []))
 
     if args.cmd == "lint":
         # pure host-side: the AST engine imports neither jax nor the
@@ -499,6 +562,7 @@ def main(argv: list[str] | None = None) -> int:
         from feddrift_tpu.config import ExperimentConfig
         with open(os.path.join(args.out_dir, "ckpt", "MANIFEST.json")) as f:
             cfg = ExperimentConfig.from_json(json.dumps(json.load(f)["config"]))
+        fh_file = _arm_faulthandler(args.out_dir)
         exp = Experiment.resume(cfg, args.out_dir, use_wandb=args.wandb)
     else:
         cfg = _cfg_from_args(args)
@@ -511,11 +575,20 @@ def main(argv: list[str] | None = None) -> int:
                 f"{cfg.dataset}-{cfg.model}-{cfg.concept_drift_algo}"
                 f"-{cfg.concept_drift_algo_arg}-s{cfg.seed}")
         ckpt = os.path.join(out_dir, "ckpt")
+        fh_file = _arm_faulthandler(out_dir)
         if (getattr(args, "auto_resume", False)
                 and (os.path.isdir(ckpt) or os.path.isdir(ckpt + ".old"))):
             exp = Experiment.resume(cfg, out_dir, use_wandb=args.wandb)
         else:
             exp = Experiment(cfg, use_wandb=args.wandb, out_dir=out_dir)
+
+    if getattr(exp, "incidents", None) is not None:
+        # kill -QUIT now dumps all-thread stacks to faulthandler.log AND
+        # snapshots an incident bundle; uncaught exceptions in other
+        # threads (sys.excepthook chain) get a bundle too
+        from feddrift_tpu.obs import incident as incident_mod
+        incident_mod.install_process_hooks(exp.incidents,
+                                           faulthandler_file=fh_file)
 
     exp.run()
     print(json.dumps({"Test/Acc": exp.logger.last("Test/Acc"),

@@ -337,6 +337,17 @@ class ExperimentConfig:
     canary_fraction: float = 0.0
     canary_min_samples: int = 32
     canary_acc_margin: float = 0.02
+    # --- incident plane (obs/blackbox.py, obs/incident.py;
+    # docs/OBSERVABILITY.md "Incident plane") ---------------------------
+    # Always-on flight recorder (bounded in-memory rings over recent
+    # events/alerts/round_breakdowns) + automatic incident bundles under
+    # <run_dir>/incidents/ on crit alerts, SLO burns, replica deaths,
+    # secure-agg degradation, divergence aborts, preemption, unhandled
+    # exceptions and SIGQUIT. Triage: python -m feddrift_tpu incident.
+    incident_capture: bool = True
+    incident_ring: int = 512            # flight-recorder capacity (records)
+    incident_debounce_s: float = 30.0   # min seconds between bundles
+    incident_max_bundles: int = 8       # oldest bundles pruned past this
 
     def __post_init__(self) -> None:
         if self.population_size == 0 \
@@ -434,6 +445,12 @@ class ExperimentConfig:
             raise ValueError("canary_min_samples must be >= 1")
         if not 0.0 <= self.canary_acc_margin <= 1.0:
             raise ValueError("canary_acc_margin must be in [0, 1]")
+        if self.incident_ring < 8:
+            raise ValueError("incident_ring must be >= 8 records")
+        if self.incident_debounce_s < 0:
+            raise ValueError("incident_debounce_s must be >= 0")
+        if self.incident_max_bundles < 1:
+            raise ValueError("incident_max_bundles must be >= 1")
         if self.hierarchy_edges < 0:
             raise ValueError("hierarchy_edges must be >= 0")
         if self.hierarchy_edges > 0:

@@ -43,6 +43,13 @@ were all invisible. This package is the missing observability layer:
   ``FleetCollector`` merge over the broker (CLI: ``python -m
   feddrift_tpu fleet <broker>``), and an SLO engine whose error-budget
   burn-rate rules emit ``slo_burn`` events on the live tap.
+- ``obs.blackbox``    — the always-on flight recorder: bounded
+  in-memory rings over recent events/alerts/round_breakdowns plus
+  periodic instrument snapshots, dumped into incident bundles.
+- ``obs.incident``    — the incident plane: trigger taps (crit alerts,
+  SLO burns, replica deaths, preemption, exceptions, SIGQUIT) debounced
+  into self-contained forensic bundles under ``<run_dir>/incidents/``
+  (CLI: ``python -m feddrift_tpu incident <bundle-or-run_dir>``).
 
 Event kinds are a CLOSED set (``events.EVENT_KINDS``): ``emit()`` rejects
 unknown kinds, and ``scripts/check_events_schema.py`` statically checks that
@@ -69,8 +76,10 @@ from feddrift_tpu.obs.instruments import (  # noqa: F401
 )
 from feddrift_tpu.obs import (  # noqa: F401
     alerts,
+    blackbox,
     costmodel,
     hostprof,
+    incident,
     lineage,
     live,
     quantiles,

@@ -193,10 +193,12 @@ class AlertMonitor:
     runs on whatever thread emitted — runner main, broker background)."""
 
     def __init__(self, rules: Optional[list[Rule]] = None,
-                 path: Optional[str] = None, bus=None) -> None:
+                 path: Optional[str] = None, bus=None,
+                 max_bytes: int = 0) -> None:
         import collections
         self.rules = rules if rules is not None else default_rules()
         self.path = path
+        self.max_bytes = int(max_bytes)   # alerts.jsonl size cap (0 = off)
         self.bus = bus
         self.state: dict[str, Any] = {}       # rule scratch (best_ari, ...)
         self.alerts: list[dict] = []          # every raised record
@@ -265,16 +267,48 @@ class AlertMonitor:
         except Exception:
             pass
         if self.path:
-            append_alert(self.path, rec)
+            append_alert(self.path, rec, max_bytes=self.max_bytes)
 
 
-def append_alert(path: str, rec: dict) -> None:
+# per-path rotation generation counters for the append_alert size cap
+# (the sink is open-append-close, so generation state lives here, not on
+# a file handle like the events/spans sinks)
+_rotations: dict[str, int] = {}
+_rot_lock = threading.Lock()
+
+
+def append_alert(path: str, rec: dict, max_bytes: int = 0) -> None:
     """Append one record to an alerts.jsonl sink (open-append-close, so
     concurrent writers — the alert monitor and the SLO engine in
-    obs/live.py — interleave whole lines, never partial ones)."""
+    obs/live.py — interleave whole lines, never partial ones).
+
+    ``max_bytes`` > 0 applies the same size-cap rotation events/spans
+    get (``cfg.obs_max_file_mb``): when the write pushes the file past
+    the cap it rotates to ``<path>.1`` (one generation kept) with a loud
+    ``obs_rotated`` event — a long-running service with a flapping rule
+    must not grow alerts.jsonl unboundedly."""
     os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
-    with open(path, "a") as f:
-        f.write(json.dumps(rec, default=_json_default) + "\n")
+    rotated_bytes = generation = 0
+    with _rot_lock:
+        with open(path, "a") as f:
+            f.write(json.dumps(rec, default=_json_default) + "\n")
+            if max_bytes and f.tell() >= max_bytes:
+                rotated_bytes = f.tell()
+        if rotated_bytes:
+            try:
+                os.replace(path, path + ".1")
+            except OSError:
+                rotated_bytes = 0
+            else:
+                generation = _rotations[path] = _rotations.get(path, 0) + 1
+    if rotated_bytes:
+        from feddrift_tpu.obs import events as _events
+        try:
+            _events.emit("obs_rotated", file=os.path.basename(path),
+                         rotated_bytes=rotated_bytes,
+                         generation=generation)
+        except Exception:   # noqa: BLE001 — observability stays passive
+            pass
 
 
 def _json_default(o):

@@ -132,6 +132,9 @@ EVENT_KINDS = frozenset({
     "share_dropped",        # share lost/late/corrupt -> contributor/holder masked
     "secure_reconstructed",  # masked sum decoded from surviving shares
     "secure_degraded",      # survivors below threshold: prev params kept
+    # incident plane (obs/blackbox.py, obs/incident.py)
+    "incident_captured",    # a trigger debounced into a written incident bundle
+    "flight_dump",          # flight-recorder rings serialized into a bundle
 })
 
 RING_SIZE = 4096

@@ -277,11 +277,13 @@ class SLOEngine:
 
     def __init__(self, objectives: Optional[list] = None,
                  path: Optional[str] = None, bus=None,
-                 time_fn: Callable[[], float] = time.time) -> None:
+                 time_fn: Callable[[], float] = time.time,
+                 max_bytes: int = 0) -> None:
         import collections
         self.objectives = objectives if objectives is not None \
             else default_slos()
         self.path = path
+        self.max_bytes = int(max_bytes)   # alerts.jsonl size cap (0 = off)
         self.bus = bus
         self._time = time_fn
         self._lock = threading.RLock()
@@ -359,7 +361,8 @@ class SLOEngine:
         except Exception:
             pass
         if self.path:
-            obs_alerts.append_alert(self.path, burn)
+            obs_alerts.append_alert(self.path, burn,
+                                    max_bytes=self.max_bytes)
         log.warning("SLO burn: %s (observed=%s objective=%s, %d/%d "
                     "window violations)", summary["slo"],
                     summary["observed"], summary["objective"],
@@ -629,6 +632,45 @@ def announce_topic(namespace: str) -> str:
     return f"{namespace}/ops/announce"
 
 
+# --- ops/incident lane (obs/blackbox.py, obs/incident.py) -------------
+# Request/response over the same broker the snapshots ride: a collector
+# publishes a pull on ``<ns>/ops/incident/pull``; every publisher armed
+# with a ``flight_fn`` answers on its own ``<ns>/ops/incident/<lane>``
+# with a flight-recorder ring snapshot. The frontend uses this to merge
+# per-replica black boxes into ONE bundle when a replica dies.
+def incident_topic(namespace: str, lane: str) -> str:
+    return f"{namespace}/ops/incident/{lane}"
+
+
+def incident_pull_topic(namespace: str) -> str:
+    return f"{namespace}/ops/incident/pull"
+
+
+def pull_flights(client, lanes, namespace: str = OPS_NAMESPACE,
+                 timeout_s: float = 3.0, poll_s: float = 0.1) -> dict:
+    """Pull per-process flight snapshots from ``lanes`` over the
+    ops/incident lane; returns ``{lane: payload}`` for every lane that
+    answered within ``timeout_s`` (dead processes simply stay absent —
+    their silence is itself evidence)."""
+    lanes = sorted(set(lanes))
+    qs = {lane: client.subscribe(incident_topic(namespace, lane))
+          for lane in lanes}
+    client.publish(incident_pull_topic(namespace),
+                   json.dumps({"want": lanes}))
+    out: dict[str, dict] = {}
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline and len(out) < len(lanes):
+        for lane, q in qs.items():
+            for raw in FleetCollector._drain(q):
+                try:
+                    out[lane] = json.loads(raw)
+                except ValueError:
+                    continue
+        if len(out) < len(lanes):
+            time.sleep(poll_s)
+    return out
+
+
 class OpsPublisher:
     """Publishes this process's snapshot on ``<ns>/ops/<lane>`` every
     ``interval_s`` (daemon thread), announcing the lane on
@@ -640,7 +682,8 @@ class OpsPublisher:
                  namespace: str = OPS_NAMESPACE, interval_s: float = 2.0,
                  reg=None, slo: Optional[SLOEngine] = None,
                  board: Optional[StatusBoard] = None,
-                 extra_fn: Optional[Callable[[], dict]] = None) -> None:
+                 extra_fn: Optional[Callable[[], dict]] = None,
+                 flight_fn: Optional[Callable[[], dict]] = None) -> None:
         self.client = client
         self.lane = lane
         self.namespace = namespace
@@ -649,6 +692,10 @@ class OpsPublisher:
         self.slo = slo
         self.board = board
         self.extra_fn = extra_fn
+        # ops/incident lane: answer flight-snapshot pulls with this
+        # payload (None = lane not armed, no extra subscription)
+        self.flight_fn = flight_fn
+        self._pull_q = None
         self.seq = 0
         self._closed = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -676,11 +723,49 @@ class OpsPublisher:
                       board=self.board)
         return snap
 
+    def _answer_pulls(self) -> None:
+        """Answer any queued ops/incident pull with one flight-snapshot
+        publish on this lane's incident topic."""
+        if self.flight_fn is None or self._pull_q is None:
+            return
+        if not FleetCollector._drain(self._pull_q):
+            return
+        import os as _os
+        try:
+            payload = {"lane": self.lane, "pid": _os.getpid(),
+                       "ts": round(time.time(), 3),
+                       "seq": self.seq,
+                       "flight": self.flight_fn()}
+        except Exception:   # noqa: BLE001 — a failing dump never kills
+            return          # the publisher thread
+        try:
+            self.client.publish(
+                incident_topic(self.namespace, self.lane),
+                json.dumps(payload, default=obs_alerts._json_default))
+        except (OSError, RuntimeError):
+            pass                        # dead bare client; pull re-asks
+
     def _loop(self) -> None:
-        while not self._closed.wait(self.interval_s):
-            self.publish_now()
+        # with the incident lane armed, wake often enough that a pull is
+        # answered well inside pull_flights' timeout; snapshots still
+        # publish on the configured cadence
+        wake = min(self.interval_s, 0.25) if self.flight_fn is not None \
+            else self.interval_s
+        elapsed = 0.0
+        while not self._closed.wait(wake):
+            self._answer_pulls()
+            elapsed += wake
+            if elapsed + 1e-9 >= self.interval_s:
+                self.publish_now()
+                elapsed = 0.0
 
     def start(self) -> "OpsPublisher":
+        if self.flight_fn is not None and self._pull_q is None:
+            try:
+                self._pull_q = self.client.subscribe(
+                    incident_pull_topic(self.namespace))
+            except (OSError, RuntimeError):
+                self._pull_q = None
         self._thread = threading.Thread(
             target=self._loop, daemon=True,
             name=f"ops-publisher:{self.lane}")
@@ -749,6 +834,16 @@ class FleetCollector:
             time.sleep(poll_s)
         return self.poll()
 
+    def pull_flights(self, lanes=None, timeout_s: float = 3.0) -> dict:
+        """The ops/incident lane: pull per-process flight-recorder
+        snapshots from ``lanes`` (default: every lane this collector
+        has seen announce). Lanes that stay silent are absent from the
+        result — a dead process cannot answer."""
+        self.poll()
+        return pull_flights(self.client,
+                            lanes if lanes is not None else self.lanes,
+                            namespace=self.namespace, timeout_s=timeout_s)
+
 
 def _fmt(v, nd=3) -> str:
     if v is None:
@@ -778,14 +873,31 @@ def _sketch_q(snap: dict, name: str, q: str):
     return None
 
 
-def render_fleet(lanes: dict) -> str:
-    """The merged multi-process table the ``fleet`` CLI verb prints."""
-    cols = ("LANE", "PID", "ITER", "ROUNDS/S", "P99 WALL", "BYTES OUT",
-            "HOST-MB", "STRAGGLERS", "RECONNECTS", "REQ/S", "P99-REQ",
-            "POOL-VER", "CANARY", "ALERTS", "HEALTH")
+def render_fleet(lanes: dict, stale_after: Optional[float] = None,
+                 now: Optional[float] = None) -> str:
+    """The merged multi-process table the ``fleet`` CLI verb prints.
+
+    ``stale_after`` (seconds) evicts lanes whose last snapshot is older
+    than the bound: instead of rendering a frozen snapshot as if it were
+    live, the lane collapses to an AGE + a loud ``(stale)`` marker. The
+    AGE column always shows seconds since each lane's last snapshot
+    ``ts`` (``-`` when the snapshot predates the ts field)."""
+    cols = ("LANE", "PID", "AGE", "ITER", "ROUNDS/S", "P99 WALL",
+            "BYTES OUT", "HOST-MB", "STRAGGLERS", "RECONNECTS", "REQ/S",
+            "P99-REQ", "POOL-VER", "CANARY", "ALERTS", "HEALTH")
+    now = time.time() if now is None else now
     rows = []
     for lane in sorted(lanes):
         snap = lanes[lane]
+        ts = snap.get("ts")
+        age = max(now - ts, 0.0) if isinstance(ts, (int, float)) else None
+        if stale_after is not None and age is not None \
+                and age > stale_after:
+            # evicted: no frozen metrics, just the lane, its age and the
+            # loud marker — silent freshness is the failure mode here
+            rows.append((lane, _fmt(snap.get("pid")), f"{age:.0f}s",
+                         *("-",) * 12, "(stale)"))
+            continue
         st = snap.get("status") or {}
         health = snap.get("health") or {}
         extra = snap.get("extra") or {}
@@ -801,6 +913,7 @@ def render_fleet(lanes: dict) -> str:
         rows.append((
             lane,
             _fmt(snap.get("pid")),
+            f"{age:.0f}s" if age is not None else "-",
             _fmt(st.get("iteration")),
             _fmt(st.get("rounds_per_s")),
             _fmt(_sketch_q(snap, "round_wall_seconds_q", "0.99"), 4),
@@ -840,6 +953,10 @@ def fleet_main(argv=None) -> int:
     ap.add_argument("--poll", type=float, default=0.2)
     ap.add_argument("--min-lanes", type=int, default=0,
                     help="return as soon as this many lanes reported")
+    ap.add_argument("--stale-after", type=float, default=60.0,
+                    help="seconds after which a silent lane renders as "
+                         "(stale) instead of its frozen last snapshot "
+                         "(default 60; <= 0 disables)")
     ap.add_argument("--json", action="store_true",
                     help="print merged snapshots as JSON instead")
     args = ap.parse_args(argv)
@@ -858,5 +975,7 @@ def fleet_main(argv=None) -> int:
         print(json.dumps(lanes, indent=2,
                          default=obs_alerts._json_default))
     else:
-        print(render_fleet(lanes))
+        print(render_fleet(
+            lanes,
+            stale_after=args.stale_after if args.stale_after > 0 else None))
     return 0 if lanes else 1

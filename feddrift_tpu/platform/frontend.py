@@ -567,6 +567,8 @@ class ServingFrontend:
         self.port: int | None = None
         self._broker_stop = threading.Event()
         self._broker_threads: list[threading.Thread] = []
+        self._incidents = None          # obs/incident.py manager, armed
+        #                                 by attach_incidents()
 
     # -- the shared core ------------------------------------------------
     def submit(self, client_id, x, timeout: float | None = None,
@@ -761,6 +763,59 @@ class ServingFrontend:
         for eng in self.replicas.engines:
             eng.attach_ops(client, lane=f"{lane_prefix}/{eng.name}",
                            interval_s=interval_s)
+        return self
+
+    # -- incident plane -------------------------------------------------
+    def attach_incidents(self, manager, client=None,
+                         namespace: str | None = None,
+                         lane_prefix: str = "serve",
+                         pull_timeout_s: float = 3.0) -> "ServingFrontend":
+        """Arm MERGED cross-process incident capture: when a replica
+        dies mid-traffic (``replica_drained``/``replica_failed`` reaches
+        the attached ``IncidentManager``), the bundle additionally pulls
+        every replica's flight-recorder snapshot over the fleet plane's
+        ops/incident lane (``client`` given; see ``attach_ops`` for the
+        matching lane names) and names the dead replicas in meta.json.
+        Replicas that cannot answer the pull fall back to their
+        in-process engine stats, so the bundle always attributes the
+        death even on a half-dead fleet."""
+        from feddrift_tpu.obs.live import OPS_NAMESPACE, pull_flights
+        ns = namespace if namespace is not None else OPS_NAMESPACE
+
+        def fleet_source(reason: str, evidence) -> dict | None:
+            if not reason.startswith("replica"):
+                return None
+            dead = self.replicas.drained_names()
+            if isinstance(evidence, dict) and evidence.get("replica"):
+                dead.setdefault(str(evidence["replica"]),
+                                str(evidence.get("reason") or reason))
+            lanes: dict[str, dict] = {}
+            names = [e.name for e in self.replicas.engines]
+            if client is not None:
+                try:
+                    lanes = pull_flights(
+                        client, [f"{lane_prefix}/{n}" for n in names],
+                        namespace=ns, timeout_s=pull_timeout_s)
+                except Exception:   # noqa: BLE001 — broker may be down
+                    lanes = {}
+            for eng in self.replicas.engines:
+                lane = f"{lane_prefix}/{eng.name}"
+                if lane in lanes:
+                    continue
+                try:
+                    lanes[lane] = {"replica": eng.name,
+                                   "stats": eng.stats(),
+                                   "failed": (repr(eng.failed)
+                                              if eng.failed else None),
+                                   "pulled": False}
+                except Exception:   # noqa: BLE001 — a dying engine's
+                    lanes[lane] = {"replica": eng.name,  # stats may raise
+                                   "pulled": False}
+            return {"dead": sorted(dead), "lanes": lanes,
+                    "drain_reasons": dead}
+
+        manager.fleet_source = fleet_source
+        self._incidents = manager
         return self
 
     # -- lifecycle ------------------------------------------------------

@@ -990,10 +990,22 @@ class InferenceEngine:
                                   "labeled": self.quality.labeled}
             return out
 
+        def flight() -> dict:
+            # ops/incident lane payload: this replica's stats + a
+            # bounded tail of the process flight recorder, so a frontend
+            # can merge per-replica black boxes into one bundle
+            # (obs/incident.py) when a replica dies mid-traffic
+            from feddrift_tpu.obs.blackbox import get_flight_recorder
+            out = {"replica": self.name, "stats": self.stats(),
+                   "failed": repr(self.failed) if self.failed else None}
+            out["flight"] = get_flight_recorder().dump(
+                events_limit=128, include_instruments=False)
+            return out
+
         self._ops = OpsPublisher(
             client, lane if lane is not None else f"serve/{os.getpid()}",
             interval_s=interval_s, slo=slo, board=board,
-            extra_fn=extra).start()
+            extra_fn=extra, flight_fn=flight).start()
         return self
 
     # -- diagnostics ----------------------------------------------------

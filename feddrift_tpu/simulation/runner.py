@@ -236,6 +236,7 @@ class Experiment:
                     churn_window=cfg.alert_window),
                 path=os.path.join(out_dir, "alerts.jsonl")
                 if (out_dir and self.is_coordinator) else None,
+                max_bytes=obs_cap,
             ).attach(self.events)
         # Live ops plane (obs/live.py): SLO burn-rate engine on the event
         # tap, plus the /metrics + /healthz + /status HTTP server when
@@ -255,6 +256,7 @@ class Experiment:
                 objectives=obs.live.default_slos(**slo_thresholds),
                 path=os.path.join(out_dir, "alerts.jsonl")
                 if (out_dir and self.is_coordinator) else None,
+                max_bytes=obs_cap,
             ).attach(self.events)
         if self._ops_active:
             obs.live.status_board().reset()
@@ -262,6 +264,26 @@ class Experiment:
             self.ops = obs.live.OpsServer(
                 port=max(cfg.ops_port, 0),   # -1 -> ephemeral bind
                 slo=self.slo).start()
+        # Incident plane (obs/blackbox.py, obs/incident.py): always-on
+        # flight recorder over the event stream + debounced bundle
+        # capture on the trigger set (crit alerts, SLO burns, replica
+        # deaths, secure degradation, preemption, divergence aborts via
+        # run()'s exception guard). Every process records; only the
+        # coordinator writes bundles, like every other sink here.
+        self.flight = self.incidents = None
+        if cfg.incident_capture:
+            self.flight = obs.blackbox.configure(
+                capacity=cfg.incident_ring).attach(self.events)
+            self.incidents = obs.incident.IncidentManager(
+                run_dir=out_dir if (out_dir and self.is_coordinator)
+                else None,
+                recorder=self.flight,
+                debounce_s=cfg.incident_debounce_s,
+                max_bundles=cfg.incident_max_bundles,
+                config_json=cfg.to_json(),
+                ckpt_path=os.path.join(out_dir, "ckpt") if out_dir
+                else None,
+            ).attach(self.events)
         self.algo.bind(self.x, self.y, self.logger, self.C_pad)
         # Population-scale participation (platform/registry.py,
         # resilience/participation.py): host-side registry of every
@@ -894,6 +916,10 @@ class Experiment:
             wall / max(cfg.comm_round, 1))
         reg.quantile_sketch("dispatch_gap_seconds_q").observe(gap)
         self._ledger.finalize(iteration=t, rounds=cfg.comm_round)
+        if self.flight is not None:
+            # ring one instrument snapshot per iteration: the black box
+            # keeps recent metric state, not just the event stream
+            self.flight.snapshot_instruments()
         obs.costmodel.record_hbm_watermark(iteration=t)
         if self._ops_active and t % cfg.ops_snapshot_every == 0:
             obs.live.emit_snapshot("runner", seq=t, slo=self.slo)
@@ -1737,6 +1763,8 @@ class Experiment:
         reg.quantile_sketch("dispatch_gap_seconds_q").observe(
             gap / committed)
         self._ledger.finalize(iteration=last_t, rounds=committed * R)
+        if self.flight is not None:
+            self.flight.snapshot_instruments()
         obs.costmodel.record_hbm_watermark(iteration=last_t)
         if self._ops_active and last_t % cfg.ops_snapshot_every == 0:
             obs.live.emit_snapshot("runner", seq=last_t, slo=self.slo)
@@ -1752,28 +1780,38 @@ class Experiment:
         from feddrift_tpu.resilience.preempt import PreemptionHandler
         with self.logger, self.events:
             with PreemptionHandler(enabled=self.cfg.preempt_signals) as pre:
-                t = self.start_iteration
-                while t < self.cfg.train_iterations:
-                    # greedy megastep fusion: K > 1 runs whole blocks of
-                    # drift-decision-free time steps as one dispatch; K = 1
-                    # is the historical per-iteration path, bit for bit
-                    K = self._megastep_span(t)
-                    if K > 1:
-                        t += self.run_megastep(t, K)
-                    else:
-                        self.run_iteration(t)
-                        t += 1
-                    if self.sanitizer is not None:
-                        # raises past the steady-state recompile budget;
-                        # the first block's warm-up compiles don't count
-                        self.sanitizer.check()
-                        self.sanitizer.mark_steady()
-                    if pre.requested:
-                        # preemption: the block ending at t-1 just
-                        # completed — persist it and exit cleanly;
-                        # --auto_resume continues here
-                        self._preempt_stop(t - 1, pre.signal_name)
-                        break
+                try:
+                    t = self.start_iteration
+                    while t < self.cfg.train_iterations:
+                        # greedy megastep fusion: K > 1 runs whole blocks
+                        # of drift-decision-free time steps as one
+                        # dispatch; K = 1 is the historical
+                        # per-iteration path, bit for bit
+                        K = self._megastep_span(t)
+                        if K > 1:
+                            t += self.run_megastep(t, K)
+                        else:
+                            self.run_iteration(t)
+                            t += 1
+                        if self.sanitizer is not None:
+                            # raises past the steady-state recompile
+                            # budget; the first block's warm-up compiles
+                            # don't count
+                            self.sanitizer.check()
+                            self.sanitizer.mark_steady()
+                        if pre.requested:
+                            # preemption: the block ending at t-1 just
+                            # completed — persist it and exit cleanly;
+                            # --auto_resume continues here
+                            self._preempt_stop(t - 1, pre.signal_name)
+                            break
+                except Exception as err:
+                    # abnormal termination — divergence aborts included:
+                    # capture the black box while the bus and file sinks
+                    # are still open, then propagate unchanged
+                    if self.incidents is not None:
+                        self.incidents.on_exception(err)
+                    raise
             self.events.emit("run_end", global_round=self.global_round,
                              test_acc=self.logger.last("Test/Acc"),
                              preempted=self.preempted)

@@ -67,6 +67,12 @@
 #    secure_reconstructed in events.jsonl), the opened sum matches the
 #    plaintext reference of the included contributors within fixed-point
 #    quantization tolerance, and a degraded round can never hang.
+# 15) incident plane — the stage-13 scenario re-run with the black box
+#    armed: a replica crash mid-traffic AUTO-captures ONE merged incident
+#    bundle (debounced across the replica_failed/replica_drained storm)
+#    holding per-replica flight snapshots pulled over the ops/incident
+#    lane; the `incident` triage CLI then attributes the dead replica
+#    (DEAD REPLICAS: r0) and exits 0.
 #
 # Usage: scripts/chaos_smoke.sh            (~2-3 min on one CPU core)
 set -euo pipefail
@@ -77,12 +83,12 @@ OUT=$(mktemp -d)
 trap 'rm -rf "$OUT"' EXIT
 RUN="$OUT/run"
 
-echo "== [1/14] chaos transport e2e (drop_prob=0.2 + broker kill/restart) =="
+echo "== [1/15] chaos transport e2e (drop_prob=0.2 + broker kill/restart) =="
 timeout -k 10 300 python -m pytest tests/test_resilience.py -q \
     -p no:cacheprovider -p no:randomly \
     -k "ChaosEndToEnd or survives_broker_kill or heartbeat_missed"
 
-echo "== [2/14] preemption: SIGTERM a real run, then --auto_resume =="
+echo "== [2/15] preemption: SIGTERM a real run, then --auto_resume =="
 ARGS=(--dataset sine --model fnn --concept_drift_algo win-1
       --concept_num 2 --client_num_in_total 4 --client_num_per_round 4
       --train_iterations 6 --comm_round 8 --epochs 2
@@ -119,15 +125,15 @@ print(f"resume OK: {len(rows)} metric rows, final Test/Acc="
       f"{rows[-1]['Test/Acc']:.4f}")
 EOF
 
-echo "== [3/14] event taxonomy consistency (strict: no dead kinds) =="
+echo "== [3/15] event taxonomy consistency (strict: no dead kinds) =="
 python scripts/check_events_schema.py --strict
 
-echo "== [4/14] byzantine smoke: trimmed_mean defends where mean fails =="
+echo "== [4/15] byzantine smoke: trimmed_mean defends where mean fails =="
 timeout -k 10 300 python -m pytest tests/test_robust_agg.py -q \
     -p no:cacheprovider -p no:randomly \
     -k "trimmed_mean_defends_where_mean_fails"
 
-echo "== [5/14] decision observability: kill clients -> alerts + lineage =="
+echo "== [5/15] decision observability: kill clients -> alerts + lineage =="
 LRUN="$OUT/lineage-run"
 timeout -k 10 300 python - "$LRUN" <<'EOF'
 import sys
@@ -161,7 +167,7 @@ python -m feddrift_tpu report "$LRUN" > "$OUT/report.txt"
 grep -q "alerts:" "$OUT/report.txt" \
     || { echo "report missing alerts section"; exit 1; }
 
-echo "== [6/14] participation: 10^3 population, 20% stragglers + churn =="
+echo "== [6/15] participation: 10^3 population, 20% stragglers + churn =="
 PRUN="$OUT/population-run"
 timeout -k 10 300 python -m feddrift_tpu run \
     --dataset sea --model fnn --concept_drift_algo softcluster \
@@ -180,7 +186,7 @@ python -m feddrift_tpu report "$PRUN" > "$OUT/preport.txt"
 grep -q "participation:" "$OUT/preport.txt" \
     || { echo "report missing participation section"; exit 1; }
 
-echo "== [7/14] fused participation: megastep_k=4 kill -> resume, same cohorts =="
+echo "== [7/15] fused participation: megastep_k=4 kill -> resume, same cohorts =="
 FREF="$OUT/fused-ref"
 FRUN="$OUT/fused-run"
 FARGS=(--dataset sea --model fnn --concept_drift_algo oblivious
@@ -238,7 +244,7 @@ print(f"fused resume OK: {len(c_ref)} iterations, identical cohort "
       f"schedule, {len(rows)} metric rows")
 EOF
 
-echo "== [8/14] hierarchy: 10^3 population, kill edge 0 mid-run =="
+echo "== [8/15] hierarchy: 10^3 population, kill edge 0 mid-run =="
 HRUN="$OUT/hierarchy-run"
 timeout -k 10 300 python -m feddrift_tpu run \
     --dataset sea --model fnn --concept_drift_algo softcluster \
@@ -276,12 +282,12 @@ grep -q "hierarchy:" "$OUT/hreport.txt" \
 grep -q "re-homed:" "$OUT/hreport.txt" \
     || { echo "report missing re-homed line"; exit 1; }
 
-echo "== [9/14] causal trace continuity across broker reconnect =="
+echo "== [9/15] causal trace continuity across broker reconnect =="
 timeout -k 10 300 python -m pytest tests/test_causal_trace.py -q \
     -p no:cacheprovider -p no:randomly \
     -k "trace_survives_broker_reconnect"
 
-echo "== [10/14] live ops plane: broker kill -> /healthz 503 + slo_burn -> recovery =="
+echo "== [10/15] live ops plane: broker kill -> /healthz 503 + slo_burn -> recovery =="
 ORUN="$OUT/ops-run"
 mkdir -p "$ORUN"
 timeout -k 10 300 python - "$ORUN" <<'EOF'
@@ -349,7 +355,7 @@ print(f"  recovery OK: /healthz {code} {doc['status']}, "
 client.close(); srv.close(); broker2.close()
 EOF
 
-echo "== [11/14] serving: broker kill mid-traffic -> degrade, swaps resume =="
+echo "== [11/15] serving: broker kill mid-traffic -> degrade, swaps resume =="
 SRUN="$OUT/serve-run"
 mkdir -p "$SRUN"
 timeout -k 10 300 python - "$SRUN" <<'EOF'
@@ -473,7 +479,7 @@ print(f"  recovery OK: {stats['served']} served total, 0 errors, "
       f"pool version {stats['version']}")
 EOF
 
-echo "== [12/14] canary: corrupt candidate mid-swap -> rollback + crit alert, 0 errors =="
+echo "== [12/15] canary: corrupt candidate mid-swap -> rollback + crit alert, 0 errors =="
 CRUN="$OUT/canary-run"
 mkdir -p "$CRUN"
 timeout -k 10 300 python - "$CRUN" <<'EOF'
@@ -559,7 +565,7 @@ print(f"  rollback OK: shadow_acc={v['shadow_acc']} vs "
       f"{served[0]} requests served, 0 errors")
 EOF
 
-echo "== [13/14] frontend: kill 1 of 2 replicas mid-traffic -> 0 admitted failures, survivor lane lives =="
+echo "== [13/15] frontend: kill 1 of 2 replicas mid-traffic -> 0 admitted failures, survivor lane lives =="
 FRUN="$OUT/frontend-run"
 mkdir -p "$FRUN"
 timeout -k 10 300 python - "$FRUN" <<'EOF'
@@ -678,7 +684,7 @@ print(f"  failover OK: {served[0]} served ({sheds[0]} explicit sheds), "
       f"0 admitted failures, retries={st['retries']}, survivor r1")
 EOF
 
-echo "== [14/14] secure agg: SIGKILL a share-holder mid-protocol + corrupt one share =="
+echo "== [14/15] secure agg: SIGKILL a share-holder mid-protocol + corrupt one share =="
 SECRUN="$OUT/secure-run"
 mkdir -p "$SECRUN"
 timeout -k 10 300 python - "$SECRUN" <<'EOF'
@@ -767,5 +773,122 @@ print(f"  secure round OK: included={res.included} "
       f"holders_alive={res.holders_alive} max_err={res.max_abs_err:.2e} "
       f"dropped={res.shares_dropped}")
 EOF
+
+echo "== [15/15] incident plane: kill 1 of 2 replicas mid-traffic -> merged bundle + triage CLI =="
+IRUN="$OUT/incident-run"
+mkdir -p "$IRUN"
+timeout -k 10 300 python - "$IRUN" <<'EOF'
+import json, os, sys, threading, time
+import numpy as np
+import jax.numpy as jnp
+from feddrift_tpu import obs
+from feddrift_tpu.comm.netbroker import NetworkBroker, NetworkBrokerClient
+from feddrift_tpu.config import ExperimentConfig
+from feddrift_tpu.core.pool import ModelPool
+from feddrift_tpu.data.registry import make_dataset
+from feddrift_tpu.models import create_model
+from feddrift_tpu.obs import blackbox
+from feddrift_tpu.obs import incident as incident_mod
+from feddrift_tpu.platform.faults import ReplicaFaultInjector
+from feddrift_tpu.platform.frontend import (AdmissionController,
+                                            FrontendClient, ServingFrontend,
+                                            build_replica_set)
+from feddrift_tpu.platform.serving import EngineOverloaded, RoutingTable
+
+out = sys.argv[1]
+bus = obs.configure(os.path.join(out, "events.jsonl"))
+rec = blackbox.configure(capacity=256).attach(bus)
+inc = incident_mod.IncidentManager(out, recorder=rec,
+                                   debounce_s=5.0).attach(bus)
+
+cfg = ExperimentConfig(dataset="sea", train_iterations=2, sample_num=16)
+ds = make_dataset(cfg)
+pool = ModelPool.create(create_model("fnn", ds, cfg),
+                        jnp.asarray(ds.x[0, 0, :2]), 2, seed=7,
+                        identical=False)
+rs = build_replica_set(pool, RoutingTable([0] * 8), n=2, buckets=(1, 2, 4),
+                       max_queue=64, stall_after_s=2.0,
+                       health_interval_s=0.05)
+inj = ReplicaFaultInjector(mode="crash", after_batches=12, seed=3)
+inj.arm(rs.engines[0])
+
+fe = ServingFrontend(rs, admission=AdmissionController(max_pending=64))
+broker = NetworkBroker()
+# per-replica fleet lanes armed with flight_fn: each replica can answer
+# the ops/incident pull with its own ring snapshot
+fe.attach_ops(NetworkBrokerClient(broker.host, broker.port, timeout=2.0),
+              interval_s=0.2)
+fe.attach_incidents(
+    inc, client=NetworkBrokerClient(broker.host, broker.port, timeout=2.0),
+    pull_timeout_s=2.0)
+fe.start(port=0)
+cli = FrontendClient(f"http://{fe.host}:{fe.port}", timeout=10.0)
+
+stop = threading.Event()
+served = [0]
+def pump(w):
+    rng = np.random.RandomState(w)
+    while not stop.is_set():
+        try:
+            cli.submit(int(rng.randint(8)),
+                       rng.standard_normal(3).astype(np.float32))
+            served[0] += 1
+        except EngineOverloaded:
+            time.sleep(0.01)
+        except Exception:
+            time.sleep(0.01)
+pumps = [threading.Thread(target=pump, args=(w,), daemon=True)
+         for w in range(4)]
+for t in pumps:
+    t.start()
+
+def wait_for(pred, what, timeout_s=60.0):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if pred():
+            return
+        time.sleep(0.05)
+    raise AssertionError(f"timed out waiting for {what}")
+
+wait_for(lambda: rs.engines[0].failed is not None, "armed crash to fire")
+wait_for(lambda: rs.healthy_names() == ["r1"], "health gate to drain r0")
+# the replica death is itself the trigger: the bundle is AUTO-captured
+# by the replica_failed/replica_drained tap, no manual trigger() here
+wait_for(lambda: len(inc.captured) >= 1, "auto-captured incident bundle",
+         timeout_s=30.0)
+stop.set()
+for t in pumps:
+    t.join(timeout=10)
+
+bdir = inc.captured[0]
+meta = json.load(open(os.path.join(bdir, "meta.json")))
+assert meta["reason"].startswith("replica"), meta["reason"]
+fleet = meta.get("fleet") or {}
+assert "r0" in (fleet.get("dead") or []), fleet
+assert sorted(fleet.get("lanes") or []) == ["serve/r0", "serve/r1"], fleet
+# the merged bundle holds one flight snapshot per replica lane
+assert sorted(os.listdir(os.path.join(bdir, "fleet"))) \
+    == ["serve_r0.json", "serve_r1.json"]
+flight = json.load(open(os.path.join(bdir, "flight.json")))
+assert flight["events"], "coordinator ring empty in bundle"
+
+fe.close()
+broker.close()
+kinds = {json.loads(l)["kind"]
+         for l in open(os.path.join(out, "events.jsonl"))}
+for k in ("replica_failed", "replica_drained", "incident_captured",
+          "flight_dump"):
+    assert k in kinds, f"missing {k} in {sorted(kinds)}"
+print(f"  incident OK: {os.path.basename(bdir)} dead={fleet['dead']} "
+      f"lanes={fleet['lanes']} ({served[0]} requests pumped)")
+EOF
+
+# the triage CLI (pre-jax verb) must attribute the dead replica and exit 0
+INC_OUT=$(timeout -k 10 60 python -m feddrift_tpu incident "$IRUN")
+echo "$INC_OUT" | head -5
+echo "$INC_OUT" | grep -q "DEAD REPLICAS: r0" \
+  || { echo "incident CLI did not attribute dead replica r0"; exit 1; }
+echo "$INC_OUT" | grep -q "merged fleet snapshots: serve/r0, serve/r1" \
+  || { echo "incident CLI missing merged fleet lanes"; exit 1; }
 
 echo "chaos_smoke: ALL OK"

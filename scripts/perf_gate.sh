@@ -16,18 +16,18 @@ cd "$(dirname "$0")/.."
 out="$(mktemp -d)"
 trap 'rm -rf "$out"' EXIT
 
-echo "[perf_gate 1/13] graftlint: static analysis must be clean"
+echo "[perf_gate 1/14] graftlint: static analysis must be clean"
 # cheapest stage first: the lint verb is pre-jax and runs in ~1s; a dirty
 # tree fails the gate before any bench spends minutes compiling
 python -m feddrift_tpu lint feddrift_tpu/ --strict
 
-echo "[perf_gate 2/13] warm run (populates the persistent compile cache)"
+echo "[perf_gate 2/14] warm run (populates the persistent compile cache)"
 python bench.py --smoke --cpu > "$out/warm.json"
 
-echo "[perf_gate 3/13] measured run"
+echo "[perf_gate 3/14] measured run"
 python bench.py --smoke --cpu > "$out/bench.json"
 
-echo "[perf_gate 4/13] cost-model + critical-path fields present"
+echo "[perf_gate 4/14] cost-model + critical-path fields present"
 python - "$out/bench.json" <<'EOF'
 import json, sys
 d = json.loads(open(sys.argv[1]).read().strip().splitlines()[-1])
@@ -44,7 +44,7 @@ print(f"  mfu_estimate={d['mfu_estimate']} (source={d['mfu']['source']}), "
       f"round_wall_p99_s={d['round_wall_p99_s']}")
 EOF
 
-echo "[perf_gate 5/13] critical_path on a smoke run dir"
+echo "[perf_gate 5/14] critical_path on a smoke run dir"
 # bench.py runs without an out_dir (no spans.jsonl), so the attribution
 # verb gets its own tiny recorded run: 2 iterations, per-round path.
 JAX_PLATFORMS=cpu python -m feddrift_tpu run \
@@ -68,7 +68,7 @@ print(f"  dominant_segment={d['dominant_segment']}, "
       f"host_overhead_frac_mean={d['host_overhead_frac_mean']}")
 EOF
 
-echo "[perf_gate 6/13] megastep: K=4 vs K=1 bitwise parity + zero steady recompiles"
+echo "[perf_gate 6/14] megastep: K=4 vs K=1 bitwise parity + zero steady recompiles"
 # the megastep fuses K whole iterations into one device program; the gate
 # is (a) bitwise-identical params/accuracy vs the K=1 driver and (b) no
 # jit cache growth past the single warm-up compile across blocks
@@ -101,7 +101,7 @@ print(f"  parity OK (leafdiff=0.0, {len(a4)} eval points), "
       f"megastep cache entries={n}")
 EOF
 
-echo "[perf_gate 7/13] composed megastep: population+hierarchy K=4 parity + throughput"
+echo "[perf_gate 7/14] composed megastep: population+hierarchy K=4 parity + throughput"
 # the megastep gate is per-feature: population cohorts, hierarchy and
 # chaos schedules all fuse now. Gate is (a) bitwise parity (params, eval
 # series, registry bookkeeping) vs the K=1 driver, (b) no megastep jit
@@ -182,7 +182,7 @@ print(f"  parity OK (leafdiff=0.0, {len(a4)} eval points); "
 assert r4 >= r1, f"composed K=4 slower than its own K=1: {r4:.1f} vs {r1:.1f}"
 EOF
 
-echo "[perf_gate 8/13] serving: batched >= 3x unbatched rps, zero steady recompiles"
+echo "[perf_gate 8/14] serving: batched >= 3x unbatched rps, zero steady recompiles"
 # The cluster-routed read path (platform/serving.py): warm every bucket,
 # drive a seeded closed loop twice — unbatched (bucket set {1}) and
 # batched — and hold (a) an absolute unbatched requests/s floor (sanity:
@@ -238,7 +238,7 @@ assert un["requests_per_s"] >= 200, \
 assert ratio >= 3.0, f"micro-batching payoff collapsed: {ratio:.2f}x"
 EOF
 
-echo "[perf_gate 9/13] precision: bf16_mixed smoke (accuracy + recompiles) + artifact gate"
+echo "[perf_gate 9/14] precision: bf16_mixed smoke (accuracy + recompiles) + artifact gate"
 # End-to-end precision policy (core/precision.py): a fast fnn smoke proves
 # the policy actually reaches the compiled round program — bf16 pool
 # params, one jit signature per function under BOTH policies (dtype flips
@@ -296,7 +296,7 @@ EOF
 python -m feddrift_tpu regress PRECISION_r15.json \
     --baseline PRECISION_r15.json --tol-precision-acc 0.05
 
-echo "[perf_gate 10/13] regress: self-comparison (warm), then vs BENCH_r05.json"
+echo "[perf_gate 10/14] regress: self-comparison (warm), then vs BENCH_r05.json"
 # back-to-back smoke runs on a busy 1-core host: generous relative noise
 # margins, but identical round counts make every metric comparable
 python -m feddrift_tpu regress "$out/bench.json" --baseline "$out/warm.json" \
@@ -307,7 +307,7 @@ python -m feddrift_tpu regress "$out/bench.json" --baseline "$out/warm.json" \
 python -m feddrift_tpu regress "$out/bench.json" --baseline BENCH_r05.json \
     --tol-rounds 0.9 --tol-acc 0.15
 
-echo "[perf_gate 11/13] ops plane overhead: enabled run within 2% of disabled"
+echo "[perf_gate 11/14] ops plane overhead: enabled run within 2% of disabled"
 # The /metrics + /healthz server, SLO engine and status tap must stay off
 # the hot path. Resolving a 2% bound on a noisy 1-core host needs a
 # paired design: BOTH experiments live in one process, iterations
@@ -359,7 +359,7 @@ assert on_rps >= 0.98 * off_rps, \
     f"ops plane costs more than 2%: {on_rps:.3f} vs {off_rps:.3f} rounds/s"
 EOF
 
-echo "[perf_gate 12/13] canary shadow overhead: canary-on within 5% of canary-off rps"
+echo "[perf_gate 12/14] canary shadow overhead: canary-on within 5% of canary-off rps"
 # The shadow canary duplicate-executes a seeded fraction of affected
 # micro-batches through the candidate generation (platform/canary.py).
 # Leg-level throughput on a shared host swings far more than the 5%
@@ -432,7 +432,7 @@ assert score >= 0.95, \
     f"shadow overhead above 5%: best pair {max(pair_ratios):.3f}, median {med:.3f}"
 EOF
 
-echo "[perf_gate 13/13] hostprof overhead: profiler+ledger on within 2% of off"
+echo "[perf_gate 13/14] hostprof overhead: profiler+ledger on within 2% of off"
 # The host-plane observatory (obs/hostprof.py) must be passive: the
 # 50 Hz sampling daemon plus the per-subsystem ledger hooks (cohort
 # planning, writeback, stager, drift decisions — always on, both sides)
@@ -486,6 +486,58 @@ print(f"  rounds/s hostprof-off={off_rps:.3f} hostprof-on={on_rps:.3f} "
       f"samples={exp.hostprof.samples}")
 assert on_rps >= 0.98 * off_rps, \
     f"hostprof costs more than 2%: {on_rps:.3f} vs {off_rps:.3f} rounds/s"
+EOF
+
+echo "[perf_gate 14/14] flight recorder: black box on within 2% of off"
+# The incident plane's always-on flight recorder (obs/blackbox.py) must
+# be passive: its bus tap (one RLock acquire + deque appends per event)
+# and per-iteration instrument snapshot may not cost measurable round
+# throughput. Same paired methodology as the hostprof stage: ONE
+# experiment serves both sides, the recorder's enabled flag is toggled
+# between iterations (outside the timed window), each side scored by
+# its per-iteration MINIMUM wall. Population mode so the event rate on
+# the measured path is the realistic one (cohorts, stragglers, churn).
+JAX_PLATFORMS=cpu python - <<'EOF'
+import tempfile, time
+import jax
+from feddrift_tpu.config import ExperimentConfig
+from feddrift_tpu.simulation.runner import Experiment
+
+BASE = dict(dataset="sea", model="lr", concept_drift_algo="oblivious",
+            concept_drift_algo_arg="", concept_num=1,
+            population_size=40, cohort_size=8, cohort_overprovision=2,
+            straggler_prob=0.1, churn_leave_prob=0.01, churn_join_prob=0.02,
+            train_iterations=40, comm_round=20, epochs=1, batch_size=50,
+            sample_num=50, frequency_of_the_test=5, seed=7,
+            trace_sync=True, incident_ring=512)
+
+exp = Experiment(ExperimentConfig(**BASE), out_dir=tempfile.mkdtemp())
+assert exp.flight is not None and exp.flight.enabled, "recorder not armed"
+assert exp.incidents is not None, "incident manager not armed"
+exp.run_iteration(0); exp.run_iteration(1)           # warm-up / compiles
+jax.block_until_ready(exp.pool.params)
+best = {"off": float("inf"), "on": float("inf")}
+for t in range(2, BASE["train_iterations"]):
+    name = "on" if t % 2 else "off"
+    exp.flight.enabled = (name == "on")
+    t0 = time.perf_counter()
+    exp.run_iteration(t)
+    jax.block_until_ready(exp.pool.params)
+    best[name] = min(best[name], time.perf_counter() - t0)
+exp.flight.enabled = True
+# the black box must have been recording while the run was measured
+assert exp.flight.observed > 0, "recorder observed nothing"
+dump = exp.flight.dump(include_spans=False, include_instruments=False)
+assert dump["events"], "event ring empty"
+assert dump["round_breakdowns"], "round_breakdown ring empty"
+assert dump["instrument_snapshots"], "no per-iteration instrument snapshots"
+off_rps = BASE["comm_round"] / best["off"]
+on_rps = BASE["comm_round"] / best["on"]
+print(f"  rounds/s recorder-off={off_rps:.3f} recorder-on={on_rps:.3f} "
+      f"ratio={on_rps / off_rps:.4f} (floor 0.98), "
+      f"observed={exp.flight.observed}")
+assert on_rps >= 0.98 * off_rps, \
+    f"flight recorder costs more than 2%: {on_rps:.3f} vs {off_rps:.3f} rounds/s"
 EOF
 
 echo "perf_gate: OK"

@@ -1,0 +1,373 @@
+"""Incident plane: flight-recorder rings, trigger debounce, bundle
+capture, the merged fleet dimension, and the ``incident`` triage CLI
+(obs/blackbox.py, obs/incident.py; docs/OBSERVABILITY.md Incident
+plane)."""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+from feddrift_tpu.obs import live
+from feddrift_tpu.obs.blackbox import FlightRecorder
+from feddrift_tpu.obs.events import EventBus
+from feddrift_tpu.obs.incident import (IncidentManager, incident_main,
+                                       resolve_bundle)
+
+
+class TestFlightRecorder:
+    def test_ring_wraparound_at_capacity(self):
+        """The event ring is bounded: 100 events through a 16-slot ring
+        keep exactly the newest 16, while the lifetime counter proves
+        the rest were observed (not dropped on the record path)."""
+        rec = FlightRecorder(capacity=16)
+        for i in range(100):
+            rec.observe({"kind": "metrics_logged", "i": i})
+        d = rec.dump(include_spans=False, include_instruments=False)
+        assert len(d["events"]) == 16
+        assert [e["i"] for e in d["events"]] == list(range(84, 100))
+        assert d["observed"] == 100
+        assert d["capacity"] == 16
+
+    def test_alert_tee_survives_main_ring_wrap(self):
+        """Alerts are teed into their own ring, so a burst of ordinary
+        events wrapping the main ring does not evict the alert trail."""
+        rec = FlightRecorder(capacity=16)
+        rec.observe({"kind": "alert_raised", "rule": "x",
+                     "severity": "crit"})
+        for i in range(50):
+            rec.observe({"kind": "metrics_logged", "i": i})
+        d = rec.dump(include_spans=False, include_instruments=False)
+        assert not any(e["kind"] == "alert_raised" for e in d["events"])
+        assert [a["rule"] for a in d["alerts"]] == ["x"]
+
+    def test_disabled_recorder_is_inert(self):
+        rec = FlightRecorder(capacity=16, enabled=False)
+        rec.observe({"kind": "metrics_logged"})
+        assert rec.snapshot_instruments() is None
+        d = rec.dump(include_spans=False, include_instruments=False)
+        assert d["events"] == [] and d["observed"] == 0
+
+    def test_bus_tap_feeds_rings(self, tmp_path):
+        bus = EventBus(str(tmp_path / "events.jsonl"))
+        rec = FlightRecorder(capacity=8).attach(bus)
+        with bus:
+            bus.emit("run_start")
+            bus.emit("round_breakdown", wall_s=1.0,
+                     segments={"train": 0.9})
+        d = rec.dump(include_spans=False, include_instruments=False)
+        assert [e["kind"] for e in d["events"]] == ["run_start",
+                                                    "round_breakdown"]
+        assert len(d["round_breakdowns"]) == 1
+        rec.detach()
+
+
+class TestIncidentManager:
+    def test_debounce_window(self, tmp_path):
+        """One bundle per debounce window; suppressed triggers are
+        counted; ``force`` (the crash path) bypasses the window."""
+        t = [0.0]
+        m = IncidentManager(str(tmp_path), debounce_s=30.0,
+                            clock=lambda: t[0])
+        assert m.trigger("first") is not None
+        assert m.trigger("second") is None
+        assert m.suppressed == 1
+        t[0] = 29.0
+        assert m.trigger("third") is None
+        t[0] = 31.0
+        assert m.trigger("fourth") is not None
+        assert m.trigger("crash", force=True) is not None
+        bundles = sorted(os.listdir(tmp_path / "incidents"))
+        assert len(bundles) == 3
+
+    def test_concurrent_trigger_storm_yields_one_bundle(self, tmp_path):
+        """Every replica draining at once is ONE incident: 8 threads
+        firing through the same debounce window produce exactly one
+        bundle, and the bundle records how many triggers it absorbed."""
+        m = IncidentManager(str(tmp_path), debounce_s=60.0)
+        barrier = threading.Barrier(8)
+        results = []
+
+        def fire(i):
+            barrier.wait()
+            results.append(m.trigger(f"storm-{i}"))
+
+        threads = [threading.Thread(target=fire, args=(i,))
+                   for i in range(8)]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join(timeout=30)
+        captured = [r for r in results if r is not None]
+        assert len(captured) == 1
+        assert sorted(os.listdir(tmp_path / "incidents")) \
+            == [os.path.basename(captured[0])]
+        meta = json.load(open(os.path.join(captured[0], "meta.json")))
+        assert meta["suppressed_triggers"] == 0  # counted before write
+        assert m.suppressed == 7
+
+    def test_trigger_predicates(self, tmp_path):
+        """Only crit alerts / rollback verdicts trigger; warns and
+        promote verdicts do not."""
+        m = IncidentManager(str(tmp_path), debounce_s=0.0)
+        m.observe({"kind": "alert_raised", "rule": "r", "severity": "warn"})
+        m.observe({"kind": "canary_verdict", "verdict": "promote"})
+        assert not os.path.isdir(tmp_path / "incidents")
+        m.observe({"kind": "alert_raised", "rule": "ari_collapse",
+                   "severity": "crit"})
+        bundles = os.listdir(tmp_path / "incidents")
+        assert len(bundles) == 1 and "alert_ari_collapse" in bundles[0]
+
+    def test_bundle_contents_and_prune(self, tmp_path):
+        rec = FlightRecorder(capacity=32)
+        rec.observe({"kind": "run_start", "_ts": 1.0})
+        (tmp_path / "alerts.jsonl").write_text(
+            json.dumps({"rule": "x"}) + "\n")
+        m = IncidentManager(str(tmp_path), recorder=rec, debounce_s=0.0,
+                            max_bundles=2,
+                            config_json=json.dumps({"dataset": "sea"}))
+        for i in range(4):
+            assert m.trigger(f"t{i}") is not None
+        names = sorted(os.listdir(tmp_path / "incidents"))
+        assert len(names) == 2 and names[-1].endswith("t3")
+        bdir = os.path.join(tmp_path, "incidents", names[-1])
+        files = sorted(os.listdir(bdir))
+        for expect in ("alerts_tail.jsonl", "config.json", "flight.json",
+                       "host_ledger.json", "meta.json", "trace.json"):
+            assert expect in files
+        meta = json.load(open(os.path.join(bdir, "meta.json")))
+        assert meta["reason"] == "t3" and meta["pid"] == os.getpid()
+        flight = json.load(open(os.path.join(bdir, "flight.json")))
+        assert flight["events"][0]["kind"] == "run_start"
+        trace = json.load(open(os.path.join(bdir, "trace.json")))
+        assert any(ev.get("name") == "run_start"
+                   for ev in trace["traceEvents"])
+
+    def test_on_exception_bypasses_debounce(self, tmp_path):
+        m = IncidentManager(str(tmp_path), debounce_s=600.0)
+        assert m.trigger("first") is not None
+        try:
+            raise ValueError("model diverged")
+        except ValueError as err:
+            path = m.on_exception(err)
+        assert path is not None and "exception_ValueError" in path
+        meta = json.load(open(os.path.join(path, "meta.json")))
+        assert "model diverged" in meta["evidence"]["traceback"]
+
+    def test_no_run_dir_is_inert(self):
+        m = IncidentManager(None, debounce_s=0.0)
+        assert m.trigger("x") is None
+        assert m.trigger("x", force=True) is None
+
+
+class TestFleetDimension:
+    def test_merged_bundle_names_dead_replica(self, tmp_path, capsys):
+        """A replica death mid-traffic produces ONE bundle holding the
+        per-replica flight snapshots, and the triage CLI attributes the
+        dead replica loudly (and exits 0)."""
+        rec = FlightRecorder(capacity=32)
+        rec.observe({"kind": "replica_failed", "replica": "r1",
+                     "reason": "fault:crash", "_ts": 1.0})
+        m = IncidentManager(str(tmp_path), recorder=rec, debounce_s=0.0)
+        m.fleet_source = lambda reason, ev: {
+            "dead": ["r1"],
+            "lanes": {"serve/r0": {"replica": "r0", "failed": None},
+                      "serve/r1": {"replica": "r1",
+                                   "failed": "Boom('crash')"}}}
+        bdir = m.trigger("replica_failed", evidence={"replica": "r1"})
+        meta = json.load(open(os.path.join(bdir, "meta.json")))
+        assert meta["fleet"]["dead"] == ["r1"]
+        assert meta["fleet"]["lanes"] == ["serve/r0", "serve/r1"]
+        assert sorted(os.listdir(os.path.join(bdir, "fleet"))) \
+            == ["serve_r0.json", "serve_r1.json"]
+        assert resolve_bundle(str(tmp_path)) == bdir
+        assert incident_main([str(tmp_path)]) == 0
+        out = capsys.readouterr().out
+        assert "DEAD REPLICAS: r1" in out
+        assert "serve/r0" in out and "replica_failed" in out
+
+    def test_pull_flights_round_trip(self):
+        """The ops/incident lane over a loopback client: a publisher
+        armed with a flight_fn answers a collector's pull with its ring
+        snapshot."""
+        class LoopClient:
+            def __init__(self):
+                self.qs = {}
+
+            def subscribe(self, topic, sink=None):
+                import queue as _q
+                q = sink if sink is not None else _q.Queue()
+                self.qs.setdefault(topic, []).append(q)
+                return q
+
+            def publish(self, topic, payload):
+                for q in self.qs.get(topic, []):
+                    q.put(payload)
+
+        c = LoopClient()
+        rec = FlightRecorder(capacity=8)
+        rec.observe({"kind": "serve_request", "replica": "r0"})
+        pub = live.OpsPublisher(
+            c, "serve/r0", namespace="t", interval_s=5.0,
+            flight_fn=lambda: rec.dump(include_spans=False,
+                                       include_instruments=False))
+        pub.start()
+        try:
+            got = live.pull_flights(c, ["serve/r0"], namespace="t",
+                                    timeout_s=10.0, poll_s=0.05)
+            assert "serve/r0" in got
+            snap = got["serve/r0"]
+            assert snap["lane"] == "serve/r0"
+            assert snap["flight"]["events"][0]["kind"] == "serve_request"
+            # a lane nobody serves stays silently absent
+            got = live.pull_flights(c, ["serve/ghost"], namespace="t",
+                                    timeout_s=0.3, poll_s=0.05)
+            assert got == {}
+        finally:
+            pub.close()
+
+
+class TestProcessHooks:
+    def test_sigquit_captures_bundle_in_subprocess(self, tmp_path):
+        """kill -QUIT on a wedged process dumps all-thread stacks to the
+        faulthandler log AND snapshots an incident bundle — exercised in
+        a real subprocess so the signal path is the production one."""
+        script = r"""
+import os, signal, sys, time
+run_dir = sys.argv[1]
+from feddrift_tpu.obs import events
+from feddrift_tpu.obs import incident
+from feddrift_tpu.obs.blackbox import FlightRecorder
+bus = events.get_bus()
+rec = FlightRecorder(capacity=32).attach(bus)
+m = incident.IncidentManager(run_dir, recorder=rec,
+                             debounce_s=600.0).attach(bus)
+fh = open(os.path.join(run_dir, "faulthandler.log"), "w")
+incident.install_process_hooks(m, faulthandler_file=fh)
+os.kill(os.getpid(), signal.SIGQUIT)
+time.sleep(0.2)     # let the handler run at the next bytecode boundary
+bundles = os.listdir(os.path.join(run_dir, "incidents"))
+assert len(bundles) == 1 and "sigquit" in bundles[0], bundles
+fh.flush()
+print("OK", bundles[0])
+"""
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        proc = subprocess.run(
+            [sys.executable, "-c", script, str(tmp_path)],
+            capture_output=True, text=True, timeout=120, env=env)
+        assert proc.returncode == 0, proc.stderr
+        assert "OK" in proc.stdout
+        log = (tmp_path / "faulthandler.log").read_text()
+        assert "Thread" in log or "File" in log  # real stack dump landed
+        bdir = resolve_bundle(str(tmp_path))
+        meta = json.load(open(os.path.join(bdir, "meta.json")))
+        assert meta["reason"] == "sigquit"
+
+    def test_excepthook_chain_captures(self, tmp_path):
+        from feddrift_tpu.obs import incident as incident_mod
+        m = IncidentManager(str(tmp_path), debounce_s=600.0)
+        prev_current = incident_mod.current_manager()
+        prev_hook = sys.excepthook
+        try:
+            incident_mod.set_current(m)
+            # simulate what install_process_hooks' chained hook does
+            try:
+                raise RuntimeError("boom")
+            except RuntimeError as err:
+                mgr = incident_mod.current_manager()
+                assert mgr is m
+                path = mgr.on_exception(err)
+            assert path is not None
+        finally:
+            sys.excepthook = prev_hook
+            incident_mod.set_current(prev_current)
+
+
+class TestAlertsRotation:
+    def test_rotation_boundary(self, tmp_path):
+        """alerts.jsonl honours the obs_max_file_mb cap like events/
+        spans: crossing the byte bound renames to .1 and every line in
+        BOTH generations stays a whole JSON record (no torn writes)."""
+        from feddrift_tpu.obs import alerts as obs_alerts
+        path = str(tmp_path / "alerts.jsonl")
+        for i in range(12):
+            obs_alerts.append_alert(
+                path, {"rule": "budget", "severity": "warn", "i": i,
+                       "message": "m" * 80},
+                max_bytes=400)
+        assert os.path.isfile(path + ".1")
+        rows = []
+        for fname in (path + ".1", path):
+            if not os.path.isfile(fname):
+                continue        # the very last append may have rotated
+            with open(fname) as f:
+                for ln in f:
+                    rows.append(json.loads(ln))   # raises on a torn line
+        assert rows, "rotation dropped every record"
+        # the retained generations are cut at the boundary, never
+        # unbounded: each file holds at most cap + one whole record
+        for fname in (path + ".1", path):
+            if os.path.isfile(fname):
+                assert os.path.getsize(fname) <= 400 + 200
+
+    def test_monitor_passes_cap_through(self, tmp_path):
+        from feddrift_tpu.obs.alerts import AlertMonitor
+        mon = AlertMonitor(path=str(tmp_path / "alerts.jsonl"),
+                           max_bytes=123)
+        assert mon.max_bytes == 123
+
+
+class TestFleetStale:
+    def test_stale_lane_evicted_and_marked(self):
+        now = 1000.0
+        lanes = {
+            "runner": {"lane": "runner", "pid": 11, "ts": 995.0, "seq": 3,
+                       "status": {"iteration": 7},
+                       "health": {"status": "ok"}},
+            "serve/r1": {"lane": "serve/r1", "pid": 22, "ts": 880.0,
+                         "seq": 9, "status": {"iteration": 2},
+                         "health": {"status": "ok"}},
+        }
+        table = live.render_fleet(lanes, stale_after=60.0, now=now)
+        lines = table.splitlines()
+        assert lines[0].split()[:3] == ["LANE", "PID", "AGE"]
+        live_row = next(l for l in lines if l.startswith("runner"))
+        stale_row = next(l for l in lines if l.startswith("serve/r1"))
+        assert "5s" in live_row and "(stale)" not in live_row
+        assert "120s" in stale_row and "(stale)" in stale_row
+        assert "ok" not in stale_row       # frozen metrics not rendered
+        # disabled: the frozen snapshot renders as usual
+        table = live.render_fleet(lanes, stale_after=None, now=now)
+        assert "(stale)" not in table
+
+    def test_fleet_cli_accepts_stale_after(self):
+        """The flag parses and <=0 disables eviction (smoke via
+        argparse path: bad broker exits via error, so only check the
+        parser wiring on render)."""
+        lanes = {"a": {"lane": "a", "pid": 1, "ts": 0.0, "seq": 1}}
+        out = live.render_fleet(lanes, stale_after=None, now=1e9)
+        assert "(stale)" not in out
+
+
+class TestCliRouting:
+    def test_incident_verb_routes_pre_jax(self, tmp_path, capsys):
+        from feddrift_tpu.cli import main
+        m = IncidentManager(str(tmp_path), debounce_s=0.0)
+        m.trigger("alert:test", evidence={"rule": "test",
+                                          "severity": "crit"})
+        assert main(["incident", str(tmp_path)]) == 0
+        out = capsys.readouterr().out
+        assert "alert:test" in out
+        assert main(["incident", str(tmp_path), "--json"]) == 0
+        payload = json.loads(capsys.readouterr().out)
+        assert payload["meta"]["reason"] == "alert:test"
+
+    def test_incident_verb_missing_bundle_exits_1(self, tmp_path, capsys):
+        from feddrift_tpu.cli import main
+        assert main(["incident", str(tmp_path)]) == 1
+        assert "no incident bundle" in capsys.readouterr().err
